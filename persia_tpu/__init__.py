@@ -1,0 +1,25 @@
+"""persia_tpu — a TPU-native hybrid-parallel recommendation training framework.
+
+A ground-up re-design of the capabilities of PersiaML/PERSIA
+(/root/reference) for TPU hardware:
+
+- Dense model training in JAX: ``jit`` + ``shard_map`` over a
+  ``jax.sharding.Mesh``, bf16 mixed precision, optax dense optimizers,
+  XLA collectives over ICI for data parallelism
+  (reference: persia/ctx.py + persia/distributed.py, torch DDP/NCCL).
+- Giant sparse embedding tables in sharded CPU-memory parameter servers
+  written in C++ (reference: rust/persia-embedding-server), updated
+  asynchronously with bounded staleness.
+- An embedding-worker middleware tier that shards sign lookups,
+  aggregates results into static-shape TPU-friendly tensors, and
+  accumulates gradients (reference: embedding_worker_service/mod.rs).
+- A native host-side pipeline feeding the TPU via pinned host buffers +
+  ``jax.device_put`` (reference: rust/persia-core CUDA pools + forward.rs).
+- Alternatively, fully device-resident sharded embedding tables in TPU
+  HBM via ``shard_map`` collectives (no CPU PS) — a TPU-first mode the
+  CUDA reference does not have.
+"""
+
+from persia_tpu.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,313 @@
+"""Configuration system.
+
+Two YAML documents configure a job, mirroring the reference's
+`global_config.yml` + `embedding_config.yml` split
+(rust/persia-embedding-config/src/lib.rs:459-526 and :552-650):
+
+- :class:`GlobalConfig` — job type, checkpointing, embedding-worker and
+  parameter-server tuning knobs.
+- :class:`EmbeddingSchema` — the per-slot embedding table schema
+  (dims, pooling mode, hashstack compression, feature groups with
+  automatic index-prefix assignment).
+
+TPU-first deviations from the reference:
+
+- ``sample_fixed_size`` is mandatory for non-summed ("raw") slots: XLA
+  needs static shapes, so raw slots always produce a dense
+  ``(batch, sample_fixed_size)`` index tensor with ``-1`` padding plus a
+  mask, instead of variable-length per-sample lists.
+- The wire dtype for embeddings defaults to **bf16** (TPU-native) rather
+  than the reference's f16 (persia-common/src/lib.rs:85-113).
+"""
+
+import copy
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Dict, List, Optional, Tuple
+
+from persia_tpu.utils import load_yaml
+
+
+class JobType(Enum):
+    TRAIN = "Train"
+    EVAL = "Eval"
+    INFER = "Infer"
+
+
+class InitializationMethod(Enum):
+    """Embedding entry initialization (reference: lib.rs:26-97)."""
+
+    BOUNDED_UNIFORM = "bounded_uniform"
+    NORMAL = "normal"
+    TRUNCATED_NORMAL = "truncated_normal"
+    ZERO = "zero"
+
+
+@dataclass
+class InitializationConfig:
+    method: InitializationMethod = InitializationMethod.BOUNDED_UNIFORM
+    lower: float = -0.01
+    upper: float = 0.01
+    mean: float = 0.0
+    standard_deviation: float = 0.01
+
+
+@dataclass
+class HashStackConfig:
+    """Multi-round hashing that compresses a huge vocab into
+    ``hash_stack_rounds`` lookups in a table of ``embedding_size`` rows
+    (reference: embedding_worker_service/mod.rs:347-400)."""
+
+    hash_stack_rounds: int = 0
+    embedding_size: int = 0
+
+
+@dataclass
+class SlotConfig:
+    """Schema of one sparse feature slot (reference: lib.rs:535-550)."""
+
+    name: str
+    dim: int
+    sample_fixed_size: int = 10
+    embedding_summation: bool = True
+    sqrt_scaling: bool = False
+    hash_stack_config: HashStackConfig = field(default_factory=HashStackConfig)
+    index_prefix: int = 0  # assigned automatically from feature groups
+
+
+@dataclass
+class EmbeddingSchema:
+    """Full sparse-side schema: all slots + feature-group prefix layout.
+
+    ``feature_index_prefix_bit`` reserves the top N bits of the u64 sign
+    space per feature group so different groups never collide in the
+    shared parameter-server keyspace (reference: lib.rs:552-650).
+    """
+
+    slots_config: Dict[str, SlotConfig]
+    feature_index_prefix_bit: int = 0
+    feature_groups: Dict[str, List[str]] = field(default_factory=dict)
+    initialization: InitializationConfig = field(default_factory=InitializationConfig)
+
+    def __post_init__(self):
+        self._assign_index_prefixes()
+
+    def _assign_index_prefixes(self):
+        if self.feature_index_prefix_bit <= 0:
+            return
+        if self.feature_index_prefix_bit >= 64:
+            raise ValueError("feature_index_prefix_bit must be < 64")
+        # Every slot must belong to exactly one feature group; ungrouped
+        # slots each get their own group.
+        grouped = {s for slots in self.feature_groups.values() for s in slots}
+        for name in self.slots_config:
+            if name not in grouped:
+                self.feature_groups[name] = [name]
+        shift = 64 - self.feature_index_prefix_bit
+        for group_index, (_group, slot_names) in enumerate(
+            sorted(self.feature_groups.items()), start=1
+        ):
+            if group_index >= (1 << self.feature_index_prefix_bit):
+                raise ValueError(
+                    f"too many feature groups for "
+                    f"feature_index_prefix_bit={self.feature_index_prefix_bit}"
+                )
+            prefix = group_index << shift
+            for slot_name in slot_names:
+                if slot_name not in self.slots_config:
+                    raise ValueError(f"feature group references unknown slot {slot_name}")
+                if self.slots_config[slot_name].index_prefix != 0:
+                    raise ValueError("do not set index_prefix manually")
+                self.slots_config[slot_name].index_prefix = prefix
+
+    @property
+    def feature_spacing(self) -> int:
+        """Usable sign space under each prefix."""
+        if self.feature_index_prefix_bit > 0:
+            return (1 << (64 - self.feature_index_prefix_bit)) - 1
+        return (1 << 64) - 1
+
+    def get_slot(self, feature_name: str) -> SlotConfig:
+        try:
+            return self.slots_config[feature_name]
+        except KeyError:
+            raise KeyError(
+                f"feature {feature_name!r} not in embedding schema "
+                f"(slots: {list(self.slots_config)})"
+            ) from None
+
+    @property
+    def feature_names(self) -> List[str]:
+        return list(self.slots_config.keys())
+
+    @classmethod
+    def load(cls, path: str) -> "EmbeddingSchema":
+        return cls.from_dict(load_yaml(path))
+
+    @classmethod
+    def from_dict(cls, raw: dict) -> "EmbeddingSchema":
+        raw = copy.deepcopy(raw)
+        slots = {}
+        slots_raw = raw.get("slots_config", {})
+        for name, sc in slots_raw.items():
+            hs = sc.get("hash_stack_config", {}) or {}
+            slots[name] = SlotConfig(
+                name=name,
+                dim=int(sc["dim"]),
+                sample_fixed_size=int(sc.get("sample_fixed_size", 10)),
+                embedding_summation=bool(sc.get("embedding_summation", True)),
+                sqrt_scaling=bool(sc.get("sqrt_scaling", False)),
+                hash_stack_config=HashStackConfig(
+                    hash_stack_rounds=int(hs.get("hash_stack_rounds", 0)),
+                    embedding_size=int(hs.get("embedding_size", 0)),
+                ),
+            )
+        init_raw = raw.get("initialization", {}) or {}
+        init = InitializationConfig(
+            method=InitializationMethod(init_raw.get("method", "bounded_uniform")),
+            lower=float(init_raw.get("lower", -0.01)),
+            upper=float(init_raw.get("upper", 0.01)),
+            mean=float(init_raw.get("mean", 0.0)),
+            standard_deviation=float(init_raw.get("standard_deviation", 0.01)),
+        )
+        return cls(
+            slots_config=slots,
+            feature_index_prefix_bit=int(raw.get("feature_index_prefix_bit", 0)),
+            feature_groups={
+                k: list(v) for k, v in (raw.get("feature_groups", {}) or {}).items()
+            },
+            initialization=init,
+        )
+
+
+@dataclass
+class CheckpointingConfig:
+    num_workers: int = 4
+
+
+@dataclass
+class EmbeddingWorkerConfig:
+    """(reference: lib.rs:389-415)"""
+
+    forward_buffer_size: int = 1000
+    buffered_data_expired_sec: int = 1800
+
+
+@dataclass
+class EmbeddingParameterServerConfig:
+    """(reference: lib.rs:417-457)"""
+
+    capacity: int = 1_000_000_000
+    num_hashmap_internal_shards: int = 100
+    full_amount_manager_buffer_size: int = 1000
+    enable_incremental_update: bool = False
+    incremental_buffer_size: int = 5_000_000
+    incremental_dir: str = "/tmp/persia_inc_dump"
+
+
+@dataclass
+class CommonConfig:
+    job_type: JobType = JobType.TRAIN
+    metrics_enabled: bool = False
+    metrics_push_interval_sec: int = 10
+    checkpointing: CheckpointingConfig = field(default_factory=CheckpointingConfig)
+    # Infer-mode fixed addresses (reference: infer_config servers list)
+    infer_servers: List[str] = field(default_factory=list)
+    infer_initial_sparse_checkpoint: str = ""
+    # Wire dtype for embeddings: "bf16" (TPU-native default) or "f32".
+    embedding_wire_dtype: str = "bf16"
+
+
+@dataclass
+class GlobalConfig:
+    common: CommonConfig = field(default_factory=CommonConfig)
+    embedding_worker: EmbeddingWorkerConfig = field(
+        default_factory=EmbeddingWorkerConfig
+    )
+    parameter_server: EmbeddingParameterServerConfig = field(
+        default_factory=EmbeddingParameterServerConfig
+    )
+
+    @classmethod
+    def load(cls, path: str) -> "GlobalConfig":
+        return cls.from_dict(load_yaml(path))
+
+    @classmethod
+    def from_dict(cls, raw: dict) -> "GlobalConfig":
+        raw = raw or {}
+        common_raw = raw.get("common_config", raw.get("common", {})) or {}
+        ckpt_raw = common_raw.get("checkpointing_config", {}) or {}
+        infer_raw = common_raw.get("infer_config", {}) or {}
+        worker_raw = raw.get(
+            "embedding_worker_config", raw.get("embedding_worker", {})
+        ) or {}
+        ps_raw = raw.get(
+            "embedding_parameter_server_config", raw.get("parameter_server", {})
+        ) or {}
+        return cls(
+            common=CommonConfig(
+                job_type=JobType(common_raw.get("job_type", "Train")),
+                metrics_enabled=bool(
+                    (common_raw.get("metrics_config", {}) or {}).get(
+                        "enable_metrics", False
+                    )
+                ),
+                metrics_push_interval_sec=int(
+                    (common_raw.get("metrics_config", {}) or {}).get(
+                        "push_interval_sec", 10
+                    )
+                ),
+                checkpointing=CheckpointingConfig(
+                    num_workers=int(ckpt_raw.get("num_workers", 4))
+                ),
+                infer_servers=list(infer_raw.get("servers", []) or []),
+                infer_initial_sparse_checkpoint=str(
+                    infer_raw.get("initial_sparse_checkpoint", "")
+                ),
+                embedding_wire_dtype=str(
+                    common_raw.get("embedding_wire_dtype", "bf16")
+                ),
+            ),
+            embedding_worker=EmbeddingWorkerConfig(
+                forward_buffer_size=int(worker_raw.get("forward_buffer_size", 1000)),
+                buffered_data_expired_sec=int(
+                    worker_raw.get("buffered_data_expired_sec", 1800)
+                ),
+            ),
+            parameter_server=EmbeddingParameterServerConfig(
+                capacity=int(ps_raw.get("capacity", 1_000_000_000)),
+                num_hashmap_internal_shards=int(
+                    ps_raw.get("num_hashmap_internal_shards", 100)
+                ),
+                full_amount_manager_buffer_size=int(
+                    ps_raw.get("full_amount_manager_buffer_size", 1000)
+                ),
+                enable_incremental_update=bool(
+                    ps_raw.get("enable_incremental_update", False)
+                ),
+                incremental_buffer_size=int(
+                    ps_raw.get("incremental_buffer_size", 5_000_000)
+                ),
+                incremental_dir=str(
+                    ps_raw.get("incremental_dir", "/tmp/persia_inc_dump")
+                ),
+            ),
+        )
+
+
+def uniform_slots(
+    names: List[str],
+    dim: int,
+    embedding_summation: bool = True,
+    sample_fixed_size: int = 10,
+) -> Dict[str, SlotConfig]:
+    """Convenience builder: identical slots for a list of feature names."""
+    return {
+        n: SlotConfig(
+            name=n,
+            dim=dim,
+            embedding_summation=embedding_summation,
+            sample_fixed_size=sample_fixed_size,
+        )
+        for n in names
+    }

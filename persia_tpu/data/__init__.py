@@ -1,0 +1,27 @@
+from persia_tpu.data.batch import (
+    MAX_BATCH_SIZE,
+    IDTypeFeature,
+    IDTypeFeatureWithSingleID,
+    Label,
+    NonIDTypeFeature,
+    PersiaBatch,
+)
+from persia_tpu.data.dataloader import (
+    DataLoader,
+    IterableDataset,
+    StreamingDataset,
+    TrainingBatch,
+)
+
+__all__ = [
+    "MAX_BATCH_SIZE",
+    "IDTypeFeature",
+    "IDTypeFeatureWithSingleID",
+    "NonIDTypeFeature",
+    "Label",
+    "PersiaBatch",
+    "DataLoader",
+    "IterableDataset",
+    "StreamingDataset",
+    "TrainingBatch",
+]

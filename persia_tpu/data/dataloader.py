@@ -1,0 +1,170 @@
+"""Datasets + DataLoader (reference: persia/data.py).
+
+The reference's ``DataLoader`` owns a native ``Forward`` pipeline engine
+(rust/persia-core/src/forward.rs) that prefetches embedding lookups and
+yields GPU-resident ``PersiaTrainingBatch``es. Here the engine is
+:class:`persia_tpu.pipeline.ForwardEngine`; it overlaps embedding-worker
+RPC, host staging, and TPU transfer, bounded by the embedding-staleness
+semaphore, and yields :class:`TrainingBatch` of JAX arrays.
+"""
+
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, Iterator, List, Optional
+
+from persia_tpu.data.batch import PersiaBatch
+from persia_tpu.logger import get_default_logger
+
+_logger = get_default_logger(__name__)
+
+
+@dataclass
+class EmbeddingResult:
+    """Embeddings for one batch, keyed by feature name.
+
+    - summed slots: array of shape ``(batch, dim)``
+    - raw slots: ``(distinct, dim)`` embeddings + static-shape
+      ``(batch, sample_fixed_size)`` int32 index tensor (-1 = padding);
+      mask is derived on-device as ``index >= 0``.
+    """
+
+    summed: Dict[str, Any] = field(default_factory=dict)
+    raw: Dict[str, Any] = field(default_factory=dict)  # name -> (emb, index)
+    ref_id: Optional[int] = None  # worker-side gradient return handle
+    worker_addr: Optional[str] = None
+
+
+@dataclass
+class TrainingBatch:
+    """Device-ready batch handed to the training step
+    (reference: PersiaTrainingBatch in forward.rs)."""
+
+    non_id_type_features: Dict[str, Any]
+    embeddings: EmbeddingResult
+    labels: Dict[str, Any]
+    batch_id: Optional[int] = None
+    meta: Optional[bytes] = None
+    requires_grad: bool = True
+
+
+class IterableDatasetBase(Iterable[PersiaBatch]):
+    """Anything that yields :class:`PersiaBatch` (reference: data.py:29-94)."""
+
+    def __init__(self, buffer_size: int = 128):
+        self.buffer_size = buffer_size
+
+    def __iter__(self) -> Iterator[PersiaBatch]:
+        raise NotImplementedError
+
+
+class IterableDataset(IterableDatasetBase):
+    """Wraps a local python iterable producing PersiaBatch, decoupled
+    through a background thread + bounded queue (reference: data.py:141-199)."""
+
+    def __init__(self, source: Iterable[PersiaBatch], buffer_size: int = 128):
+        super().__init__(buffer_size)
+        self.source = source
+
+    def __iter__(self) -> Iterator[PersiaBatch]:
+        q: "queue.Queue" = queue.Queue(maxsize=self.buffer_size)
+        _SENTINEL = object()
+        error: List[BaseException] = []
+
+        def _producer():
+            try:
+                for item in self.source:
+                    q.put(item)
+            except BaseException as e:  # surface producer failures to consumer
+                error.append(e)
+            finally:
+                q.put(_SENTINEL)
+
+        t = threading.Thread(target=_producer, daemon=True, name="dataset-producer")
+        t.start()
+        while True:
+            item = q.get()
+            if item is _SENTINEL:
+                if error:
+                    raise error[0]
+                return
+            yield item
+
+
+class StreamingDataset(IterableDatasetBase):
+    """Binds the dataflow receiver: batches pushed by remote data-loader
+    processes over the message queue (reference: data.py:97-138).
+
+    The receiver is registered by ``TrainCtx``/``DataCtx`` wiring; iteration
+    blocks on the network queue forever (training-stream semantics).
+    """
+
+    def __init__(self, buffer_size: int = 128):
+        super().__init__(buffer_size)
+        self._receiver = None  # persia_tpu.service.dataflow.DataflowReceiver
+
+    def bind_receiver(self, receiver):
+        self._receiver = receiver
+
+    def __iter__(self) -> Iterator[PersiaBatch]:
+        if self._receiver is None:
+            raise RuntimeError(
+                "StreamingDataset not bound to a dataflow receiver; "
+                "enter a TrainCtx/EmbeddingCtx first"
+            )
+        while True:
+            payload = self._receiver.get()
+            if payload is None:
+                return
+            yield PersiaBatch.from_bytes(payload)
+
+
+class DataLoader:
+    """Drives the forward engine over a dataset
+    (reference: persia/data.py:202-271).
+
+    Arguments mirror the reference: ``forward_buffer_size`` bounds the
+    prefetch pipeline, ``embedding_staleness`` bounds how many batches may
+    have in-flight (unreturned) embedding gradients, ``reproducible``
+    enables the batch-id reorder buffer so iteration order is deterministic.
+    """
+
+    def __init__(
+        self,
+        dataset: IterableDatasetBase,
+        forward_buffer_size: int = 10,
+        timeout_ms: int = 1000 * 60 * 10,
+        num_workers: int = 8,
+        reproducible: bool = False,
+        embedding_staleness: Optional[int] = None,
+    ):
+        self.dataset = dataset
+        self.timeout_ms = timeout_ms
+        self.forward_buffer_size = forward_buffer_size
+        self.num_workers = num_workers
+        self.reproducible = reproducible
+        self.embedding_staleness = embedding_staleness
+        self._engine = None
+
+    def _ensure_engine(self):
+        if self._engine is None:
+            from persia_tpu.ctx import current_ctx
+            from persia_tpu.pipeline import ForwardEngine
+
+            ctx = current_ctx()
+            if ctx is None:
+                raise RuntimeError(
+                    "DataLoader requires an active EmbeddingCtx/TrainCtx"
+                )
+            self._engine = ForwardEngine(
+                ctx=ctx,
+                num_workers=self.num_workers,
+                buffer_size=self.forward_buffer_size,
+                reproducible=self.reproducible,
+                embedding_staleness=self.embedding_staleness,
+            )
+        return self._engine
+
+    def __iter__(self) -> Iterator[TrainingBatch]:
+        engine = self._ensure_engine()
+        yield from engine.run(iter(self.dataset), timeout_ms=self.timeout_ms)

@@ -1,0 +1,74 @@
+"""Process-identity plumbing via environment variables.
+
+Reference: persia/env.py (RANK/LOCAL_RANK/WORLD_SIZE for nn-workers,
+REPLICA_INDEX/REPLICA_SIZE for every other role). Same contract here so
+launchers and k8s manifests stay interchangeable.
+"""
+
+import os
+from typing import Optional
+
+
+def _int_env(name: str) -> Optional[int]:
+    val = os.environ.get(name)
+    return int(val) if val is not None else None
+
+
+PERSIA_SKIP_CHECK_DATA = os.environ.get("PERSIA_SKIP_CHECK_DATA", "false").lower() in (
+    "1",
+    "true",
+    "yes",
+)
+
+
+def get_rank() -> int:
+    """Global rank of this nn-worker (dense trainer) process."""
+    rank = _int_env("RANK")
+    if rank is None:
+        raise RuntimeError("RANK environment variable not set")
+    return rank
+
+
+def get_local_rank() -> int:
+    """Rank of this nn-worker on its host (selects the local TPU chip)."""
+    local_rank = _int_env("LOCAL_RANK")
+    if local_rank is None:
+        raise RuntimeError("LOCAL_RANK environment variable not set")
+    return local_rank
+
+
+def get_world_size() -> int:
+    """Total number of nn-worker processes."""
+    world_size = _int_env("WORLD_SIZE")
+    if world_size is None:
+        raise RuntimeError("WORLD_SIZE environment variable not set")
+    return world_size
+
+
+def get_replica_index() -> int:
+    """Replica index for data-loader / embedding-worker / parameter-server roles."""
+    idx = _int_env("REPLICA_INDEX")
+    if idx is None:
+        raise RuntimeError("REPLICA_INDEX environment variable not set")
+    return idx
+
+
+def get_replica_size() -> int:
+    """Replica count for data-loader / embedding-worker / parameter-server roles."""
+    size = _int_env("REPLICA_SIZE")
+    if size is None:
+        raise RuntimeError("REPLICA_SIZE environment variable not set")
+    return size
+
+
+def get_coordinator_addr() -> str:
+    """Address of the persia-coordinator control-plane service.
+
+    Plays the role NATS plays in the reference (PERSIA_NATS_URL,
+    rust/others/persia-nats-client/src/lib.rs:98-108).
+    """
+    return os.environ.get("PERSIA_COORDINATOR_ADDR", "127.0.0.1:23333")
+
+
+def get_metrics_gateway_addr() -> Optional[str]:
+    return os.environ.get("PERSIA_METRICS_GATEWAY_ADDR")

@@ -1,0 +1,74 @@
+"""Logger factory (reference: persia/logger.py — colorlog + optional file).
+
+Uses stdlib logging with an ANSI color formatter; no third-party deps.
+"""
+
+import logging
+import os
+import sys
+from typing import Optional
+
+_LEVEL_COLORS = {
+    logging.DEBUG: "\x1b[36m",  # cyan
+    logging.INFO: "\x1b[32m",  # green
+    logging.WARNING: "\x1b[33m",  # yellow
+    logging.ERROR: "\x1b[31m",  # red
+    logging.CRITICAL: "\x1b[35m",  # magenta
+}
+_RESET = "\x1b[0m"
+
+_loggers = {}
+
+
+class _ColorFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        color = _LEVEL_COLORS.get(record.levelno, "")
+        base = super().format(record)
+        if color and sys.stderr.isatty():
+            return f"{color}{base}{_RESET}"
+        return base
+
+
+def get_logger(
+    name: str,
+    level: Optional[int] = None,
+    log_file: Optional[str] = None,
+) -> logging.Logger:
+    """Create (or fetch) a configured logger.
+
+    Level comes from the ``LOG_LEVEL`` env var unless given explicitly,
+    mirroring the tracing env filter the reference uses in every binary.
+    """
+    if name in _loggers:
+        return _loggers[name]
+
+    logger = logging.getLogger(name)
+    if level is None:
+        level = getattr(
+            logging, os.environ.get("LOG_LEVEL", "INFO").upper(), logging.INFO
+        )
+    logger.setLevel(level)
+    logger.propagate = False
+
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(
+        _ColorFormatter(
+            fmt="%(asctime)s %(levelname)s [%(name)s] %(message)s",
+            datefmt="%Y-%m-%d %H:%M:%S",
+        )
+    )
+    logger.addHandler(handler)
+
+    if log_file is not None:
+        file_handler = logging.FileHandler(log_file)
+        file_handler.setFormatter(
+            logging.Formatter(fmt="%(asctime)s %(levelname)s [%(name)s] %(message)s")
+        )
+        logger.addHandler(file_handler)
+
+    _loggers[name] = logger
+    return logger
+
+
+def get_default_logger(name: str = "persia_tpu") -> logging.Logger:
+    return get_logger(name)

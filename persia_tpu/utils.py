@@ -1,0 +1,75 @@
+"""Small shared helpers (reference: persia/utils.py)."""
+
+import os
+import random
+import socket
+import subprocess
+from typing import Any, List, Optional
+
+import numpy as np
+import yaml
+
+
+def setup_seed(seed: int):
+    """Deterministic seeding across python/numpy (reference: utils.py:13-32).
+
+    JAX PRNG keys are explicit (functional), so unlike the torch reference
+    there is no global framework RNG to pin — training code derives all
+    device randomness from ``jax.random.key(seed)``.
+    """
+    random.seed(seed)
+    np.random.seed(seed)
+    os.environ["PYTHONHASHSEED"] = str(seed)
+
+
+def load_yaml(path: str) -> Any:
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"yaml file not found: {path}")
+    with open(path, "r") as f:
+        return yaml.safe_load(f)
+
+
+def dump_yaml(content: Any, path: str):
+    with open(path, "w") as f:
+        yaml.safe_dump(content, f)
+
+
+def run_command(cmd: List[str], env: Optional[dict] = None) -> subprocess.Popen:
+    full_env = dict(os.environ)
+    if env:
+        full_env.update({k: str(v) for k, v in env.items()})
+    return subprocess.Popen(cmd, env=full_env)
+
+
+def find_free_port(start: int = 10000, end: int = 65535) -> int:
+    """Pick a currently-free TCP port (reference: utils.py:83-91)."""
+    for _ in range(128):
+        port = random.randint(start, end)
+        with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+            try:
+                s.bind(("127.0.0.1", port))
+                return port
+            except OSError:
+                continue
+    raise RuntimeError("could not find a free port")
+
+
+def resolve_binary_path(name: str) -> str:
+    """Locate a native service binary shipped inside the package.
+
+    Native binaries are built into ``persia_tpu/native_bin/`` by the
+    Makefile (reference resolves rust binaries next to the package,
+    persia/utils.py:64-66).
+    """
+    here = os.path.dirname(os.path.abspath(__file__))
+    candidates = [
+        os.path.join(here, "native_bin", name),
+        os.path.join(os.path.dirname(here), "native", "build", name),
+    ]
+    for c in candidates:
+        if os.path.exists(c):
+            return c
+    raise FileNotFoundError(
+        f"native binary {name!r} not found; run `make -C native` first "
+        f"(searched {candidates})"
+    )

@@ -1,0 +1,38 @@
+"""Test harness: force JAX onto a virtual 8-device CPU platform so
+multi-chip sharding logic is exercised without TPU hardware
+(SURVEY.md §4: cluster-in-a-box testing pattern)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+
+@pytest.fixture(scope="session")
+def native_lib_path():
+    """Build (if needed) and return the native shared library path."""
+    build_dir = REPO_ROOT / "native" / "build"
+    lib = build_dir / "libpersia_native.so"
+    makefile = REPO_ROOT / "native" / "Makefile"
+    if makefile.exists():
+        subprocess.run(
+            ["make", "-C", str(REPO_ROOT / "native"), "-j", "8"],
+            check=True,
+            capture_output=True,
+        )
+    if not lib.exists():
+        pytest.skip("native library not built")
+    return str(lib)

@@ -1,0 +1,109 @@
+import pytest
+
+from persia_tpu.config import (
+    EmbeddingSchema,
+    GlobalConfig,
+    HashStackConfig,
+    JobType,
+    SlotConfig,
+    uniform_slots,
+)
+
+
+def test_slot_defaults():
+    s = SlotConfig(name="a", dim=8)
+    assert s.sample_fixed_size == 10
+    assert s.embedding_summation
+    assert not s.sqrt_scaling
+    assert s.hash_stack_config.hash_stack_rounds == 0
+    assert s.index_prefix == 0
+
+
+def test_index_prefix_assignment():
+    schema = EmbeddingSchema(
+        slots_config=uniform_slots(["a", "b", "c"], dim=4),
+        feature_index_prefix_bit=8,
+        feature_groups={"g1": ["a", "b"]},
+    )
+    # a and b share g1's prefix; c got its own auto group
+    pa = schema.slots_config["a"].index_prefix
+    pb = schema.slots_config["b"].index_prefix
+    pc = schema.slots_config["c"].index_prefix
+    assert pa == pb != pc
+    assert pa != 0 and pc != 0
+    # prefixes occupy the top 8 bits only
+    assert pa % (1 << 56) == 0
+    assert schema.feature_spacing == (1 << 56) - 1
+
+
+def test_index_prefix_manual_rejected():
+    slots = uniform_slots(["a"], dim=4)
+    slots["a"].index_prefix = 123
+    with pytest.raises(ValueError):
+        EmbeddingSchema(slots_config=slots, feature_index_prefix_bit=4)
+
+
+def test_too_many_groups_rejected():
+    slots = uniform_slots([f"f{i}" for i in range(4)], dim=2)
+    with pytest.raises(ValueError):
+        EmbeddingSchema(slots_config=slots, feature_index_prefix_bit=2)
+
+
+def test_no_prefix_bit_means_no_assignment():
+    schema = EmbeddingSchema(slots_config=uniform_slots(["a", "b"], dim=4))
+    assert schema.slots_config["a"].index_prefix == 0
+    assert schema.feature_spacing == (1 << 64) - 1
+
+
+def test_schema_yaml_roundtrip(tmp_path):
+    raw = {
+        "feature_index_prefix_bit": 8,
+        "slots_config": {
+            "age": {"dim": 8},
+            "clicks": {
+                "dim": 16,
+                "embedding_summation": False,
+                "sample_fixed_size": 5,
+                "sqrt_scaling": True,
+                "hash_stack_config": {"hash_stack_rounds": 2, "embedding_size": 100},
+            },
+        },
+        "feature_groups": {"grp": ["age", "clicks"]},
+    }
+    import yaml
+
+    p = tmp_path / "embedding_config.yml"
+    p.write_text(yaml.safe_dump(raw))
+    schema = EmbeddingSchema.load(str(p))
+    assert schema.slots_config["clicks"].dim == 16
+    assert schema.slots_config["clicks"].hash_stack_config == HashStackConfig(2, 100)
+    assert not schema.slots_config["clicks"].embedding_summation
+    assert schema.slots_config["age"].index_prefix == (
+        schema.slots_config["clicks"].index_prefix
+    )
+
+
+def test_global_config_defaults_and_yaml(tmp_path):
+    cfg = GlobalConfig()
+    assert cfg.common.job_type == JobType.TRAIN
+    assert cfg.parameter_server.capacity == 1_000_000_000
+    assert cfg.embedding_worker.forward_buffer_size == 1000
+
+    import yaml
+
+    raw = {
+        "common_config": {"job_type": "Infer", "embedding_wire_dtype": "f32"},
+        "embedding_parameter_server_config": {
+            "capacity": 1000,
+            "num_hashmap_internal_shards": 4,
+        },
+        "embedding_worker_config": {"forward_buffer_size": 7},
+    }
+    p = tmp_path / "global_config.yml"
+    p.write_text(yaml.safe_dump(raw))
+    cfg = GlobalConfig.load(str(p))
+    assert cfg.common.job_type == JobType.INFER
+    assert cfg.common.embedding_wire_dtype == "f32"
+    assert cfg.parameter_server.capacity == 1000
+    assert cfg.parameter_server.num_hashmap_internal_shards == 4
+    assert cfg.embedding_worker.forward_buffer_size == 7

@@ -1,0 +1,104 @@
+import numpy as np
+import pytest
+
+from persia_tpu.data import (
+    IDTypeFeature,
+    IDTypeFeatureWithSingleID,
+    Label,
+    NonIDTypeFeature,
+    PersiaBatch,
+)
+
+
+def test_id_type_feature_csr():
+    lil = [
+        np.array([], dtype=np.uint64),
+        np.array([10001], dtype=np.uint64),
+        np.array([7, 8, 9], dtype=np.uint64),
+    ]
+    f = IDTypeFeature("clicks", lil)
+    assert f.batch_size == 3
+    np.testing.assert_array_equal(f.offsets, [0, 0, 1, 4])
+    np.testing.assert_array_equal(f.signs, [10001, 7, 8, 9])
+    # LIL view round trip
+    for orig, view in zip(lil, f.data):
+        np.testing.assert_array_equal(orig, view)
+
+
+def test_id_type_feature_type_checks():
+    with pytest.raises(TypeError):
+        IDTypeFeature("bad", [np.array([1.0], dtype=np.float32)])
+    with pytest.raises(TypeError):
+        IDTypeFeature("bad", [np.array([[1]], dtype=np.uint64)])
+
+
+def test_single_id_feature():
+    f = IDTypeFeatureWithSingleID("uid", np.arange(5, dtype=np.uint64))
+    assert f.batch_size == 5
+    np.testing.assert_array_equal(f.offsets, np.arange(6))
+    with pytest.raises(TypeError):
+        IDTypeFeatureWithSingleID("uid", np.arange(5, dtype=np.int64))
+
+
+def test_ndarray_checks():
+    NonIDTypeFeature(np.zeros((4, 2), dtype=np.float32))
+    Label(np.zeros(4, dtype=np.float32), name="y")
+    with pytest.raises(TypeError):
+        NonIDTypeFeature(np.zeros((4, 2), dtype=np.float16))
+    with pytest.raises(TypeError):
+        NonIDTypeFeature([1, 2, 3])
+
+
+def test_batch_size_mismatch():
+    with pytest.raises(ValueError):
+        PersiaBatch(
+            [IDTypeFeatureWithSingleID("a", np.arange(4, dtype=np.uint64))],
+            labels=[Label(np.zeros(3, dtype=np.float32))],
+        )
+
+
+def test_batch_wire_roundtrip():
+    batch = PersiaBatch(
+        id_type_features=[
+            IDTypeFeature(
+                "clicks",
+                [
+                    np.array([1, 2], dtype=np.uint64),
+                    np.array([], dtype=np.uint64),
+                ],
+            ),
+            IDTypeFeatureWithSingleID("uid", np.array([9, 10], dtype=np.uint64)),
+        ],
+        non_id_type_features=[
+            NonIDTypeFeature(np.random.rand(2, 3).astype(np.float32), name="dense"),
+            NonIDTypeFeature(np.array([[1], [0]], dtype=np.int64), name="flags"),
+        ],
+        labels=[Label(np.array([1.0, 0.0], dtype=np.float32), name="y")],
+        batch_id=42,
+        requires_grad=False,
+        meta=b"hello",
+    )
+    rt = PersiaBatch.from_bytes(batch.to_bytes())
+    assert rt.batch_id == 42
+    assert rt.requires_grad is False
+    assert rt.meta == b"hello"
+    assert rt.batch_size == 2
+    assert [f.name for f in rt.id_type_features] == ["clicks", "uid"]
+    np.testing.assert_array_equal(rt.id_type_features[0].signs, [1, 2])
+    np.testing.assert_array_equal(rt.id_type_features[0].offsets, [0, 2, 2])
+    np.testing.assert_array_equal(
+        rt.non_id_type_features[0].data, batch.non_id_type_features[0].data
+    )
+    assert rt.non_id_type_features[1].data.dtype == np.int64
+    np.testing.assert_array_equal(rt.labels[0].data, [1.0, 0.0])
+
+
+def test_empty_optional_sections():
+    batch = PersiaBatch(
+        [IDTypeFeatureWithSingleID("uid", np.arange(3, dtype=np.uint64))]
+    )
+    rt = PersiaBatch.from_bytes(batch.to_bytes())
+    assert rt.non_id_type_features == []
+    assert rt.labels == []
+    assert rt.batch_id is None
+    assert rt.requires_grad
