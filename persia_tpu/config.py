@@ -94,14 +94,36 @@ class EmbeddingSchema:
 
     def _assign_index_prefixes(self):
         if self.feature_index_prefix_bit <= 0:
+            # Deviation from the reference (which requires the bit > 0 when
+            # grouping is used): 0 disables prefixing entirely, useful for
+            # single-table jobs. Slots keep index_prefix 0.
             return
         if self.feature_index_prefix_bit >= 64:
             raise ValueError("feature_index_prefix_bit must be < 64")
+        # A slot may belong to at most one feature group.
+        seen: Dict[str, str] = {}
+        for group, slots in self.feature_groups.items():
+            for s in slots:
+                if s in seen:
+                    raise ValueError(
+                        f"slot {s!r} listed in feature groups {seen[s]!r} and "
+                        f"{group!r}; a slot may belong to only one feature group"
+                    )
+                seen[s] = group
         # Every slot must belong to exactly one feature group; ungrouped
-        # slots each get their own group.
-        grouped = {s for slots in self.feature_groups.values() for s in slots}
+        # slots each get their own group. An ungrouped slot whose name
+        # equals an existing group name would silently merge into (and
+        # clobber) that group — the reference panics on this
+        # (rust/persia-embedding-config/src/lib.rs:618); we raise.
+        grouped = set(seen)
         for name in self.slots_config:
             if name not in grouped:
+                if name in self.feature_groups:
+                    raise ValueError(
+                        f"ungrouped slot {name!r} has the same name as a "
+                        f"feature group; a slot name can not be the same as a "
+                        f"feature group name"
+                    )
                 self.feature_groups[name] = [name]
         shift = 64 - self.feature_index_prefix_bit
         for group_index, (_group, slot_names) in enumerate(
@@ -117,7 +139,10 @@ class EmbeddingSchema:
                 if slot_name not in self.slots_config:
                     raise ValueError(f"feature group references unknown slot {slot_name}")
                 if self.slots_config[slot_name].index_prefix != 0:
-                    raise ValueError("do not set index_prefix manually")
+                    raise ValueError(
+                        f"slot {slot_name!r} already has index_prefix set; "
+                        f"do not set index_prefix manually"
+                    )
                 self.slots_config[slot_name].index_prefix = prefix
 
     @property

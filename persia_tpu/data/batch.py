@@ -9,8 +9,8 @@ TPU-first design differences:
   one contiguous uint64 buffer per feature serializes with zero copies
   and is what the C++ worker consumes directly.
 - Serialization is a simple length-prefixed little-endian binary layout
-  (`PTB1`) implemented identically in Python (here) and C++
-  (native/src/wire.h), replacing the reference's speedy format.
+  (`PTB2`) replacing the reference's speedy format. This Python
+  implementation is the format's source of truth.
 """
 
 import struct
@@ -24,7 +24,13 @@ from persia_tpu.env import PERSIA_SKIP_CHECK_DATA
 # worker's dedup maps (reference: persia/embedding/data.py:14).
 MAX_BATCH_SIZE = 65535
 
-MAGIC = b"PTB1"
+MAGIC = b"PTB2"
+
+# Header flag bits (PTB2): presence flags instead of in-band sentinels so
+# batch_id=-1 and meta=b"" round-trip losslessly.
+_FLAG_REQUIRES_GRAD = 1
+_FLAG_HAS_BATCH_ID = 2
+_FLAG_HAS_META = 4
 
 _ND_SUPPORTED_DTYPES = (
     np.bool_,
@@ -37,7 +43,7 @@ _ND_SUPPORTED_DTYPES = (
     np.uint8,
 )
 
-# Stable dtype codes for the wire format (shared with native/src/wire.h).
+# Stable dtype codes for the wire format.
 _DTYPE_CODES = {
     np.dtype(np.float32): 0,
     np.dtype(np.float64): 1,
@@ -166,8 +172,8 @@ class PersiaBatch:
     """One training/inference batch: ID features + dense features + labels.
 
     Reference surface: persia/embedding/data.py:279-411. ``to_bytes`` /
-    ``from_bytes`` implement the PTB1 wire layout consumed by the C++
-    embedding worker and the dataflow message queue.
+    ``from_bytes`` implement the PTB2 wire layout consumed by the
+    dataflow message queue between data-loader and trainer processes.
     """
 
     def __init__(
@@ -200,15 +206,22 @@ class PersiaBatch:
 
     def to_bytes(self) -> bytes:
         out = [MAGIC]
+        flags = 0
+        if self.requires_grad:
+            flags |= _FLAG_REQUIRES_GRAD
+        if self.batch_id is not None:
+            flags |= _FLAG_HAS_BATCH_ID
+        if self.meta is not None:
+            flags |= _FLAG_HAS_META
         out.append(
             struct.pack(
                 "<qBH",
-                -1 if self.batch_id is None else self.batch_id,
-                1 if self.requires_grad else 0,
+                self.batch_id if self.batch_id is not None else 0,
+                flags,
                 self.batch_size,
             )
         )
-        meta = self.meta or b""
+        meta = self.meta if self.meta is not None else b""
         out.append(struct.pack("<I", len(meta)))
         out.append(meta)
 
@@ -239,11 +252,13 @@ class PersiaBatch:
         if bytes(view[:4]) != MAGIC:
             raise ValueError("bad PersiaBatch magic")
         pos = 4
-        batch_id, requires_grad, batch_size = struct.unpack_from("<qBH", view, pos)
+        batch_id, flags, batch_size = struct.unpack_from("<qBH", view, pos)
         pos += struct.calcsize("<qBH")
         (meta_len,) = struct.unpack_from("<I", view, pos)
         pos += 4
-        meta = bytes(view[pos : pos + meta_len]) if meta_len else None
+        meta = (
+            bytes(view[pos : pos + meta_len]) if flags & _FLAG_HAS_META else None
+        )
         pos += meta_len
 
         (n_id,) = struct.unpack_from("<H", view, pos)
@@ -289,7 +304,7 @@ class PersiaBatch:
             id_type_features=id_feats,
             non_id_type_features=groups[0],
             labels=groups[1],
-            batch_id=None if batch_id == -1 else batch_id,
-            requires_grad=bool(requires_grad),
+            batch_id=batch_id if flags & _FLAG_HAS_BATCH_ID else None,
+            requires_grad=bool(flags & _FLAG_REQUIRES_GRAD),
             meta=meta,
         )

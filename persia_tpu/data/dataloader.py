@@ -148,8 +148,14 @@ class DataLoader:
 
     def _ensure_engine(self):
         if self._engine is None:
-            from persia_tpu.ctx import current_ctx
-            from persia_tpu.pipeline import ForwardEngine
+            try:
+                from persia_tpu.ctx import current_ctx
+                from persia_tpu.pipeline import ForwardEngine
+            except ImportError as e:
+                raise RuntimeError(
+                    f"DataLoader requires persia_tpu.ctx and "
+                    f"persia_tpu.pipeline (import failed: {e})"
+                ) from e
 
             ctx = current_ctx()
             if ctx is None:

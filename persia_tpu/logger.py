@@ -40,7 +40,20 @@ def get_logger(
     mirroring the tracing env filter the reference uses in every binary.
     """
     if name in _loggers:
-        return _loggers[name]
+        cached_level, cached_file, logger = _loggers[name]
+        if (level is not None and level != cached_level) or (
+            log_file is not None and log_file != cached_file
+        ):
+            import warnings
+
+            warnings.warn(
+                f"get_logger({name!r}) called with level={level!r} "
+                f"log_file={log_file!r} but a logger was already configured "
+                f"with level={cached_level!r} log_file={cached_file!r}; "
+                f"keeping the original configuration",
+                stacklevel=2,
+            )
+        return logger
 
     logger = logging.getLogger(name)
     if level is None:
@@ -66,7 +79,7 @@ def get_logger(
         )
         logger.addHandler(file_handler)
 
-    _loggers[name] = logger
+    _loggers[name] = (level, log_file, logger)
     return logger
 
 

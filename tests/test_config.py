@@ -107,3 +107,43 @@ def test_global_config_defaults_and_yaml(tmp_path):
     assert cfg.parameter_server.capacity == 1000
     assert cfg.parameter_server.num_hashmap_internal_shards == 4
     assert cfg.embedding_worker.forward_buffer_size == 7
+
+
+def test_ungrouped_slot_name_collides_with_group_name():
+    with pytest.raises(ValueError, match="feature group name"):
+        EmbeddingSchema(
+            slots_config={
+                "a": SlotConfig(name="a", dim=8),
+                "b": SlotConfig(name="b", dim=8),
+                "c": SlotConfig(name="c", dim=8),
+            },
+            feature_index_prefix_bit=8,
+            feature_groups={"a": ["b", "c"]},
+        )
+
+
+def test_slot_in_two_groups_rejected():
+    with pytest.raises(ValueError, match="only one feature group"):
+        EmbeddingSchema(
+            slots_config={
+                "a": SlotConfig(name="a", dim=8),
+                "b": SlotConfig(name="b", dim=8),
+            },
+            feature_index_prefix_bit=8,
+            feature_groups={"g1": ["a", "b"], "g2": ["b"]},
+        )
+
+
+def test_all_slots_get_nonzero_prefix():
+    schema = EmbeddingSchema(
+        slots_config={
+            "a": SlotConfig(name="a", dim=8),
+            "b": SlotConfig(name="b", dim=8),
+            "c": SlotConfig(name="c", dim=8),
+        },
+        feature_index_prefix_bit=8,
+        feature_groups={"g1": ["b", "c"]},
+    )
+    assert all(s.index_prefix != 0 for s in schema.slots_config.values())
+    assert schema.slots_config["b"].index_prefix == schema.slots_config["c"].index_prefix
+    assert schema.slots_config["a"].index_prefix != schema.slots_config["b"].index_prefix

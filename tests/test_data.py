@@ -102,3 +102,22 @@ def test_empty_optional_sections():
     assert rt.labels == []
     assert rt.batch_id is None
     assert rt.requires_grad
+
+
+def test_wire_roundtrip_edge_sentinels():
+    import numpy as np
+    from persia_tpu.data.batch import IDTypeFeature, PersiaBatch
+
+    f = IDTypeFeature("s", [np.array([1], dtype=np.uint64)])
+    # meta=b'' and batch_id=-1 must survive the round trip (presence flags)
+    b = PersiaBatch([f], batch_id=-1, meta=b"", requires_grad=False)
+    rt = PersiaBatch.from_bytes(b.to_bytes())
+    assert rt.batch_id == -1
+    assert rt.meta == b""
+    assert rt.requires_grad is False
+
+    b2 = PersiaBatch([f], batch_id=None, meta=None)
+    rt2 = PersiaBatch.from_bytes(b2.to_bytes())
+    assert rt2.batch_id is None
+    assert rt2.meta is None
+    assert rt2.requires_grad is True
