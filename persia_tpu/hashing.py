@@ -1,0 +1,62 @@
+"""64-bit hashing used for sign→shard routing and hashstack compression.
+
+The reference routes every sign through ``farmhash::hash64(sign.to_le_bytes())``
+(rust/persia-embedding-server/src/embedding_worker_service/mod.rs:341-345) and
+uses the same hash for multi-round hashstack bucketing (mod.rs:347-400).
+We keep bit-exact FarmHash64 semantics for fixed 8-byte little-endian keys so
+the reference's golden transform tests carry over unchanged, and so a
+checkpoint's shard assignment is reproducible across Python and C++.
+
+Both a scalar and a vectorized numpy implementation are provided; the C++
+runtime (native/src/farmhash.h) implements the identical function.
+"""
+
+import numpy as np
+
+_MASK = 0xFFFFFFFFFFFFFFFF
+_K2 = 0x9AE16A3B2F90404F
+_MUL8 = (_K2 + 16) & _MASK  # HashLen0to16's `mul` for len == 8
+
+
+def farmhash64(sign: int) -> int:
+    """FarmHash64 of the 8-byte little-endian encoding of ``sign``.
+
+    Specialization of FarmHash's HashLen0to16 for len == 8, where both
+    64-bit fetches read the same word (the sign itself).
+    """
+    a = (sign + _K2) & _MASK
+    b = sign & _MASK
+    c = (((b >> 37) | (b << 27)) & _MASK) * _MUL8 + a & _MASK
+    c &= _MASK
+    d = ((((a >> 25) | (a << 39)) & _MASK) + b) * _MUL8 & _MASK
+    # HashLen16(c, d, mul)
+    h = ((c ^ d) * _MUL8) & _MASK
+    h ^= h >> 47
+    h = ((d ^ h) * _MUL8) & _MASK
+    h ^= h >> 47
+    h = (h * _MUL8) & _MASK
+    return h
+
+
+def farmhash64_np(signs: np.ndarray) -> np.ndarray:
+    """Vectorized :func:`farmhash64` over a uint64 array."""
+    s = signs.astype(np.uint64, copy=False)
+    k2 = np.uint64(_K2)
+    mul = np.uint64(_MUL8)
+    with np.errstate(over="ignore"):
+        a = s + k2
+        b = s
+        c = (((b >> np.uint64(37)) | (b << np.uint64(27))) * mul) + a
+        d = (((a >> np.uint64(25)) | (a << np.uint64(39))) + b) * mul
+        h = (c ^ d) * mul
+        h ^= h >> np.uint64(47)
+        h = (d ^ h) * mul
+        h ^= h >> np.uint64(47)
+        h *= mul
+    return h
+
+
+def sign_to_shard(signs: np.ndarray, replica_size: int) -> np.ndarray:
+    """Shard index for each sign: farmhash64(sign) % replica_size
+    (reference: embedding_worker_service/mod.rs:341-345)."""
+    return (farmhash64_np(signs) % np.uint64(replica_size)).astype(np.int64)
