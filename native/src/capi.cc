@@ -1,0 +1,100 @@
+// C ABI for persia_tpu's native runtime, consumed from Python via ctypes
+// (persia_tpu/ps/native.py). Keep every symbol extern "C" and POD-only.
+#include <cstdint>
+#include <cstring>
+
+#include "hashrng.h"
+#include "store.h"
+
+using persia::InitParams;
+using persia::Store;
+
+extern "C" {
+
+void* ptps_new(uint64_t capacity, uint32_t num_shards) {
+  return new Store(capacity, num_shards);
+}
+
+void ptps_free(void* h) { delete static_cast<Store*>(h); }
+
+// params: [lower, upper, mean, stddev, shape, scale, lambda]
+void ptps_configure(void* h, int method, const double* params,
+                    float admit_probability, float weight_bound,
+                    int enable_weight_bound) {
+  InitParams p;
+  p.lower = params[0];
+  p.upper = params[1];
+  p.mean = params[2];
+  p.stddev = params[3];
+  p.shape = params[4];
+  p.scale = params[5];
+  p.lambda = params[6];
+  static_cast<Store*>(h)->configure(method, p, admit_probability, weight_bound,
+                                    enable_weight_bound != 0);
+}
+
+int ptps_register_optimizer(void* h, const char* wire) {
+  return static_cast<Store*>(h)->register_optimizer(wire) ? 0 : -1;
+}
+
+int ptps_lookup(void* h, const uint64_t* signs, uint64_t n, uint32_t dim,
+                int training, float* out) {
+  return static_cast<Store*>(h)->lookup(signs, n, dim, training != 0, out);
+}
+
+int ptps_update(void* h, const uint64_t* signs, uint64_t n, uint32_t dim,
+                const float* grads) {
+  return static_cast<Store*>(h)->update(signs, n, dim, grads);
+}
+
+uint64_t ptps_len(void* h) { return static_cast<Store*>(h)->size(); }
+
+void ptps_clear(void* h) { static_cast<Store*>(h)->clear(); }
+
+uint64_t ptps_index_miss_count(void* h) {
+  return static_cast<Store*>(h)->index_miss_count();
+}
+
+uint64_t ptps_gradient_id_miss_count(void* h) {
+  return static_cast<Store*>(h)->gradient_id_miss_count();
+}
+
+int64_t ptps_get_entry(void* h, uint64_t sign, float* out, uint32_t maxlen,
+                       uint32_t* dim_out) {
+  return static_cast<Store*>(h)->get_entry(sign, out, maxlen, dim_out);
+}
+
+int ptps_set_entry(void* h, uint64_t sign, uint32_t dim, const float* vec,
+                   uint32_t len) {
+  return static_cast<Store*>(h)->set_entry(sign, dim, vec, len);
+}
+
+int ptps_dump(void* h, const char* path) {
+  return static_cast<Store*>(h)->dump_file(path) ? 0 : -1;
+}
+
+int ptps_load(void* h, const char* path, int clear_first) {
+  return static_cast<Store*>(h)->load_file(path, clear_first != 0) ? 0 : -1;
+}
+
+// Hash helpers (parity tests + worker-side routing from C++ later).
+uint64_t ptps_farmhash64(uint64_t sign) { return persia::farmhash64(sign); }
+
+void ptps_farmhash64_batch(const uint64_t* in, uint64_t n, uint64_t* out) {
+  for (uint64_t i = 0; i < n; ++i) out[i] = persia::farmhash64(in[i]);
+}
+
+void ptps_init_entry(uint64_t sign, uint32_t dim, int method,
+                     const double* params, float* out) {
+  InitParams p;
+  p.lower = params[0];
+  p.upper = params[1];
+  p.mean = params[2];
+  p.stddev = params[3];
+  p.shape = params[4];
+  p.scale = params[5];
+  p.lambda = params[6];
+  persia::init_entry(sign, dim, method, p, out);
+}
+
+}  // extern "C"

@@ -1,0 +1,170 @@
+// Hashing + deterministic init RNG, bit-identical to the Python spec
+// (persia_tpu/hashing.py and persia_tpu/ps/rng.py — the source of truth).
+//
+// farmhash64: FarmHash64 specialized to fixed 8-byte little-endian keys,
+// matching the reference's farmhash::hash64(sign.to_le_bytes()) routing
+// (embedding_worker_service/mod.rs:341-345).
+// splitmix64 streams: seeded-by-sign entry initialization (emb_entry.rs
+// analogue) — see rng.py for the full spec.
+#pragma once
+
+#include <cmath>
+#include <cstdint>
+
+namespace persia {
+
+static constexpr uint64_t kGolden = 0x9E3779B97F4A7C15ULL;
+static constexpr uint64_t kAdmitSalt = 0x5851F42D4C957F2DULL;
+static constexpr uint64_t kFarmK2 = 0x9AE16A3B2F90404FULL;
+
+inline uint64_t rotr64(uint64_t v, int s) { return (v >> s) | (v << (64 - s)); }
+
+inline uint64_t farmhash64(uint64_t sign) {
+  const uint64_t mul = kFarmK2 + 16;
+  uint64_t a = sign + kFarmK2;
+  uint64_t b = sign;
+  uint64_t c = rotr64(b, 37) * mul + a;
+  uint64_t d = (rotr64(a, 25) + b) * mul;
+  uint64_t h = (c ^ d) * mul;
+  h ^= h >> 47;
+  h = (d ^ h) * mul;
+  h ^= h >> 47;
+  h *= mul;
+  return h;
+}
+
+inline uint64_t splitmix_mix(uint64_t z) {
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+  z ^= z >> 31;
+  return z;
+}
+
+inline double u01_from_bits(uint64_t bits) {
+  return static_cast<double>(bits >> 11) * 0x1.0p-53;
+}
+
+// Scalar per-sign stream: k-th draw is mix(sign + k*kGolden), k >= 1.
+struct SignStream {
+  uint64_t sign;
+  uint64_t k = 0;
+  explicit SignStream(uint64_t s) : sign(s) {}
+
+  double next_u01() {
+    ++k;
+    return u01_from_bits(splitmix_mix(sign + k * kGolden));
+  }
+
+  double next_normal() {
+    double u1 = next_u01();
+    if (u1 < 0x1.0p-53) u1 = 0x1.0p-53;
+    double u2 = next_u01();
+    return std::sqrt(-2.0 * std::log(u1)) *
+           std::cos(2.0 * 3.141592653589793 * u2);
+  }
+
+  // Box-Muller emits pairs; the Python side consumes z0,z1 interleaved.
+  void next_normal_pair(double* z0, double* z1) {
+    double u1 = next_u01();
+    if (u1 < 0x1.0p-53) u1 = 0x1.0p-53;
+    double u2 = next_u01();
+    double r = std::sqrt(-2.0 * std::log(u1));
+    *z0 = r * std::cos(2.0 * 3.141592653589793 * u2);
+    *z1 = r * std::sin(2.0 * 3.141592653589793 * u2);
+  }
+
+  double next_gamma(double shape) {
+    if (shape < 1.0) {
+      double u = next_u01();
+      if (u < 0x1.0p-53) u = 0x1.0p-53;
+      return next_gamma(shape + 1.0) * std::pow(u, 1.0 / shape);
+    }
+    double d = shape - 1.0 / 3.0;
+    double c = 1.0 / std::sqrt(9.0 * d);
+    for (;;) {
+      double x = next_normal();
+      double v = 1.0 + c * x;
+      v = v * v * v;
+      if (v <= 0.0) continue;
+      double u = next_u01();
+      if (u < 0x1.0p-53) u = 0x1.0p-53;
+      if (u < 1.0 - 0.0331 * x * x * x * x) return d * v;
+      if (std::log(u) < 0.5 * x * x + d * (1.0 - v + std::log(v))) return d * v;
+    }
+  }
+
+  long next_poisson(double lam) {
+    double limit = std::exp(-lam);
+    long kk = 0;
+    double p = 1.0;
+    do {
+      ++kk;
+      p *= next_u01();
+    } while (p > limit);
+    return kk - 1;
+  }
+};
+
+inline bool admit(uint64_t sign, float probability) {
+  if (probability >= 1.0f) return true;
+  return u01_from_bits(splitmix_mix(sign ^ kAdmitSalt)) <
+         static_cast<double>(probability);
+}
+
+inline uint32_t internal_shard_of(uint64_t sign, uint32_t num_shards) {
+  return static_cast<uint32_t>(splitmix_mix(sign) % num_shards);
+}
+
+enum InitMethod : int {
+  kBoundedUniform = 0,
+  kBoundedGamma = 1,
+  kBoundedPoisson = 2,
+  kNormal = 3,
+  kTruncatedNormal = 4,
+  kZero = 5,
+};
+
+struct InitParams {
+  double lower = -0.01, upper = 0.01;
+  double mean = 0.0, stddev = 0.01;
+  double shape = 1.0, scale = 1.0;
+  double lambda = 1.0;
+};
+
+// Fill `out[dim]` with the deterministic initialization for `sign`.
+inline void init_entry(uint64_t sign, uint32_t dim, int method,
+                       const InitParams& p, float* out) {
+  SignStream st(sign);
+  switch (method) {
+    case kBoundedUniform:
+      for (uint32_t i = 0; i < dim; ++i)
+        out[i] = static_cast<float>(p.lower + (p.upper - p.lower) * st.next_u01());
+      break;
+    case kNormal:
+    case kTruncatedNormal: {
+      uint32_t pairs = (dim + 1) / 2;
+      for (uint32_t i = 0; i < pairs; ++i) {
+        double z0, z1;
+        st.next_normal_pair(&z0, &z1);
+        if (2 * i < dim) out[2 * i] = static_cast<float>(p.mean + p.stddev * z0);
+        if (2 * i + 1 < dim)
+          out[2 * i + 1] = static_cast<float>(p.mean + p.stddev * z1);
+      }
+      break;
+    }
+    case kBoundedGamma:
+      for (uint32_t i = 0; i < dim; ++i)
+        out[i] = static_cast<float>(st.next_gamma(p.shape) * p.scale);
+      break;
+    case kBoundedPoisson:
+      for (uint32_t i = 0; i < dim; ++i)
+        out[i] = static_cast<float>(st.next_poisson(p.lambda));
+      break;
+    case kZero:
+    default:
+      for (uint32_t i = 0; i < dim; ++i) out[i] = 0.0f;
+      break;
+  }
+}
+
+}  // namespace persia

@@ -1,0 +1,185 @@
+// Server-side sparse optimizers, matching persia_tpu/ps/optim.py numerics
+// (which in turn mirror the reference rust/persia-common/src/optim.rs with
+// exact 1/sqrt instead of the AVX2 approximate rsqrt).
+//
+// Entry layout: [embedding(dim) | optimizer state(require_space(dim))].
+#pragma once
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace persia {
+
+struct OptimizerConfig {
+  enum Kind : int { kSGD = 0, kAdagrad = 1, kAdam = 2 } kind = kSGD;
+  // sgd
+  float lr = 0.01f, wd = 0.0f;
+  // adagrad
+  float g_square_momentum = 1.0f, initialization = 0.01f, eps = 1e-10f;
+  bool vectorwise_shared = false;
+  // adam
+  float beta1 = 0.9f, beta2 = 0.999f;
+  uint32_t feature_index_prefix_bit = 0;
+
+  // Wire form: "sgd <lr> <wd>" | "adagrad <lr> <wd> <g2m> <init> <eps> <shared>"
+  //          | "adam <lr> <b1> <b2> <eps> <prefix_bit>"
+  static bool parse(const std::string& s, OptimizerConfig* out) {
+    char name[16];
+    OptimizerConfig c;
+    if (std::sscanf(s.c_str(), "%15s", name) != 1) return false;
+    if (std::strcmp(name, "sgd") == 0) {
+      c.kind = kSGD;
+      if (std::sscanf(s.c_str(), "%*s %f %f", &c.lr, &c.wd) != 2) return false;
+    } else if (std::strcmp(name, "adagrad") == 0) {
+      c.kind = kAdagrad;
+      int shared = 0;
+      if (std::sscanf(s.c_str(), "%*s %f %f %f %f %f %d", &c.lr, &c.wd,
+                      &c.g_square_momentum, &c.initialization, &c.eps,
+                      &shared) != 6)
+        return false;
+      c.vectorwise_shared = shared != 0;
+    } else if (std::strcmp(name, "adam") == 0) {
+      c.kind = kAdam;
+      unsigned prefix_bit = 0;
+      if (std::sscanf(s.c_str(), "%*s %f %f %f %f %u", &c.lr, &c.beta1,
+                      &c.beta2, &c.eps, &prefix_bit) != 5)
+        return false;
+      c.feature_index_prefix_bit = prefix_bit;
+    } else {
+      return false;
+    }
+    *out = c;
+    return true;
+  }
+};
+
+class Optimizer {
+ public:
+  explicit Optimizer(const OptimizerConfig& c) : cfg_(c) {}
+
+  uint32_t require_space(uint32_t dim) const {
+    switch (cfg_.kind) {
+      case OptimizerConfig::kSGD:
+        return 0;
+      case OptimizerConfig::kAdagrad:
+        return cfg_.vectorwise_shared ? 1 : dim;
+      case OptimizerConfig::kAdam:
+        return dim * 2;
+    }
+    return 0;
+  }
+
+  void state_initialization(float* entry, uint32_t dim) const {
+    uint32_t space = require_space(dim);
+    if (cfg_.kind == OptimizerConfig::kAdagrad) {
+      for (uint32_t i = 0; i < space; ++i) entry[dim + i] = cfg_.initialization;
+    } else {
+      for (uint32_t i = 0; i < space; ++i) entry[dim + i] = 0.0f;
+    }
+  }
+
+  // Advance + fetch the per-feature-group Adam beta powers for a batch.
+  // Mirrors SparseAdam.batch_level_state: each distinct masked sign group
+  // advances once per call; powers start at beta and advance before use.
+  void batch_level_state(const uint64_t* signs, uint64_t n,
+                         std::vector<float>* b1p, std::vector<float>* b2p) {
+    if (cfg_.kind != OptimizerConfig::kAdam) return;
+    b1p->resize(n);
+    b2p->resize(n);
+    uint64_t mask = 0;
+    if (cfg_.feature_index_prefix_bit > 0)
+      mask = ~((1ULL << (64 - cfg_.feature_index_prefix_bit)) - 1);
+    std::unordered_map<uint64_t, std::pair<float, float>> stepped;
+    std::lock_guard<std::mutex> lk(accum_mu_);
+    for (uint64_t i = 0; i < n; ++i) {
+      uint64_t g = signs[i] & mask;
+      auto it = stepped.find(g);
+      if (it != stepped.end()) {
+        (*b1p)[i] = it->second.first;
+        (*b2p)[i] = it->second.second;
+        continue;
+      }
+      auto acc = accum_.find(g);
+      float p1 = cfg_.beta1, p2 = cfg_.beta2;
+      if (acc != accum_.end()) {
+        p1 = acc->second.first;
+        p2 = acc->second.second;
+      }
+      p1 *= cfg_.beta1;
+      p2 *= cfg_.beta2;
+      accum_[g] = {p1, p2};
+      stepped[g] = {p1, p2};
+      (*b1p)[i] = p1;
+      (*b2p)[i] = p2;
+    }
+  }
+
+  // One optimizer step on a single entry, in place.
+  void update(float* entry, const float* grad, uint32_t dim, float b1p,
+              float b2p) const {
+    switch (cfg_.kind) {
+      case OptimizerConfig::kSGD: {
+        for (uint32_t i = 0; i < dim; ++i)
+          entry[i] -= cfg_.lr * (grad[i] + cfg_.wd * entry[i]);
+        break;
+      }
+      case OptimizerConfig::kAdagrad: {
+        float* emb = entry;
+        if (cfg_.vectorwise_shared) {
+          float acc = entry[dim];
+          float scale =
+              cfg_.lr / std::sqrt(acc + cfg_.eps);
+          double g2 = 0.0;
+          for (uint32_t i = 0; i < dim; ++i) {
+            emb[i] -= scale * grad[i];
+            g2 += static_cast<double>(grad[i]) * grad[i];
+          }
+          // mean of squares accumulated in f32 like numpy's float32 mean
+          float g2f = static_cast<float>(g2 / dim);
+          entry[dim] = acc * cfg_.g_square_momentum + g2f;
+        } else {
+          float* acc = entry + dim;
+          for (uint32_t i = 0; i < dim; ++i) {
+            emb[i] -= cfg_.lr * grad[i] / std::sqrt(acc[i] + cfg_.eps);
+            acc[i] = acc[i] * cfg_.g_square_momentum + grad[i] * grad[i];
+          }
+        }
+        break;
+      }
+      case OptimizerConfig::kAdam: {
+        float* emb = entry;
+        float* m = entry + dim;
+        float* v = entry + 2 * dim;
+        for (uint32_t i = 0; i < dim; ++i) {
+          m[i] = cfg_.beta1 * m[i] + (1.0f - cfg_.beta1) * grad[i];
+          v[i] = cfg_.beta2 * v[i] + (1.0f - cfg_.beta2) * grad[i] * grad[i];
+          float m_hat = m[i] / (1.0f - b1p);
+          float v_hat = v[i] / (1.0f - b2p);
+          emb[i] -= cfg_.lr * m_hat / (cfg_.eps + std::sqrt(v_hat));
+        }
+        break;
+      }
+    }
+  }
+
+  const OptimizerConfig& config() const { return cfg_; }
+
+ private:
+  OptimizerConfig cfg_;
+  std::unordered_map<uint64_t, std::pair<float, float>> accum_;
+  std::mutex accum_mu_;
+};
+
+inline void weight_bound_clamp(float* emb, uint32_t dim, float bound) {
+  for (uint32_t i = 0; i < dim; ++i) {
+    if (emb[i] > bound) emb[i] = bound;
+    if (emb[i] < -bound) emb[i] = -bound;
+  }
+}
+
+}  // namespace persia

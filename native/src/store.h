@@ -1,0 +1,288 @@
+// Sharded LRU embedding store — the C++ twin of persia_tpu/ps/store.py.
+//
+// Architecture follows the reference's persia-embedding-holder:
+// num_internal_shards independently-locked shards
+// (persia-embedding-holder/src/lib.rs:28-101), each an LRU map
+// (eviction_map.rs) of sign -> [emb | optimizer state] float vectors
+// (emb_entry.rs). Lookup/update semantics match
+// embedding_parameter_service/mod.rs:162-262 and :359-427.
+//
+// Serialization: PSD1 layout, byte-identical with EmbeddingHolder.dump_bytes.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <list>
+#include <memory>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "hashrng.h"
+#include "optim.h"
+
+namespace persia {
+
+struct Entry {
+  uint64_t sign;
+  uint32_t dim;
+  std::vector<float> vec;  // [emb | opt state]
+};
+
+// LRU map: hashmap + recency list (least-recent at front).
+class EvictionMap {
+ public:
+  explicit EvictionMap(uint64_t capacity) : capacity_(capacity) {}
+
+  Entry* get(uint64_t sign) {
+    auto it = map_.find(sign);
+    return it == map_.end() ? nullptr : &*it->second;
+  }
+
+  Entry* get_refresh(uint64_t sign) {
+    auto it = map_.find(sign);
+    if (it == map_.end()) return nullptr;
+    list_.splice(list_.end(), list_, it->second);
+    return &*it->second;
+  }
+
+  // Returns true if an older entry was evicted.
+  bool insert(uint64_t sign, uint32_t dim, std::vector<float> vec) {
+    auto it = map_.find(sign);
+    if (it != map_.end()) {
+      list_.erase(it->second);
+      map_.erase(it);
+    }
+    list_.push_back(Entry{sign, dim, std::move(vec)});
+    map_[sign] = std::prev(list_.end());
+    if (list_.size() > capacity_) {
+      map_.erase(list_.front().sign);
+      list_.pop_front();
+      return true;
+    }
+    return false;
+  }
+
+  void clear() {
+    map_.clear();
+    list_.clear();
+  }
+
+  uint64_t size() const { return list_.size(); }
+
+  template <typename F>
+  void for_each_lru(F&& f) const {
+    for (const auto& e : list_) f(e);
+  }
+
+ private:
+  uint64_t capacity_;
+  std::list<Entry> list_;
+  std::unordered_map<uint64_t, std::list<Entry>::iterator> map_;
+};
+
+class Store {
+ public:
+  Store(uint64_t capacity, uint32_t num_shards)
+      : num_shards_(num_shards == 0 ? 1 : num_shards) {
+    uint64_t per_shard = capacity / num_shards_;
+    if (per_shard == 0) per_shard = 1;
+    for (uint32_t i = 0; i < num_shards_; ++i) {
+      shards_.emplace_back(new EvictionMap(per_shard));
+      locks_.emplace_back(new std::mutex());
+    }
+  }
+
+  void configure(int method, const InitParams& params, float admit_probability,
+                 float weight_bound, bool enable_weight_bound) {
+    init_method_ = method;
+    init_params_ = params;
+    admit_probability_ = admit_probability;
+    weight_bound_ = weight_bound;
+    enable_weight_bound_ = enable_weight_bound;
+    configured_ = true;
+  }
+
+  bool register_optimizer(const std::string& wire) {
+    OptimizerConfig cfg;
+    if (!OptimizerConfig::parse(wire, &cfg)) return false;
+    optimizer_.reset(new Optimizer(cfg));
+    return true;
+  }
+
+  bool has_optimizer() const { return optimizer_ != nullptr; }
+
+  // Batched lookup: out must hold n*dim floats. Returns 0 on success.
+  int lookup(const uint64_t* signs, uint64_t n, uint32_t dim, bool training,
+             float* out) {
+    if (training && (!optimizer_ || !configured_)) return -1;
+    uint64_t misses = 0;
+    for (uint64_t i = 0; i < n; ++i) {
+      uint64_t sign = signs[i];
+      float* dst = out + i * dim;
+      uint32_t s = internal_shard_of(sign, num_shards_);
+      std::lock_guard<std::mutex> lk(*locks_[s]);
+      if (training) {
+        Entry* e = shards_[s]->get_refresh(sign);
+        if (e != nullptr && e->dim == dim) {
+          std::memcpy(dst, e->vec.data(), sizeof(float) * dim);
+        } else if (e == nullptr && !admit(sign, admit_probability_)) {
+          std::memset(dst, 0, sizeof(float) * dim);
+          ++misses;
+        } else {
+          // miss (admitted) or dim mismatch: (re-)initialize
+          uint32_t space = optimizer_->require_space(dim);
+          std::vector<float> vec(dim + space);
+          init_entry(sign, dim, init_method_, init_params_, vec.data());
+          optimizer_->state_initialization(vec.data(), dim);
+          std::memcpy(dst, vec.data(), sizeof(float) * dim);
+          shards_[s]->insert(sign, dim, std::move(vec));
+          ++misses;
+        }
+      } else {
+        Entry* e = shards_[s]->get(sign);
+        if (e != nullptr && e->dim == dim) {
+          std::memcpy(dst, e->vec.data(), sizeof(float) * dim);
+        } else {
+          std::memset(dst, 0, sizeof(float) * dim);
+          ++misses;
+        }
+      }
+    }
+    index_miss_count_ += misses;
+    return 0;
+  }
+
+  // Batched gradient update; grads is n*dim. Returns 0 on success.
+  int update(const uint64_t* signs, uint64_t n, uint32_t dim,
+             const float* grads) {
+    if (!optimizer_) return -1;
+    std::vector<float> b1p, b2p;
+    optimizer_->batch_level_state(signs, n, &b1p, &b2p);
+    uint64_t misses = 0;
+    for (uint64_t i = 0; i < n; ++i) {
+      uint64_t sign = signs[i];
+      uint32_t s = internal_shard_of(sign, num_shards_);
+      std::lock_guard<std::mutex> lk(*locks_[s]);
+      Entry* e = shards_[s]->get(sign);
+      if (e == nullptr || e->dim != dim) {
+        ++misses;
+        continue;
+      }
+      float bp1 = b1p.empty() ? 0.0f : b1p[i];
+      float bp2 = b2p.empty() ? 0.0f : b2p[i];
+      optimizer_->update(e->vec.data(), grads + i * dim, dim, bp1, bp2);
+      if (enable_weight_bound_)
+        weight_bound_clamp(e->vec.data(), dim, weight_bound_);
+    }
+    gradient_id_miss_count_ += misses;
+    return 0;
+  }
+
+  // Debug / checkpoint access -------------------------------------------
+
+  int64_t get_entry(uint64_t sign, float* out, uint32_t maxlen,
+                    uint32_t* dim_out) {
+    uint32_t s = internal_shard_of(sign, num_shards_);
+    std::lock_guard<std::mutex> lk(*locks_[s]);
+    Entry* e = shards_[s]->get(sign);
+    if (e == nullptr) return -1;
+    if (dim_out) *dim_out = e->dim;
+    uint32_t len = static_cast<uint32_t>(e->vec.size());
+    if (out != nullptr && maxlen >= len)
+      std::memcpy(out, e->vec.data(), sizeof(float) * len);
+    return len;
+  }
+
+  int set_entry(uint64_t sign, uint32_t dim, const float* vec, uint32_t len) {
+    uint32_t s = internal_shard_of(sign, num_shards_);
+    std::lock_guard<std::mutex> lk(*locks_[s]);
+    shards_[s]->insert(sign, dim, std::vector<float>(vec, vec + len));
+    return 0;
+  }
+
+  void clear() {
+    for (uint32_t i = 0; i < num_shards_; ++i) {
+      std::lock_guard<std::mutex> lk(*locks_[i]);
+      shards_[i]->clear();
+    }
+  }
+
+  uint64_t size() const {
+    uint64_t total = 0;
+    for (const auto& s : shards_) total += s->size();
+    return total;
+  }
+
+  uint64_t index_miss_count() const { return index_miss_count_.load(); }
+  uint64_t gradient_id_miss_count() const {
+    return gradient_id_miss_count_.load();
+  }
+
+  // PSD1 serialization ---------------------------------------------------
+
+  bool dump_file(const char* path) {
+    FILE* f = std::fopen(path, "wb");
+    if (!f) return false;
+    bool ok = std::fwrite("PSD1", 1, 4, f) == 4;
+    uint32_t version = 1;
+    uint64_t count = size();
+    ok = ok && std::fwrite(&version, 4, 1, f) == 1;
+    ok = ok && std::fwrite(&count, 8, 1, f) == 1;
+    for (uint32_t i = 0; ok && i < num_shards_; ++i) {
+      std::lock_guard<std::mutex> lk(*locks_[i]);
+      shards_[i]->for_each_lru([&](const Entry& e) {
+        uint32_t len = static_cast<uint32_t>(e.vec.size());
+        ok = ok && std::fwrite(&e.sign, 8, 1, f) == 1;
+        ok = ok && std::fwrite(&e.dim, 4, 1, f) == 1;
+        ok = ok && std::fwrite(&len, 4, 1, f) == 1;
+        ok = ok && std::fwrite(e.vec.data(), sizeof(float), len, f) == len;
+      });
+    }
+    std::fclose(f);
+    return ok;
+  }
+
+  bool load_file(const char* path, bool clear_first) {
+    FILE* f = std::fopen(path, "rb");
+    if (!f) return false;
+    char magic[4];
+    uint32_t version = 0;
+    uint64_t count = 0;
+    bool ok = std::fread(magic, 1, 4, f) == 4 &&
+              std::memcmp(magic, "PSD1", 4) == 0 &&
+              std::fread(&version, 4, 1, f) == 1 && version == 1 &&
+              std::fread(&count, 8, 1, f) == 1;
+    if (ok && clear_first) clear();
+    for (uint64_t i = 0; ok && i < count; ++i) {
+      uint64_t sign;
+      uint32_t dim, len;
+      ok = std::fread(&sign, 8, 1, f) == 1 && std::fread(&dim, 4, 1, f) == 1 &&
+           std::fread(&len, 4, 1, f) == 1;
+      if (!ok) break;
+      std::vector<float> vec(len);
+      ok = std::fread(vec.data(), sizeof(float), len, f) == len;
+      if (ok) set_entry(sign, dim, vec.data(), len);
+    }
+    std::fclose(f);
+    return ok;
+  }
+
+ private:
+  uint32_t num_shards_;
+  std::vector<std::unique_ptr<EvictionMap>> shards_;
+  std::vector<std::unique_ptr<std::mutex>> locks_;
+  std::unique_ptr<Optimizer> optimizer_;
+  int init_method_ = kBoundedUniform;
+  InitParams init_params_;
+  float admit_probability_ = 1.0f;
+  float weight_bound_ = 10.0f;
+  bool enable_weight_bound_ = true;
+  bool configured_ = false;
+  std::atomic<uint64_t> index_miss_count_{0};
+  std::atomic<uint64_t> gradient_id_miss_count_{0};
+};
+
+}  // namespace persia

@@ -38,6 +38,8 @@ class InitializationMethod(Enum):
     """Embedding entry initialization (reference: lib.rs:26-97)."""
 
     BOUNDED_UNIFORM = "bounded_uniform"
+    BOUNDED_GAMMA = "bounded_gamma"
+    BOUNDED_POISSON = "bounded_poisson"
     NORMAL = "normal"
     TRUNCATED_NORMAL = "truncated_normal"
     ZERO = "zero"
@@ -50,6 +52,22 @@ class InitializationConfig:
     upper: float = 0.01
     mean: float = 0.0
     standard_deviation: float = 0.01
+    # gamma params (reference: BoundedGamma, lib.rs:56-68)
+    shape: float = 1.0
+    scale: float = 1.0
+    # poisson param (reference: BoundedPoisson, lib.rs:70-79)
+    lam: float = 1.0
+
+    def to_params(self) -> dict:
+        return {
+            "lower": self.lower,
+            "upper": self.upper,
+            "mean": self.mean,
+            "standard_deviation": self.standard_deviation,
+            "shape": self.shape,
+            "scale": self.scale,
+            "lambda": self.lam,
+        }
 
 
 @dataclass
@@ -194,6 +212,9 @@ class EmbeddingSchema:
             upper=float(init_raw.get("upper", 0.01)),
             mean=float(init_raw.get("mean", 0.0)),
             standard_deviation=float(init_raw.get("standard_deviation", 0.01)),
+            shape=float(init_raw.get("shape", 1.0)),
+            scale=float(init_raw.get("scale", 1.0)),
+            lam=float(init_raw.get("lambda", 1.0)),
         )
         return cls(
             slots_config=slots,

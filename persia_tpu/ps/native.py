@@ -1,0 +1,254 @@
+"""ctypes binding to the C++ embedding store (native/build/libpersia_native.so).
+
+``NativeEmbeddingHolder`` exposes the same interface as the pure-Python
+:class:`persia_tpu.ps.store.EmbeddingHolder`; semantics and serialization
+(PSD1) are identical, and the deterministic init RNG is bit-compatible, so
+the two are interchangeable (tests/test_native_parity.py enforces this).
+Use :func:`make_holder` to get the fastest available backend.
+"""
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Tuple
+
+import numpy as np
+
+from persia_tpu.logger import get_default_logger
+
+_logger = get_default_logger(__name__)
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_LIB_CANDIDATES = [
+    os.path.join(_REPO_ROOT, "native", "build", "libpersia_native.so"),
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "native_bin",
+                 "libpersia_native.so"),
+]
+
+_INIT_METHOD_CODES = {
+    "bounded_uniform": 0,
+    "bounded_gamma": 1,
+    "bounded_poisson": 2,
+    "normal": 3,
+    "truncated_normal": 4,
+    "zero": 5,
+}
+
+_lib = None
+
+
+def _build_native() -> bool:
+    makefile = os.path.join(_REPO_ROOT, "native", "Makefile")
+    if not os.path.exists(makefile):
+        return False
+    try:
+        subprocess.run(
+            ["make", "-C", os.path.join(_REPO_ROOT, "native"), "-j", "8"],
+            check=True, capture_output=True,
+        )
+        return True
+    except (subprocess.CalledProcessError, OSError) as e:
+        _logger.warning("native build failed: %s", e)
+        return False
+
+
+def load_native_lib(build_if_missing: bool = True) -> Optional[ctypes.CDLL]:
+    """Load (building on demand) the native library; None if unavailable."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    path = next((p for p in _LIB_CANDIDATES if os.path.exists(p)), None)
+    if path is None and build_if_missing and _build_native():
+        path = next((p for p in _LIB_CANDIDATES if os.path.exists(p)), None)
+    if path is None:
+        return None
+    lib = ctypes.CDLL(path)
+    u64, u32, i32, i64 = (ctypes.c_uint64, ctypes.c_uint32, ctypes.c_int,
+                          ctypes.c_int64)
+    fptr = ctypes.c_float
+    lib.ptps_new.restype = ctypes.c_void_p
+    lib.ptps_new.argtypes = [u64, u32]
+    lib.ptps_free.argtypes = [ctypes.c_void_p]
+    lib.ptps_configure.argtypes = [
+        ctypes.c_void_p, i32, ctypes.POINTER(ctypes.c_double), fptr, fptr, i32]
+    lib.ptps_register_optimizer.restype = i32
+    lib.ptps_register_optimizer.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.ptps_lookup.restype = i32
+    lib.ptps_lookup.argtypes = [ctypes.c_void_p, ctypes.POINTER(u64), u64, u32,
+                                i32, ctypes.POINTER(fptr)]
+    lib.ptps_update.restype = i32
+    lib.ptps_update.argtypes = [ctypes.c_void_p, ctypes.POINTER(u64), u64, u32,
+                                ctypes.POINTER(fptr)]
+    lib.ptps_len.restype = u64
+    lib.ptps_len.argtypes = [ctypes.c_void_p]
+    lib.ptps_clear.argtypes = [ctypes.c_void_p]
+    lib.ptps_index_miss_count.restype = u64
+    lib.ptps_index_miss_count.argtypes = [ctypes.c_void_p]
+    lib.ptps_gradient_id_miss_count.restype = u64
+    lib.ptps_gradient_id_miss_count.argtypes = [ctypes.c_void_p]
+    lib.ptps_get_entry.restype = i64
+    lib.ptps_get_entry.argtypes = [ctypes.c_void_p, u64, ctypes.POINTER(fptr),
+                                   u32, ctypes.POINTER(u32)]
+    lib.ptps_set_entry.restype = i32
+    lib.ptps_set_entry.argtypes = [ctypes.c_void_p, u64, u32,
+                                   ctypes.POINTER(fptr), u32]
+    lib.ptps_dump.restype = i32
+    lib.ptps_dump.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.ptps_load.restype = i32
+    lib.ptps_load.argtypes = [ctypes.c_void_p, ctypes.c_char_p, i32]
+    lib.ptps_farmhash64.restype = u64
+    lib.ptps_farmhash64.argtypes = [u64]
+    lib.ptps_farmhash64_batch.argtypes = [ctypes.POINTER(u64), u64,
+                                          ctypes.POINTER(u64)]
+    lib.ptps_init_entry.argtypes = [u64, u32, i32,
+                                    ctypes.POINTER(ctypes.c_double),
+                                    ctypes.POINTER(fptr)]
+    _lib = lib
+    return lib
+
+
+def _f32_ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def _u64_ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
+
+
+def _params_array(params: dict):
+    vals = [params.get("lower", -0.01), params.get("upper", 0.01),
+            params.get("mean", 0.0), params.get("standard_deviation", 0.01),
+            params.get("shape", 1.0), params.get("scale", 1.0),
+            params.get("lambda", 1.0)]
+    return (ctypes.c_double * 7)(*vals)
+
+
+def optimizer_config_to_wire(config: dict, feature_index_prefix_bit: int = 0) -> str:
+    """Serialize an optimizer config dict to the native wire string
+    (parsed by OptimizerConfig::parse in native/src/optim.h)."""
+    kind = config["type"]
+    if kind == "sgd":
+        return f"sgd {config['lr']} {config.get('wd', 0.0)}"
+    if kind == "adagrad":
+        return (
+            f"adagrad {config.get('lr', 1e-2)} {config.get('wd', 0.0)} "
+            f"{config.get('g_square_momentum', 1.0)} "
+            f"{config.get('initialization', 1e-2)} {config.get('eps', 1e-10)} "
+            f"{1 if config.get('vectorwise_shared', False) else 0}"
+        )
+    if kind == "adam":
+        return (
+            f"adam {config.get('lr', 1e-3)} {config.get('beta1', 0.9)} "
+            f"{config.get('beta2', 0.999)} {config.get('eps', 1e-8)} "
+            f"{feature_index_prefix_bit}"
+        )
+    raise ValueError(f"unknown optimizer type {kind!r}")
+
+
+class NativeEmbeddingHolder:
+    """Drop-in replacement for :class:`persia_tpu.ps.store.EmbeddingHolder`
+    backed by the C++ store."""
+
+    def __init__(self, capacity: int = 1_000_000_000, num_internal_shards: int = 8):
+        lib = load_native_lib()
+        if lib is None:
+            raise RuntimeError(
+                "native library not available; run `make -C native` or use "
+                "persia_tpu.ps.store.EmbeddingHolder"
+            )
+        self._lib = lib
+        self._h = lib.ptps_new(capacity, num_internal_shards)
+        self.capacity = capacity
+        self.num_internal_shards = num_internal_shards
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.ptps_free(h)
+            self._h = None
+
+    def configure(self, init_method: str, init_params: dict,
+                  admit_probability: float = 1.0, weight_bound: float = 10.0,
+                  enable_weight_bound: bool = True):
+        self._lib.ptps_configure(
+            self._h, _INIT_METHOD_CODES[init_method], _params_array(init_params),
+            admit_probability, weight_bound, 1 if enable_weight_bound else 0,
+        )
+
+    def register_optimizer(self, config: dict, feature_index_prefix_bit: int = 0):
+        wire = optimizer_config_to_wire(config, feature_index_prefix_bit)
+        if self._lib.ptps_register_optimizer(self._h, wire.encode()) != 0:
+            raise ValueError(f"native optimizer rejected config {config}")
+
+    def lookup(self, signs: np.ndarray, dim: int, training: bool) -> np.ndarray:
+        signs = np.ascontiguousarray(signs, dtype=np.uint64)
+        out = np.empty((len(signs), dim), dtype=np.float32)
+        if len(signs) == 0:
+            return out
+        rc = self._lib.ptps_lookup(self._h, _u64_ptr(signs), len(signs), dim,
+                                   1 if training else 0, _f32_ptr(out))
+        if rc != 0:
+            raise RuntimeError(
+                "native lookup failed (optimizer not registered or store "
+                "not configured)"
+            )
+        return out
+
+    def update_gradients(self, signs: np.ndarray, grads: np.ndarray, dim: int):
+        signs = np.ascontiguousarray(signs, dtype=np.uint64)
+        grads = np.ascontiguousarray(grads, dtype=np.float32)
+        if len(signs) == 0:
+            return
+        rc = self._lib.ptps_update(self._h, _u64_ptr(signs), len(signs), dim,
+                                   _f32_ptr(grads))
+        if rc != 0:
+            raise RuntimeError("native update failed (optimizer not registered)")
+
+    def get_entry(self, sign: int) -> Optional[Tuple[int, np.ndarray]]:
+        dim_out = ctypes.c_uint32(0)
+        length = self._lib.ptps_get_entry(self._h, sign, None, 0,
+                                          ctypes.byref(dim_out))
+        if length < 0:
+            return None
+        buf = np.empty(length, dtype=np.float32)
+        self._lib.ptps_get_entry(self._h, sign, _f32_ptr(buf), length,
+                                 ctypes.byref(dim_out))
+        return int(dim_out.value), buf
+
+    def set_entry(self, sign: int, dim: int, vec: np.ndarray):
+        vec = np.ascontiguousarray(vec, dtype=np.float32)
+        self._lib.ptps_set_entry(self._h, sign, dim, _f32_ptr(vec), len(vec))
+
+    def clear(self):
+        self._lib.ptps_clear(self._h)
+
+    def __len__(self) -> int:
+        return int(self._lib.ptps_len(self._h))
+
+    @property
+    def index_miss_count(self) -> int:
+        return int(self._lib.ptps_index_miss_count(self._h))
+
+    @property
+    def gradient_id_miss_count(self) -> int:
+        return int(self._lib.ptps_gradient_id_miss_count(self._h))
+
+    def dump_file(self, path: str):
+        if self._lib.ptps_dump(self._h, path.encode()) != 0:
+            raise IOError(f"native dump to {path} failed")
+
+    def load_file(self, path: str, clear: bool = True):
+        if self._lib.ptps_load(self._h, path.encode(), 1 if clear else 0) != 0:
+            raise IOError(f"native load from {path} failed")
+
+
+def make_holder(capacity: int, num_internal_shards: int, prefer_native: bool = True):
+    """Fastest available holder: native C++ store, else the numpy one."""
+    if prefer_native and os.environ.get("PERSIA_FORCE_PYTHON_PS") != "1":
+        try:
+            return NativeEmbeddingHolder(capacity, num_internal_shards)
+        except RuntimeError:
+            _logger.warning("native store unavailable; using numpy holder")
+    from persia_tpu.ps.store import EmbeddingHolder
+
+    return EmbeddingHolder(capacity, num_internal_shards)

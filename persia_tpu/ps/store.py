@@ -1,0 +1,309 @@
+"""The embedding parameter store: sharded LRU map of embedding entries.
+
+This is the Python (numpy) implementation of the storage tier the reference
+builds in Rust (persia-embedding-holder + the lookup/update paths of
+embedding_parameter_service/mod.rs:162-262, :359-427). A C++ backend with
+identical semantics lives in ``native/`` and is selected automatically when
+built (see :mod:`persia_tpu.ps.native`).
+
+Semantics kept from the reference:
+
+- **LRU eviction at capacity** per store (eviction_map.rs:11-111): training
+  lookups refresh recency; inserting at capacity evicts the least recently
+  used entry.
+- **Entry layout** ``[embedding | optimizer state]`` in one f32 vector
+  (emb_entry.rs:17-158), with per-entry dim.
+- **Training lookup** (mod.rs:186-230): miss → admission-gated seeded init +
+  optimizer state init + insert; non-admitted miss reads zeros and leaves no
+  entry; dim-mismatch hit is re-initialized.
+- **Eval lookup** (mod.rs:232-250): read-only, zeros on miss.
+- **Gradient update** (mod.rs:359-427): per-sign optimizer step + optional
+  weight-bound clamp; missing signs are skipped (counted).
+
+TPU-first deviations:
+
+- Lookups/updates are **batched per dim** (the worker groups signs by slot
+  dim), so the hot path is vectorized numpy / a single C++ call rather than
+  a per-sign virtual dispatch.
+- Admission decisions are deterministic per sign (rng.py ADMIT_SALT) rather
+  than drawn from a thread-local RNG.
+"""
+
+import struct
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from persia_tpu.ps.optim import SparseOptimizer, apply_weight_bound
+from persia_tpu.ps.rng import admit_mask, initialize_entries, internal_shard_of
+
+DUMP_MAGIC = b"PSD1"
+
+
+class EvictionMap:
+    """Insertion/recency-ordered map with LRU eviction at capacity.
+
+    Mirrors eviction_map.rs semantics on top of an OrderedDict (which is
+    exactly a hashmap + doubly-linked list, the same structure the
+    reference builds from a hashmap + ArrayLinkedList).
+    Values are ``(dim, vec)`` with ``vec = [emb | opt_state]`` float32.
+    """
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self._map: "OrderedDict[int, Tuple[int, np.ndarray]]" = OrderedDict()
+
+    def get(self, sign: int) -> Optional[Tuple[int, np.ndarray]]:
+        return self._map.get(sign)
+
+    def get_refresh(self, sign: int) -> Optional[Tuple[int, np.ndarray]]:
+        v = self._map.get(sign)
+        if v is not None:
+            self._map.move_to_end(sign)
+        return v
+
+    def insert(self, sign: int, dim: int, vec: np.ndarray) -> Optional[int]:
+        """Insert/replace; returns the evicted sign if capacity overflowed."""
+        if sign in self._map:
+            del self._map[sign]
+        self._map[sign] = (dim, vec)
+        if len(self._map) > self.capacity:
+            evicted_sign, _ = self._map.popitem(last=False)
+            return evicted_sign
+        return None
+
+    def items_in_lru_order(self):
+        return self._map.items()
+
+    def clear(self):
+        self._map.clear()
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    def __contains__(self, sign: int) -> bool:
+        return sign in self._map
+
+
+class EmbeddingHolder:
+    """Sharded LRU store + inline sparse optimizer application.
+
+    One process-level PS replica owns one holder; ``num_internal_shards``
+    independently-locked shards bound lock contention
+    (reference: persia-embedding-holder/src/lib.rs:28-101).
+    """
+
+    def __init__(self, capacity: int = 1_000_000_000, num_internal_shards: int = 8):
+        if num_internal_shards <= 0:
+            raise ValueError("num_internal_shards must be positive")
+        self.capacity = capacity
+        self.num_internal_shards = num_internal_shards
+        per_shard = max(1, capacity // num_internal_shards)
+        self._shards = [EvictionMap(per_shard) for _ in range(num_internal_shards)]
+        self._locks = [threading.Lock() for _ in range(num_internal_shards)]
+        self.optimizer: Optional[SparseOptimizer] = None
+        # hyperparameters (configure(), reference mod.rs:429-451)
+        self.init_method: str = "bounded_uniform"
+        self.init_params: dict = {"lower": -0.01, "upper": 0.01}
+        self.admit_probability: float = 1.0
+        self.weight_bound: float = 10.0
+        self.enable_weight_bound: bool = True
+        self.configured = False
+        # metrics
+        self.index_miss_count = 0
+        self.gradient_id_miss_count = 0
+
+    # --- control plane -------------------------------------------------
+
+    def configure(
+        self,
+        init_method: str,
+        init_params: dict,
+        admit_probability: float = 1.0,
+        weight_bound: float = 10.0,
+        enable_weight_bound: bool = True,
+    ):
+        self.init_method = init_method
+        self.init_params = dict(init_params)
+        self.admit_probability = admit_probability
+        self.weight_bound = weight_bound
+        self.enable_weight_bound = enable_weight_bound
+        self.configured = True
+
+    def register_optimizer(self, config: dict, feature_index_prefix_bit: int = 0):
+        self.optimizer = SparseOptimizer.from_config(
+            config, feature_index_prefix_bit=feature_index_prefix_bit
+        )
+
+    # --- data plane -----------------------------------------------------
+
+    def lookup(self, signs: np.ndarray, dim: int, training: bool) -> np.ndarray:
+        """Batched lookup of ``len(signs)`` embeddings of width ``dim``.
+
+        Returns an (n, dim) float32 matrix. Signs within the batch should be
+        distinct (the worker dedups before calling); duplicate signs still
+        work but pay the miss-path twice.
+        """
+        signs = np.ascontiguousarray(signs, dtype=np.uint64)
+        n = len(signs)
+        out = np.zeros((n, dim), dtype=np.float32)
+        if n == 0:
+            return out
+        if training:
+            if self.optimizer is None:
+                raise RuntimeError("optimizer not registered on parameter server")
+            if not self.configured:
+                raise RuntimeError("parameter server not configured")
+        shard_ids = internal_shard_of(signs, self.num_internal_shards)
+        miss_positions: List[int] = []
+        mismatch_positions: List[int] = []
+        for shard_idx in np.unique(shard_ids):
+            sel = np.nonzero(shard_ids == shard_idx)[0]
+            shard = self._shards[shard_idx]
+            with self._locks[shard_idx]:
+                for pos in sel:
+                    sign = int(signs[pos])
+                    entry = (
+                        shard.get_refresh(sign) if training else shard.get(sign)
+                    )
+                    if entry is not None and entry[0] == dim:
+                        out[pos] = entry[1][:dim]
+                    elif not training:
+                        self.index_miss_count += 1
+                    elif entry is not None:
+                        # dim mismatch: re-initialize unconditionally
+                        # (reference mod.rs:213-228)
+                        mismatch_positions.append(pos)
+                    else:
+                        miss_positions.append(pos)
+        if training and (miss_positions or mismatch_positions):
+            self._admit_and_init(
+                signs, dim, np.array(miss_positions, dtype=np.int64),
+                np.array(mismatch_positions, dtype=np.int64), out, shard_ids,
+            )
+        return out
+
+    def _admit_and_init(self, signs, dim, miss_positions, forced_positions,
+                        out, shard_ids):
+        admitted = admit_mask(signs[miss_positions], self.admit_probability)
+        self.index_miss_count += int(admitted.sum())
+        adm_positions = np.concatenate(
+            [miss_positions[admitted], forced_positions]
+        ).astype(np.int64)
+        if len(adm_positions) == 0:
+            return
+        adm_signs = signs[adm_positions]
+        embs = initialize_entries(adm_signs, dim, self.init_method, self.init_params)
+        space = self.optimizer.require_space(dim)
+        vecs = np.zeros((len(adm_signs), dim + space), dtype=np.float32)
+        vecs[:, :dim] = embs
+        if space:
+            self.optimizer.state_initialization(vecs, dim)
+        out[adm_positions] = embs
+        for i, pos in enumerate(adm_positions):
+            shard_idx = shard_ids[pos]
+            with self._locks[shard_idx]:
+                self._shards[shard_idx].insert(int(signs[pos]), dim, vecs[i])
+
+    def update_gradients(self, signs: np.ndarray, grads: np.ndarray, dim: int):
+        """Batched optimizer step for ``signs`` with grads (n, dim)."""
+        if self.optimizer is None:
+            raise RuntimeError("optimizer not registered on parameter server")
+        signs = np.ascontiguousarray(signs, dtype=np.uint64)
+        n = len(signs)
+        if n == 0:
+            return
+        batch_state = self.optimizer.batch_level_state(signs)
+        shard_ids = internal_shard_of(signs, self.num_internal_shards)
+        # gather present entries into a matrix, vector-update, scatter back
+        space = self.optimizer.require_space(dim)
+        width = dim + space
+        found_pos: List[int] = []
+        found_entries: List[np.ndarray] = []
+        for shard_idx in np.unique(shard_ids):
+            sel = np.nonzero(shard_ids == shard_idx)[0]
+            shard = self._shards[shard_idx]
+            with self._locks[shard_idx]:
+                for pos in sel:
+                    entry = shard.get(int(signs[pos]))
+                    if entry is not None and entry[0] == dim:
+                        found_pos.append(pos)
+                        found_entries.append(entry[1])
+                    else:
+                        self.gradient_id_miss_count += 1
+        if not found_pos:
+            return
+        order = np.argsort(found_pos)  # keep batch order for Adam state rows
+        found_pos = [found_pos[i] for i in order]
+        found_entries = [found_entries[i] for i in order]
+        mat = np.stack(found_entries).astype(np.float32, copy=False)
+        assert mat.shape[1] == width
+        sub_state = batch_state[np.array(found_pos)] if batch_state is not None else None
+        self.optimizer.update(mat, grads[np.array(found_pos)], dim, sub_state)
+        if self.enable_weight_bound:
+            apply_weight_bound(mat[:, :dim], self.weight_bound)
+        for row, vec in zip(mat, found_entries):
+            vec[:] = row  # write back in place (vec is the stored buffer)
+
+    # --- debug / checkpoint --------------------------------------------
+
+    def get_entry(self, sign: int) -> Optional[Tuple[int, np.ndarray]]:
+        shard_idx = int(internal_shard_of(np.array([sign], dtype=np.uint64),
+                                          self.num_internal_shards)[0])
+        with self._locks[shard_idx]:
+            return self._shards[shard_idx].get(sign)
+
+    def set_entry(self, sign: int, dim: int, vec: np.ndarray):
+        shard_idx = int(internal_shard_of(np.array([sign], dtype=np.uint64),
+                                          self.num_internal_shards)[0])
+        with self._locks[shard_idx]:
+            self._shards[shard_idx].insert(
+                sign, dim, np.ascontiguousarray(vec, dtype=np.float32)
+            )
+
+    def clear(self):
+        for lock, shard in zip(self._locks, self._shards):
+            with lock:
+                shard.clear()
+
+    def __len__(self) -> int:
+        return sum(len(s) for s in self._shards)
+
+    # --- serialization (PSD1, shared with native/src/store.h) -----------
+
+    def dump_bytes(self) -> bytes:
+        """Serialize all entries (LRU order per shard) to the PSD1 layout."""
+        out = [DUMP_MAGIC, struct.pack("<IQ", 1, len(self))]
+        for lock, shard in zip(self._locks, self._shards):
+            with lock:
+                for sign, (dim, vec) in shard.items_in_lru_order():
+                    out.append(struct.pack("<QII", sign, dim, len(vec)))
+                    out.append(np.ascontiguousarray(vec, dtype=np.float32).tobytes())
+        return b"".join(out)
+
+    def load_bytes(self, buf: bytes, clear: bool = True):
+        view = memoryview(buf)
+        if bytes(view[:4]) != DUMP_MAGIC:
+            raise ValueError("bad PSD1 magic")
+        version, count = struct.unpack_from("<IQ", view, 4)
+        if version != 1:
+            raise ValueError(f"unsupported PSD1 version {version}")
+        if clear:
+            self.clear()
+        pos = 4 + struct.calcsize("<IQ")
+        for _ in range(count):
+            sign, dim, total = struct.unpack_from("<QII", view, pos)
+            pos += struct.calcsize("<QII")
+            vec = np.frombuffer(view, dtype=np.float32, count=total, offset=pos).copy()
+            pos += 4 * total
+            self.set_entry(sign, dim, vec)
+
+    def dump_file(self, path: str):
+        with open(path, "wb") as f:
+            f.write(self.dump_bytes())
+
+    def load_file(self, path: str, clear: bool = True):
+        with open(path, "rb") as f:
+            self.load_bytes(f.read(), clear=clear)

@@ -1,0 +1,139 @@
+"""Parity tests: the C++ store must behave identically to the numpy store.
+
+The deterministic init RNG spec (ps/rng.py = native/src/hashrng.h) makes
+bit-identical initialization possible; optimizer math may differ by f32
+rounding order, so updates compare with a tight tolerance.
+"""
+
+import numpy as np
+import pytest
+
+from persia_tpu.ps.store import EmbeddingHolder
+
+native = pytest.importorskip("persia_tpu.ps.native")
+
+if native.load_native_lib() is None:
+    pytest.skip("native library unavailable", allow_module_level=True)
+
+from persia_tpu.ps.native import NativeEmbeddingHolder
+
+
+def _pair(optimizer=None, admit=1.0, init=("bounded_uniform", {"lower": -0.1, "upper": 0.1})):
+    optimizer = optimizer or {"type": "sgd", "lr": 0.1, "wd": 0.0}
+    holders = []
+    for cls in (EmbeddingHolder, NativeEmbeddingHolder):
+        h = cls(capacity=10_000, num_internal_shards=4)
+        h.configure(init[0], init[1], admit_probability=admit, weight_bound=10.0)
+        h.register_optimizer(optimizer)
+        holders.append(h)
+    return holders
+
+
+def test_farmhash_parity():
+    import ctypes
+
+    from persia_tpu.hashing import farmhash64_np
+
+    lib = native.load_native_lib()
+    signs = np.random.default_rng(1).integers(0, 2**63, 1000, dtype=np.uint64)
+    out = np.empty_like(signs)
+    lib.ptps_farmhash64_batch(
+        signs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), len(signs),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)))
+    np.testing.assert_array_equal(out, farmhash64_np(signs))
+
+
+@pytest.mark.parametrize("method,params", [
+    ("bounded_uniform", {"lower": -0.05, "upper": 0.05}),
+    ("normal", {"mean": 0.0, "standard_deviation": 0.02}),
+    ("bounded_gamma", {"shape": 2.0, "scale": 0.5}),
+    ("bounded_poisson", {"lambda": 3.0}),
+    ("zero", {}),
+])
+def test_init_parity(method, params):
+    py, cc = _pair(init=(method, params))
+    signs = np.random.default_rng(2).integers(0, 2**63, 64, dtype=np.uint64)
+    a = py.lookup(signs, dim=9, training=True)
+    b = cc.lookup(signs, dim=9, training=True)
+    if method in ("bounded_uniform", "zero", "bounded_poisson"):
+        np.testing.assert_array_equal(a, b)  # exact integer/linear math
+    else:
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+
+def test_admit_probability_parity():
+    py, cc = _pair(admit=0.3)
+    signs = np.arange(1, 5001, dtype=np.uint64)
+    py.lookup(signs, 2, True)
+    cc.lookup(signs, 2, True)
+    assert len(py) == len(cc)
+    # same signs admitted
+    for s in signs[:500]:
+        assert (py.get_entry(int(s)) is None) == (cc.get_entry(int(s)) is None)
+
+
+@pytest.mark.parametrize("optimizer", [
+    {"type": "sgd", "lr": 0.1, "wd": 0.01},
+    {"type": "adagrad", "lr": 0.01},
+    {"type": "adagrad", "lr": 0.01, "vectorwise_shared": True},
+    {"type": "adam", "lr": 0.001},
+])
+def test_train_loop_parity(optimizer):
+    py, cc = _pair(optimizer=optimizer)
+    rng = np.random.default_rng(3)
+    signs = rng.integers(0, 2**63, 32, dtype=np.uint64)
+    dim = 8
+    for step in range(5):
+        a = py.lookup(signs, dim, True)
+        b = cc.lookup(signs, dim, True)
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=1e-6,
+                                   err_msg=f"step {step} lookup diverged")
+        grads = rng.normal(size=(32, dim)).astype(np.float32)
+        py.update_gradients(signs, grads, dim)
+        cc.update_gradients(signs, grads.copy(), dim)
+    for s in signs:
+        pd, pv = py.get_entry(int(s))
+        cd, cv = cc.get_entry(int(s))
+        assert pd == cd
+        np.testing.assert_allclose(pv, cv, rtol=2e-5, atol=1e-6)
+
+
+def test_dump_format_cross_backend():
+    py, cc = _pair()
+    signs = np.array([10, 20, 30], dtype=np.uint64)
+    py.lookup(signs, 4, True)
+    cc.lookup(signs, 4, True)
+    import tempfile, os
+    with tempfile.TemporaryDirectory() as td:
+        py_path = os.path.join(td, "py.psd")
+        cc_path = os.path.join(td, "cc.psd")
+        py.dump_file(py_path)
+        cc.dump_file(cc_path)
+        # cross-load: python dump into native store and vice versa
+        cc2 = NativeEmbeddingHolder(100, 2)
+        cc2.configure("bounded_uniform", {"lower": -0.1, "upper": 0.1})
+        cc2.register_optimizer({"type": "sgd", "lr": 0.1})
+        cc2.load_file(py_path)
+        assert len(cc2) == 3
+        py2 = EmbeddingHolder(100, 2)
+        py2.load_file(cc_path)
+        assert len(py2) == 3
+        for s in signs:
+            np.testing.assert_array_equal(py2.get_entry(int(s))[1],
+                                          cc2.get_entry(int(s))[1])
+
+
+def test_native_lru_eviction():
+    cc = NativeEmbeddingHolder(capacity=8, num_internal_shards=2)
+    cc.configure("bounded_uniform", {"lower": -0.1, "upper": 0.1})
+    cc.register_optimizer({"type": "sgd", "lr": 0.1})
+    cc.lookup(np.arange(100, dtype=np.uint64), 2, True)
+    assert len(cc) == 8
+
+
+def test_native_update_missing_sign_counts():
+    _, cc = _pair()
+    cc.lookup(np.array([1], dtype=np.uint64), 4, True)
+    cc.update_gradients(np.array([1, 999], dtype=np.uint64),
+                        np.ones((2, 4), np.float32), 4)
+    assert cc.gradient_id_miss_count == 1

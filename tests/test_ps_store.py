@@ -1,0 +1,173 @@
+"""Tests for the embedding parameter store (LRU + lookup/update semantics).
+
+The eviction scenario mirrors the reference's EvictionMap test
+(persia-embedding-holder/src/eviction_map.rs:113-149).
+"""
+
+import numpy as np
+
+from persia_tpu.ps.store import EmbeddingHolder, EvictionMap
+
+
+def _entry(i):
+    return np.full(4, float(i), dtype=np.float32)
+
+
+def test_eviction_map_reference_scenario():
+    m = EvictionMap(capacity=5)
+    for i in range(5):
+        m.insert(i, 4, _entry(i))
+    assert len(m) == 5
+    for i in range(5, 10):
+        m.insert(i, 4, _entry(i))
+    assert len(m) == 5
+    assert m.get_refresh(4) is None
+    assert m.get_refresh(5) is not None  # refreshes 5 to most-recent
+    m.insert(10, 4, _entry(10))
+    assert len(m) == 5
+    assert m.get_refresh(6) is None  # 6 was LRU because 5 was refreshed
+    assert m.get_refresh(5) is not None
+
+
+def test_eviction_map_reinsert_moves_to_back():
+    m = EvictionMap(capacity=2)
+    m.insert(1, 4, _entry(1))
+    m.insert(2, 4, _entry(2))
+    m.insert(1, 4, _entry(11))  # re-insert refreshes
+    m.insert(3, 4, _entry(3))  # evicts 2
+    assert m.get(2) is None
+    assert m.get(1)[1][0] == 11.0
+
+
+def _holder(**kw):
+    h = EmbeddingHolder(capacity=kw.pop("capacity", 1000),
+                        num_internal_shards=kw.pop("num_internal_shards", 4))
+    h.configure(
+        init_method=kw.pop("init_method", "bounded_uniform"),
+        init_params=kw.pop("init_params", {"lower": -0.1, "upper": 0.1}),
+        admit_probability=kw.pop("admit_probability", 1.0),
+        weight_bound=kw.pop("weight_bound", 10.0),
+    )
+    h.register_optimizer(kw.pop("optimizer", {"type": "sgd", "lr": 0.1, "wd": 0.0}))
+    return h
+
+
+def test_training_lookup_is_deterministic_per_sign():
+    h = _holder()
+    signs = np.array([7, 42, 7777777], dtype=np.uint64)
+    first = h.lookup(signs, dim=8, training=True)
+    again = h.lookup(signs, dim=8, training=True)
+    np.testing.assert_array_equal(first, again)
+    h2 = _holder()
+    np.testing.assert_array_equal(h2.lookup(signs, 8, True), first)
+    assert len(h) == 3
+    assert (np.abs(first) <= 0.1).all()
+    assert not (first == 0).all()
+
+
+def test_eval_lookup_misses_read_zero_and_do_not_insert():
+    h = _holder()
+    signs = np.array([1, 2], dtype=np.uint64)
+    out = h.lookup(signs, dim=4, training=False)
+    np.testing.assert_array_equal(out, np.zeros((2, 4), np.float32))
+    assert len(h) == 0
+
+
+def test_admit_probability_zero_admits_nothing():
+    h = _holder(admit_probability=0.0)
+    out = h.lookup(np.array([5, 6], dtype=np.uint64), dim=4, training=True)
+    np.testing.assert_array_equal(out, np.zeros((2, 4), np.float32))
+    assert len(h) == 0
+
+
+def test_admit_probability_is_deterministic_fraction():
+    h = _holder(admit_probability=0.5)
+    signs = np.arange(1, 2001, dtype=np.uint64)
+    h.lookup(signs, dim=2, training=True)
+    frac = len(h) / len(signs)
+    assert 0.45 < frac < 0.55
+    # identical decision set on a fresh holder
+    h2 = _holder(admit_probability=0.5)
+    h2.lookup(signs, dim=2, training=True)
+    assert len(h2) == len(h)
+
+
+def test_sgd_update_moves_embedding():
+    h = _holder()
+    signs = np.array([3, 9], dtype=np.uint64)
+    before = h.lookup(signs, dim=4, training=True)
+    grads = np.ones((2, 4), dtype=np.float32)
+    h.update_gradients(signs, grads, dim=4)
+    after = h.lookup(signs, dim=4, training=True)
+    np.testing.assert_allclose(after, before - 0.1, rtol=1e-6)
+
+
+def test_update_skips_missing_signs():
+    h = _holder()
+    h.lookup(np.array([1], dtype=np.uint64), dim=4, training=True)
+    h.update_gradients(np.array([1, 999], dtype=np.uint64),
+                       np.ones((2, 4), np.float32), dim=4)
+    assert h.gradient_id_miss_count == 1
+
+
+def test_weight_bound_applied_on_update():
+    h = _holder(weight_bound=0.05)
+    signs = np.array([11], dtype=np.uint64)
+    h.lookup(signs, dim=4, training=True)
+    h.update_gradients(signs, np.full((1, 4), -100.0, np.float32), dim=4)
+    after = h.lookup(signs, dim=4, training=True)
+    assert (after <= 0.05).all()
+
+
+def test_lru_eviction_at_holder_capacity():
+    h = _holder(capacity=8, num_internal_shards=2)  # 4 per shard
+    signs = np.arange(100, dtype=np.uint64)
+    h.lookup(signs, dim=2, training=True)
+    assert len(h) == 8
+
+
+def test_adam_update_and_state_space():
+    h = _holder(optimizer={"type": "adam", "lr": 0.001})
+    signs = np.array([21], dtype=np.uint64)
+    h.lookup(signs, dim=4, training=True)
+    entry = h.get_entry(21)
+    assert entry[0] == 4 and len(entry[1]) == 12  # dim + 2*dim adam state
+    h.update_gradients(signs, np.ones((1, 4), np.float32), dim=4)
+    entry2 = h.get_entry(21)
+    assert not np.array_equal(entry2[1][4:], np.zeros(8))
+
+
+def test_dump_load_roundtrip():
+    h = _holder()
+    signs = np.array([1, 2, 3], dtype=np.uint64)
+    vals = h.lookup(signs, dim=4, training=True)
+    h.update_gradients(signs, np.ones((3, 4), np.float32), dim=4)
+    blob = h.dump_bytes()
+
+    h2 = EmbeddingHolder(capacity=100, num_internal_shards=3)
+    h2.configure("bounded_uniform", {"lower": -0.1, "upper": 0.1})
+    h2.register_optimizer({"type": "sgd", "lr": 0.1, "wd": 0.0})
+    h2.load_bytes(blob)
+    assert len(h2) == 3
+    for s in signs:
+        d, vec = h2.get_entry(int(s))
+        np.testing.assert_array_equal(vec, h.get_entry(int(s))[1])
+
+
+def test_gamma_poisson_inits_are_deterministic():
+    for method, params in (
+        ("bounded_gamma", {"shape": 2.0, "scale": 0.5}),
+        ("bounded_poisson", {"lambda": 3.0}),
+    ):
+        h = EmbeddingHolder(capacity=10, num_internal_shards=1)
+        h.configure(method, params)
+        h.register_optimizer({"type": "sgd", "lr": 0.1})
+        signs = np.array([4, 5], dtype=np.uint64)
+        a = h.lookup(signs, 4, True)
+        h.clear()
+        b = h.lookup(signs, 4, True)
+        np.testing.assert_array_equal(a, b)
+        if method == "bounded_gamma":
+            assert (a > 0).all()
+        else:
+            assert (a >= 0).all() and (a == np.round(a)).all()
