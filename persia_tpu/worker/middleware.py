@@ -1,0 +1,307 @@
+"""Embedding-worker middleware: the lookup/update transform pipeline.
+
+Re-design of the reference's embedding worker brain
+(rust/persia-embedding-server/src/embedding_worker_service/mod.rs:341-872)
+as vectorized numpy over CSR batches:
+
+- per-feature **dedup** of signs with (sample, col) back-pointers
+  (reference: persia-common/src/lib.rs:28-83 FeatureBatch::new)
+- **hashstack** multi-round vocab compression (mod.rs:347-400)
+- **index-prefix** namespacing (mod.rs:402-429)
+- **shard split** by farmhash64(sign) % replica_size (mod.rs:341-345,
+  :448-484), grouped by embedding dim so each PS call is one rectangular
+  batch
+- **postprocess** into TPU-friendly static-shape tensors (mod.rs:486-629):
+  summed slots -> (batch, dim) f32 with optional 1/sqrt(n) scaling; raw
+  slots -> a fixed-capacity distinct tensor (batch*sample_fixed_size + 1,
+  dim) whose row 0 is zeros, plus a (batch, sample_fixed_size) int32 index
+  tensor where 0 means padding
+- **gradient aggregation** back to per-sign gradients (mod.rs:703-872):
+  transpose of the forward scatter, NaN filtering, loss-scale recip
+
+TPU-first deviations from the reference:
+
+- Raw-slot outputs are padded to a *static* capacity so the jitted dense
+  step sees fixed shapes (XLA requirement); the reference emits
+  (distinct+1, dim) dynamically.
+- With hashstack, raw slots **accumulate** all rounds' embeddings into the
+  original sign's row (the reference overwrites, keeping only the last
+  round: mod.rs:546-552).
+"""
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from persia_tpu.config import EmbeddingSchema, SlotConfig
+from persia_tpu.data.batch import IDTypeFeature, PersiaBatch
+from persia_tpu.hashing import farmhash64_np
+
+_U64 = np.uint64
+
+
+@dataclass
+class DedupedFeature:
+    """One ID feature after dedup (+ hashstack + prefix) transforms."""
+
+    name: str
+    batch_size: int
+    distinct_signs: np.ndarray  # (d,) uint64 — signs to look up on the PS
+    elem_sample: np.ndarray  # (nnz,) int32 — sample index per CSR element
+    elem_col: np.ndarray  # (nnz,) int32 — position within the sample
+    elem_distinct: np.ndarray  # (nnz,) int32 — index into distinct_signs
+    sample_num_signs: np.ndarray  # (bs,) int32 — per-sample sign count
+    # raw mode: which output row each distinct sign contributes to
+    # (identity unless hashstack merged rounds back onto original signs)
+    raw_row_of_distinct: Optional[np.ndarray] = None
+    hash_stack_rounds: int = 0
+
+    @property
+    def num_distinct(self) -> int:
+        return len(self.distinct_signs)
+
+    @property
+    def num_raw_rows(self) -> int:
+        if self.raw_row_of_distinct is None:
+            return self.num_distinct
+        return int(self.raw_row_of_distinct.max()) + 1 if len(self.raw_row_of_distinct) else 0
+
+
+def dedup_feature(feature: IDTypeFeature) -> DedupedFeature:
+    """CSR feature -> distinct signs + element back-pointers."""
+    offsets = feature.offsets.astype(np.int64, copy=False)
+    counts = np.diff(offsets)
+    bs = feature.batch_size
+    nnz = int(offsets[-1])
+    elem_sample = np.repeat(np.arange(bs, dtype=np.int32), counts)
+    elem_col = (np.arange(nnz, dtype=np.int32)
+                - np.repeat(offsets[:-1], counts).astype(np.int32))
+    distinct, inverse = np.unique(feature.signs, return_inverse=True)
+    return DedupedFeature(
+        name=feature.name,
+        batch_size=bs,
+        distinct_signs=distinct.astype(np.uint64, copy=False),
+        elem_sample=elem_sample,
+        elem_col=elem_col,
+        elem_distinct=inverse.astype(np.int32),
+        sample_num_signs=counts.astype(np.int32),
+    )
+
+
+def apply_hashstack(feat: DedupedFeature, rounds: int, table_size: int) -> DedupedFeature:
+    """Multi-round hash compression: each sign becomes `rounds` bucket signs
+    in a table of rounds*table_size rows (reference mod.rs:347-400)."""
+    if rounds <= 0:
+        return feat
+    d = feat.num_distinct
+    h = feat.distinct_signs
+    buckets = np.empty((d, rounds), dtype=np.uint64)
+    for r in range(rounds):
+        h = farmhash64_np(h)
+        buckets[:, r] = h % _U64(table_size) + _U64(r * table_size)
+    new_distinct, new_inverse = np.unique(buckets.ravel(), return_inverse=True)
+    bucket_of = new_inverse.reshape(d, rounds).astype(np.int32)
+    # raw-mode mapping: every bucket contributes to its original sign's row
+    raw_row = np.zeros(len(new_distinct), dtype=np.int32)
+    raw_row[bucket_of.ravel()] = np.repeat(np.arange(d, dtype=np.int32), rounds)
+    return DedupedFeature(
+        name=feat.name,
+        batch_size=feat.batch_size,
+        distinct_signs=new_distinct,
+        elem_sample=np.repeat(feat.elem_sample, rounds),
+        elem_col=np.repeat(feat.elem_col, rounds),
+        elem_distinct=bucket_of[feat.elem_distinct].ravel(),
+        sample_num_signs=feat.sample_num_signs * rounds,
+        raw_row_of_distinct=raw_row,
+        hash_stack_rounds=rounds,
+    )
+
+
+def apply_index_prefix(feat: DedupedFeature, slot: SlotConfig,
+                       feature_spacing: int) -> DedupedFeature:
+    """Namespace signs under the slot's feature-group prefix
+    (reference mod.rs:402-429)."""
+    if slot.index_prefix <= 0:
+        return feat
+    with np.errstate(over="ignore"):
+        feat.distinct_signs = (
+            feat.distinct_signs % _U64(feature_spacing) + _U64(slot.index_prefix)
+        )
+    return feat
+
+
+def preprocess_batch(
+    id_type_features: List[IDTypeFeature], schema: EmbeddingSchema
+) -> List[DedupedFeature]:
+    """dedup -> hashstack -> prefix for every feature of a batch
+    (reference: lookup_batched_all_slots_preprocess, mod.rs:448-484)."""
+    feats = []
+    for f in id_type_features:
+        slot = schema.get_slot(f.name)
+        df = dedup_feature(f)
+        hs = slot.hash_stack_config
+        df = apply_hashstack(df, hs.hash_stack_rounds, hs.embedding_size)
+        df = apply_index_prefix(df, slot, schema.feature_spacing)
+        feats.append(df)
+    return feats
+
+
+@dataclass
+class ShardGroup:
+    """All signs for one (shard, dim) pair, with scatter-back pointers."""
+
+    shard: int
+    dim: int
+    signs: np.ndarray  # (m,) uint64
+    feature_idx: np.ndarray  # (m,) int32 — which DedupedFeature
+    distinct_idx: np.ndarray  # (m,) int32 — index into that feature's distinct
+
+
+def shard_split(
+    feats: List[DedupedFeature], schema: EmbeddingSchema, replica_size: int
+) -> List[ShardGroup]:
+    """Group every feature's distinct signs by (PS shard, dim)."""
+    from persia_tpu.hashing import sign_to_shard
+
+    by_key: Dict[Tuple[int, int], List[Tuple[np.ndarray, int]]] = {}
+    for fi, feat in enumerate(feats):
+        dim = schema.get_slot(feat.name).dim
+        shards = sign_to_shard(feat.distinct_signs, replica_size)
+        for shard in np.unique(shards):
+            sel = np.nonzero(shards == shard)[0].astype(np.int32)
+            by_key.setdefault((int(shard), dim), []).append((sel, fi))
+    groups = []
+    for (shard, dim), parts in sorted(by_key.items()):
+        signs = np.concatenate([feats[fi].distinct_signs[sel] for sel, fi in parts])
+        fidx = np.concatenate([np.full(len(sel), fi, np.int32) for sel, fi in parts])
+        didx = np.concatenate([sel for sel, _ in parts])
+        groups.append(ShardGroup(shard, dim, signs, fidx, didx))
+    return groups
+
+
+def scatter_lookup_results(
+    feats: List[DedupedFeature], schema: EmbeddingSchema,
+    groups: List[ShardGroup], results: List[np.ndarray],
+) -> List[np.ndarray]:
+    """Assemble per-feature (num_distinct, dim) embedding matrices from the
+    per-shard lookup results."""
+    mats = [
+        np.zeros((f.num_distinct, schema.get_slot(f.name).dim), dtype=np.float32)
+        for f in feats
+    ]
+    for group, res in zip(groups, results):
+        for fi in np.unique(group.feature_idx):
+            sel = group.feature_idx == fi
+            mats[fi][group.distinct_idx[sel]] = res[sel]
+    return mats
+
+
+@dataclass
+class SumEmbedding:
+    name: str
+    embeddings: np.ndarray  # (batch, dim)
+
+
+@dataclass
+class RawEmbedding:
+    """Static-shape raw (sequence) slot output.
+
+    ``embeddings[0]`` is all-zeros padding; ``index[s, c]`` selects the row
+    for sample s position c, with 0 meaning padding. Gather + mask happen
+    on-device in the dense model.
+    """
+
+    name: str
+    embeddings: np.ndarray  # (capacity, dim), row 0 zeros
+    index: np.ndarray  # (batch, sample_fixed_size) int32
+    sample_id_num: np.ndarray  # (batch,) int32
+
+
+def postprocess_feature(
+    feat: DedupedFeature, slot: SlotConfig, emb: np.ndarray
+):
+    """One feature's distinct embeddings -> model-ready tensors
+    (reference: lookup_batched_all_slots_postprocess, mod.rs:486-629)."""
+    bs = feat.batch_size
+    dim = slot.dim
+    if slot.embedding_summation:
+        out = np.zeros((bs, dim), dtype=np.float32)
+        np.add.at(out, feat.elem_sample, emb[feat.elem_distinct])
+        if slot.sqrt_scaling:
+            n = np.maximum(feat.sample_num_signs, 1).astype(np.float32)
+            out *= (1.0 / np.sqrt(n))[:, None]
+        return SumEmbedding(feat.name, out)
+
+    sfs = slot.sample_fixed_size
+    capacity = bs * sfs + 1
+    rows = (
+        feat.raw_row_of_distinct
+        if feat.raw_row_of_distinct is not None
+        else np.arange(feat.num_distinct, dtype=np.int32)
+    )
+    emb_out = np.zeros((capacity, dim), dtype=np.float32)
+    np.add.at(emb_out, rows + 1, emb)
+    if slot.sqrt_scaling and feat.hash_stack_rounds > 1:
+        emb_out *= 1.0 / np.sqrt(float(feat.hash_stack_rounds))
+    index = np.zeros((bs, sfs), dtype=np.int32)
+    valid = feat.elem_col < sfs
+    index[feat.elem_sample[valid], feat.elem_col[valid]] = (
+        rows[feat.elem_distinct[valid]] + 1
+    )
+    sample_id_num = np.minimum(feat.sample_num_signs, sfs).astype(np.int32)
+    return RawEmbedding(feat.name, emb_out, index, sample_id_num)
+
+
+def aggregate_gradients(
+    feat: DedupedFeature, slot: SlotConfig, grad: np.ndarray,
+    loss_scale: float = 1.0,
+) -> np.ndarray:
+    """Model gradients -> per-distinct-sign gradients (the transpose of
+    postprocess; reference: update_all_batched_gradients, mod.rs:703-872).
+
+    For summed slots ``grad`` is (batch, dim); for raw slots it is the
+    gradient w.r.t. the padded distinct tensor, (capacity, dim).
+    Non-finite values are zeroed (the reference's NaN filter) and the
+    trainer's loss scale is divided out.
+    """
+    dim = slot.dim
+    grad = np.ascontiguousarray(grad, dtype=np.float32)
+    if not np.isfinite(grad).all():
+        grad = np.nan_to_num(grad, nan=0.0, posinf=0.0, neginf=0.0)
+    if loss_scale != 1.0:
+        grad = grad * (1.0 / loss_scale)
+    out = np.zeros((feat.num_distinct, dim), dtype=np.float32)
+    if slot.embedding_summation:
+        if slot.sqrt_scaling:
+            n = np.maximum(feat.sample_num_signs, 1).astype(np.float32)
+            grad = grad * (1.0 / np.sqrt(n))[:, None]
+        np.add.at(out, feat.elem_distinct, grad[feat.elem_sample])
+    else:
+        rows = (
+            feat.raw_row_of_distinct
+            if feat.raw_row_of_distinct is not None
+            else np.arange(feat.num_distinct, dtype=np.int32)
+        )
+        out = grad[rows + 1].copy()
+        if slot.sqrt_scaling and feat.hash_stack_rounds > 1:
+            out *= 1.0 / np.sqrt(float(feat.hash_stack_rounds))
+    return out
+
+
+def shard_gradients(
+    feats: List[DedupedFeature], schema: EmbeddingSchema,
+    per_feature_grads: List[np.ndarray], replica_size: int,
+) -> List[Tuple[int, int, np.ndarray, np.ndarray]]:
+    """Group per-sign gradients by (shard, dim) for the PS update calls.
+
+    Returns a list of (shard, dim, signs, grads)."""
+    groups = shard_split(feats, schema, replica_size)
+    out = []
+    for g in groups:
+        grads = np.empty((len(g.signs), g.dim), dtype=np.float32)
+        for fi in np.unique(g.feature_idx):
+            sel = g.feature_idx == fi
+            grads[sel] = per_feature_grads[fi][g.distinct_idx[sel]]
+        out.append((g.shard, g.dim, g.signs, grads))
+    return out

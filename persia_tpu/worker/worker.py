@@ -1,0 +1,190 @@
+"""The embedding worker: middleware state + PS fan-out.
+
+Plays the role of the reference's EmbeddingWorkerInner
+(embedding_worker_service/mod.rs:631-1129): it owns
+
+- ``forward_id_buffer`` — batches sent by data-loaders awaiting lookup,
+  keyed by ref_id (mod.rs:656-701)
+- ``post_forward_buffer`` — looked-up batches awaiting gradients
+  (mod.rs:1060-1067)
+- a ``staleness`` counter (incremented at lookup, decremented when the
+  gradients return, mod.rs:75-80)
+- fan-out to the parameter-server replicas through any client exposing the
+  holder interface (in-process holders here; RPC clients in
+  persia_tpu.service wire the same calls over TCP)
+
+Expiry of stale pending batches after ``buffered_data_expired_sec``
+mirrors mod.rs:991-1029.
+"""
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from persia_tpu.config import EmbeddingSchema
+from persia_tpu.data.batch import IDTypeFeature
+from persia_tpu.logger import get_default_logger
+from persia_tpu.worker import middleware as mw
+
+_logger = get_default_logger(__name__)
+
+
+class ForwardBufferFull(RuntimeError):
+    """Backpressure signal to data-loaders (reference mod.rs:1519-1521)."""
+
+
+class EmbeddingWorker:
+    """Stateless-ish middleware between trainers and parameter servers."""
+
+    def __init__(
+        self,
+        schema: EmbeddingSchema,
+        ps_clients: Sequence,
+        forward_buffer_size: int = 1000,
+        buffered_data_expired_sec: int = 1800,
+    ):
+        self.schema = schema
+        self.ps_clients = list(ps_clients)
+        self.replica_size = len(self.ps_clients)
+        if self.replica_size == 0:
+            raise ValueError("EmbeddingWorker needs at least one PS client")
+        self.forward_buffer_size = forward_buffer_size
+        self.buffered_data_expired_sec = buffered_data_expired_sec
+        self._lock = threading.Lock()
+        self._next_ref_id = 1
+        # ref_id -> (feats, enter_time)
+        self._forward_id_buffer: Dict[int, Tuple[list, float]] = {}
+        self._post_forward_buffer: Dict[int, Tuple[list, float]] = {}
+        self.staleness = 0
+
+    # --- control plane ---------------------------------------------------
+
+    def configure_parameter_servers(self, init_method: str, init_params: dict,
+                                    admit_probability: float,
+                                    weight_bound: float,
+                                    enable_weight_bound: bool = True):
+        for c in self.ps_clients:
+            c.configure(init_method, init_params, admit_probability,
+                        weight_bound, enable_weight_bound)
+
+    def register_optimizer(self, config: dict):
+        for c in self.ps_clients:
+            c.register_optimizer(
+                config,
+                feature_index_prefix_bit=self.schema.feature_index_prefix_bit,
+            )
+
+    # --- data-loader side ------------------------------------------------
+
+    def put_batch(self, id_type_features: List[IDTypeFeature]) -> int:
+        """Ingest a pre-lookup batch; returns its ref_id
+        (reference: forward_batched, mod.rs:656-701)."""
+        self._expire_stale()
+        with self._lock:
+            if len(self._forward_id_buffer) >= self.forward_buffer_size:
+                raise ForwardBufferFull(
+                    f"forward buffer full ({self.forward_buffer_size})"
+                )
+            ref_id = self._next_ref_id
+            self._next_ref_id += 1
+        feats = mw.preprocess_batch(id_type_features, self.schema)
+        with self._lock:
+            self._forward_id_buffer[ref_id] = (feats, time.monotonic())
+        return ref_id
+
+    def _expire_stale(self):
+        horizon = time.monotonic() - self.buffered_data_expired_sec
+        with self._lock:
+            for buf in (self._forward_id_buffer, self._post_forward_buffer):
+                expired = [r for r, (_, t) in buf.items() if t < horizon]
+                for r in expired:
+                    del buf[r]
+                if expired:
+                    _logger.warning("expired %d stale buffered batches",
+                                    len(expired))
+
+    # --- trainer side ----------------------------------------------------
+
+    def lookup(self, ref_id: int, training: bool = True) -> Dict[str, object]:
+        """Look up a previously-ingested batch by ref_id
+        (reference: forward_batch_id, mod.rs:1031-1074)."""
+        with self._lock:
+            item = self._forward_id_buffer.pop(ref_id, None)
+        if item is None:
+            raise KeyError(f"ref_id {ref_id} not in forward buffer")
+        feats, _ = item
+        result = self._lookup_feats(feats, training)
+        if training:
+            with self._lock:
+                self._post_forward_buffer[ref_id] = (feats, time.monotonic())
+                self.staleness += 1
+        return result
+
+    def lookup_direct(
+        self, id_type_features: List[IDTypeFeature], training: bool = False
+    ) -> Dict[str, object]:
+        """One-shot preprocess+lookup without buffers — the inference/eval
+        path (reference: forward_batched_direct, mod.rs:1076-1107)."""
+        feats = mw.preprocess_batch(id_type_features, self.schema)
+        return self._lookup_feats(feats, training)
+
+    def lookup_direct_training(
+        self, id_type_features: List[IDTypeFeature]
+    ) -> Tuple[int, Dict[str, object]]:
+        """Preprocess+lookup keeping gradient state — the synchronous
+        training path used by the in-process e2e slice."""
+        ref_id = self.put_batch(id_type_features)
+        return ref_id, self.lookup(ref_id, training=True)
+
+    def _lookup_feats(self, feats, training: bool) -> Dict[str, object]:
+        groups = mw.shard_split(feats, self.schema, self.replica_size)
+        results = [
+            self.ps_clients[g.shard].lookup(g.signs, g.dim, training)
+            for g in groups
+        ]
+        mats = mw.scatter_lookup_results(feats, self.schema, groups, results)
+        out = {}
+        for feat, mat in zip(feats, mats):
+            slot = self.schema.get_slot(feat.name)
+            out[feat.name] = mw.postprocess_feature(feat, slot, mat)
+        return out
+
+    def update_gradients(
+        self, ref_id: int, grads: Dict[str, np.ndarray],
+        loss_scale: float = 1.0,
+    ):
+        """Route model gradients back to the parameter servers
+        (reference: update_gradient_batched, mod.rs:1109-1129)."""
+        with self._lock:
+            item = self._post_forward_buffer.pop(ref_id, None)
+            if item is not None:
+                self.staleness -= 1
+        if item is None:
+            raise KeyError(f"ref_id {ref_id} not in post-forward buffer")
+        feats, _ = item
+        per_feature = []
+        for feat in feats:
+            slot = self.schema.get_slot(feat.name)
+            if feat.name not in grads:
+                raise KeyError(f"missing gradient for feature {feat.name!r}")
+            per_feature.append(
+                mw.aggregate_gradients(feat, slot, grads[feat.name], loss_scale)
+            )
+        for shard, dim, signs, g in mw.shard_gradients(
+            feats, self.schema, per_feature, self.replica_size
+        ):
+            self.ps_clients[shard].update_gradients(signs, g, dim)
+
+    # --- checkpoint fan-out ----------------------------------------------
+
+    def dump(self, dirpath: str):
+        from persia_tpu.checkpoint import dump_sharded
+
+        dump_sharded(self.ps_clients, dirpath)
+
+    def load(self, dirpath: str):
+        from persia_tpu.checkpoint import load_sharded
+
+        load_sharded(self.ps_clients, dirpath, self.replica_size)

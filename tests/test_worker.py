@@ -1,0 +1,88 @@
+"""EmbeddingWorker orchestration tests (buffers, staleness, PS fan-out)."""
+
+import numpy as np
+import pytest
+
+from persia_tpu.config import EmbeddingSchema, SlotConfig
+from persia_tpu.data.batch import IDTypeFeature
+from persia_tpu.ps.store import EmbeddingHolder
+from persia_tpu.worker.worker import EmbeddingWorker, ForwardBufferFull
+
+
+def _make_worker(n_ps=2, **kw):
+    schema = EmbeddingSchema(slots_config={
+        "clicks": SlotConfig(name="clicks", dim=4),
+        "tags": SlotConfig(name="tags", dim=2, embedding_summation=False,
+                           sample_fixed_size=3),
+    })
+    clients = [EmbeddingHolder(capacity=10_000, num_internal_shards=2)
+               for _ in range(n_ps)]
+    worker = EmbeddingWorker(schema, clients, **kw)
+    worker.configure_parameter_servers(
+        "bounded_uniform", {"lower": -0.1, "upper": 0.1}, 1.0, 10.0)
+    worker.register_optimizer({"type": "sgd", "lr": 0.1, "wd": 0.0})
+    return worker
+
+
+def _batch():
+    return [
+        IDTypeFeature("clicks", [np.array([1, 2], np.uint64),
+                                 np.array([3], np.uint64)]),
+        IDTypeFeature("tags", [np.array([7], np.uint64),
+                               np.array([8, 9], np.uint64)]),
+    ]
+
+
+def test_lookup_update_round_trip_changes_embeddings():
+    w = _make_worker()
+    ref_id, result = w.lookup_direct_training(_batch())
+    assert w.staleness == 1
+    clicks = result["clicks"].embeddings
+    assert clicks.shape == (2, 4)
+    tags = result["tags"]
+    assert tags.embeddings.shape == (2 * 3 + 1, 2)
+    grads = {
+        "clicks": np.ones((2, 4), np.float32),
+        "tags": np.ones((7, 2), np.float32),
+    }
+    w.update_gradients(ref_id, grads)
+    assert w.staleness == 0
+    # second lookup sees sgd-updated values: emb - lr*accumulated_grad
+    _, result2 = w.lookup_direct_training(_batch())
+    # sign 1 appears once in sample 0 -> grad 1.0, lr 0.1
+    np.testing.assert_allclose(
+        result2["clicks"].embeddings[1], clicks[1] - 0.1, rtol=1e-5)
+
+
+def test_eval_lookup_leaves_no_state():
+    w = _make_worker()
+    result = w.lookup_direct(_batch(), training=False)
+    assert w.staleness == 0
+    np.testing.assert_array_equal(result["clicks"].embeddings,
+                                  np.zeros((2, 4), np.float32))
+
+
+def test_forward_buffer_backpressure():
+    w = _make_worker(forward_buffer_size=2)
+    w.put_batch(_batch())
+    w.put_batch(_batch())
+    with pytest.raises(ForwardBufferFull):
+        w.put_batch(_batch())
+
+
+def test_unknown_ref_id_raises():
+    w = _make_worker()
+    with pytest.raises(KeyError):
+        w.lookup(999)
+    with pytest.raises(KeyError):
+        w.update_gradients(999, {})
+
+
+def test_fanout_covers_all_ps_replicas():
+    w = _make_worker(n_ps=3)
+    feature = IDTypeFeature("clicks",
+                            [np.arange(1, 200, dtype=np.uint64)])
+    ref_id, _ = w.lookup_direct_training([feature])
+    total = sum(len(c) for c in w.ps_clients)
+    assert total == 199
+    assert all(len(c) > 0 for c in w.ps_clients)
