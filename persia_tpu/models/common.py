@@ -1,0 +1,91 @@
+"""Shared building blocks for the dense model zoo.
+
+The dense tower is the part of a PERSIA-style model that runs on the
+accelerator (reference: examples/src/adult-income/model.py and the torch
+models users bring). Here it is flax.linen, designed TPU-first:
+
+- **bf16 compute, f32 params**: matmuls run in bfloat16 on the MXU; the
+  parameter copy and batch-norm statistics stay float32 (no loss-scaler
+  needed — bf16 has f32's exponent range, unlike the reference's fp16
+  GradScaler path in persia/ctx.py:753-852).
+- **Static shapes**: raw (sequence) slots arrive as a fixed-capacity
+  distinct tensor + index tensor (see worker/middleware.py) and are
+  gathered on device — one XLA gather instead of host-side re-assembly.
+"""
+
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+def gather_raw_embedding(
+    embeddings: jnp.ndarray, index: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Expand a RawEmbedding (capacity, dim) + (bs, sfs) index into a
+    (bs, sfs, dim) tensor and its (bs, sfs) validity mask.
+
+    Row 0 of ``embeddings`` is zeros, so padded positions contribute zero
+    without masking; the mask is still returned for attention-style use.
+    """
+    gathered = jnp.take(embeddings, index, axis=0)
+    mask = index > 0
+    return gathered, mask
+
+
+def flatten_embeddings(embedding_tensors: Sequence[Any]) -> jnp.ndarray:
+    """Concatenate model-ready embedding inputs along features.
+
+    Each element is either a (bs, dim) summed tensor or a (emb, index)
+    raw pair, which is gathered and mean-pooled over valid positions.
+    """
+    parts = []
+    for e in embedding_tensors:
+        if isinstance(e, (tuple, list)):
+            emb, index = e
+            gathered, mask = gather_raw_embedding(emb, index)
+            denom = jnp.maximum(mask.sum(axis=1, keepdims=True), 1)
+            parts.append(gathered.sum(axis=1) / denom)
+        else:
+            parts.append(e)
+    return jnp.concatenate(parts, axis=1)
+
+
+def stack_field_embeddings(embedding_tensors: Sequence[Any]) -> jnp.ndarray:
+    """(bs, F, dim) field stack for interaction layers (DLRM/DeepFM).
+    All fields must share one dim; raw slots are mean-pooled first."""
+    parts = []
+    for e in embedding_tensors:
+        if isinstance(e, (tuple, list)):
+            emb, index = e
+            gathered, mask = gather_raw_embedding(emb, index)
+            denom = jnp.maximum(mask.sum(axis=1, keepdims=True), 1)
+            parts.append(gathered.sum(axis=1) / denom)
+        else:
+            parts.append(e)
+    return jnp.stack(parts, axis=1)
+
+
+class MLP(nn.Module):
+    """Dense stack with optional batch-norm and configurable activation."""
+
+    features: Sequence[int]
+    activation: Callable = nn.relu
+    use_batch_norm: bool = False
+    final_activation: bool = True
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.compute_dtype)
+        for i, width in enumerate(self.features):
+            x = nn.Dense(width, dtype=self.compute_dtype)(x)
+            is_last = i == len(self.features) - 1
+            if not is_last or self.final_activation:
+                if self.use_batch_norm:
+                    x = nn.BatchNorm(
+                        use_running_average=not train, dtype=jnp.float32
+                    )(x.astype(jnp.float32)).astype(self.compute_dtype)
+                x = self.activation(x)
+        return x

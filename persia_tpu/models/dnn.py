@@ -1,0 +1,40 @@
+"""Adult-income style DNN tower (reference: examples/src/adult-income/model.py).
+
+Same topology as the reference example — a dense-feature MLP+BN branch, a
+sparse-embedding MLP+BN branch, three linear layers, sigmoid output — so
+the e2e example and its AUC check carry over.
+"""
+
+from typing import Any, List, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from persia_tpu.models.common import flatten_embeddings
+
+
+class DNN(nn.Module):
+    dense_mlp_output_size: int = 16
+    sparse_mlp_output_size: int = 128
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, non_id_tensors: Sequence[jnp.ndarray],
+                 embedding_tensors: Sequence[Any], train: bool = False):
+        dt = self.compute_dtype
+        dense_x = non_id_tensors[0].astype(dt)
+        sparse_concat = flatten_embeddings(embedding_tensors).astype(dt)
+
+        sparse = nn.Dense(self.sparse_mlp_output_size, dtype=dt)(sparse_concat)
+        sparse = nn.BatchNorm(use_running_average=not train,
+                              dtype=jnp.float32)(sparse.astype(jnp.float32))
+
+        dense_x = nn.Dense(self.dense_mlp_output_size, dtype=dt)(dense_x)
+        dense_x = nn.BatchNorm(use_running_average=not train,
+                               dtype=jnp.float32)(dense_x.astype(jnp.float32))
+
+        x = jnp.concatenate([sparse, dense_x], axis=1).astype(dt)
+        x = nn.Dense(256, dtype=dt)(x)
+        x = nn.Dense(128, dtype=dt)(x)
+        x = nn.Dense(1, dtype=dt)(x)
+        return nn.sigmoid(x.astype(jnp.float32))

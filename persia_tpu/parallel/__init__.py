@@ -1,0 +1,33 @@
+"""Parallelism: device meshes, the jitted hybrid train step, and
+device-resident sharded embeddings (see mesh.py / train.py /
+device_embedding.py)."""
+
+from persia_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    batch_sharding,
+    make_mesh,
+    replicated,
+    shard_batch_pytree,
+    table_sharding,
+)
+from persia_tpu.parallel.train import (
+    TrainState,
+    bce_loss,
+    create_train_state,
+    make_eval_step,
+    make_train_step,
+    split_embedding_inputs,
+)
+from persia_tpu.parallel.device_embedding import (
+    DeviceEmbeddingBag,
+    DeviceEmbeddingCollection,
+)
+
+__all__ = [
+    "DATA_AXIS", "MODEL_AXIS", "make_mesh", "batch_sharding", "replicated",
+    "table_sharding", "shard_batch_pytree", "TrainState", "bce_loss",
+    "create_train_state", "make_train_step", "make_eval_step",
+    "split_embedding_inputs", "DeviceEmbeddingBag",
+    "DeviceEmbeddingCollection",
+]

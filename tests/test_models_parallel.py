@@ -1,0 +1,120 @@
+"""Model zoo + parallel train-step tests on the virtual 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from persia_tpu.models import DCNv2, DeepFM, DLRM, DNN
+from persia_tpu.parallel import (
+    DeviceEmbeddingCollection,
+    batch_sharding,
+    create_train_state,
+    make_eval_step,
+    make_mesh,
+    make_train_step,
+    shard_batch_pytree,
+    split_embedding_inputs,
+    table_sharding,
+)
+
+BS = 16
+
+
+def _inputs():
+    rng = np.random.default_rng(0)
+    dense = jnp.asarray(rng.normal(size=(BS, 5)), jnp.float32)
+    embs = [jnp.asarray(rng.normal(size=(BS, 8)), jnp.float32) for _ in range(3)]
+    raw = (
+        jnp.asarray(rng.normal(size=(BS * 3 + 1, 8)), jnp.float32),
+        jnp.asarray(rng.integers(0, BS * 3, size=(BS, 3)), jnp.int32),
+    )
+    label = jnp.asarray(rng.integers(0, 2, size=(BS, 1)), jnp.float32)
+    return [dense], embs + [raw], label
+
+
+@pytest.mark.parametrize("model_cls", [DNN, DLRM, DCNv2, DeepFM])
+def test_train_step_decreases_loss(model_cls):
+    kw = {"embedding_dim": 8} if model_cls is DLRM else {}
+    model = model_cls(**kw)
+    non_id, emb_inputs, label = _inputs()
+    opt = optax.adam(1e-2)
+    state = create_train_state(model, opt, jax.random.key(0), non_id, emb_inputs)
+    step = make_train_step(model, opt)
+    ev, ei = split_embedding_inputs(emb_inputs)
+    losses = []
+    for _ in range(20):
+        state, loss, emb_grads, pred = step(state, non_id, ev, ei, label)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    assert np.isfinite(losses).all()
+    # embedding gradients have matching shapes and are non-zero
+    assert emb_grads[0].shape == (BS, 8)
+    assert float(jnp.abs(emb_grads[0]).sum()) > 0
+
+
+def test_eval_step_deterministic():
+    model = DNN()
+    non_id, emb_inputs, _ = _inputs()
+    opt = optax.sgd(0.1)
+    state = create_train_state(model, opt, jax.random.key(1), non_id, emb_inputs)
+    ev, ei = split_embedding_inputs(emb_inputs)
+    eval_step = make_eval_step(model)
+    a = eval_step(state, non_id, ev, ei)
+    b = eval_step(state, non_id, ev, ei)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_data_parallel_step_matches_single_device():
+    """The dense step under a (8, 1) data mesh must produce the same math
+    as unsharded execution — XLA inserts the collectives."""
+    assert len(jax.devices()) == 8
+    model = DNN()
+    non_id, emb_inputs, label = _inputs()
+    opt = optax.sgd(0.1)
+    state = create_train_state(model, opt, jax.random.key(0), non_id, emb_inputs)
+    step = make_train_step(model, opt)
+    ev, ei = split_embedding_inputs(emb_inputs)
+
+    s1, loss1, g1, p1 = step(state, non_id, ev, ei, label)
+
+    mesh = make_mesh((8, 1))
+    sharded = shard_batch_pytree({"n": non_id, "ev": ev, "ei": ei, "l": label}, mesh)
+    s2, loss2, g2, p2 = step(state, sharded["n"], sharded["ev"], sharded["ei"],
+                             sharded["l"])
+    np.testing.assert_allclose(float(loss1), float(loss2), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(p1), np.asarray(p2), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_device_embedding_collection_sharded_table():
+    """Device-mode sparse: tables sharded over the model axis, trained with
+    optax end to end on an (2, 4) mesh."""
+    mesh = make_mesh((2, 4))
+    specs = [("a", 64, 8), ("b", 128, 8)]
+    coll = DeviceEmbeddingCollection(slot_specs=specs)
+    ids = {
+        "a": jnp.asarray(np.random.default_rng(0).integers(0, 1000, (BS, 4)),
+                         jnp.int32),
+        "b": jnp.asarray(np.random.default_rng(1).integers(0, 1000, (BS, 4)),
+                         jnp.int32),
+    }
+    variables = coll.init(jax.random.key(0), ids)
+    # logical partitioning recorded on the params
+    from flax.core import meta
+
+    def unbox_with_mesh(tree):
+        return meta.unbox(tree)
+
+    params = unbox_with_mesh(variables["params"])
+    assert params["bag_a"]["table"].shape == (64, 8)
+
+    def loss_fn(params, ids):
+        out = coll.apply({"params": params}, ids)
+        return sum(jnp.sum(o.astype(jnp.float32) ** 2) for o in out)
+
+    with mesh:
+        g = jax.jit(jax.grad(loss_fn))(params, ids)
+    assert g["bag_a"]["table"].shape == (64, 8)
+    assert float(jnp.abs(g["bag_a"]["table"]).sum()) > 0
