@@ -1,0 +1,176 @@
+"""Benchmark entry (driver-run): DLRM training throughput on one chip.
+
+Prints exactly ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Modes:
+- ``hybrid`` (default): the full PERSIA-style path — host-side C++
+  parameter servers + worker middleware feeding the jitted DLRM step,
+  embedding gradients routed back to the PS each step.
+- ``device``: fully device-resident sharded embeddings (TPU-first mode).
+
+The reference repo publishes no absolute throughput numbers
+("published": {} in BASELINE.json); the north star is "matching A100
+samples/sec/chip" on DLRM. We use 100k samples/sec/chip as that proxy
+target (the PERSIA paper's reported per-accelerator order of magnitude on
+Criteo-scale workloads), so vs_baseline = measured / 100_000.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_SAMPLES_PER_SEC = 100_000.0
+
+NUM_SLOTS = 26
+NUM_DENSE = 13
+DIM = 16
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def make_batches(num, batch_size, ids_per_slot=1, seed=0):
+    from persia_tpu.data.batch import (
+        IDTypeFeatureWithSingleID,
+        Label,
+        NonIDTypeFeature,
+        PersiaBatch,
+    )
+
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(num):
+        id_feats = [
+            IDTypeFeatureWithSingleID(
+                f"slot_{s}",
+                rng.integers(0, 1 << 40, size=batch_size, dtype=np.uint64),
+            )
+            for s in range(NUM_SLOTS)
+        ]
+        out.append(
+            PersiaBatch(
+                id_feats,
+                non_id_type_features=[NonIDTypeFeature(
+                    rng.normal(size=(batch_size, NUM_DENSE)).astype(np.float32)
+                )],
+                labels=[Label(
+                    rng.integers(0, 2, size=(batch_size, 1)).astype(np.float32)
+                )],
+                batch_id=i,
+            )
+        )
+    return out
+
+
+def bench_hybrid(batch_size, steps, warmup, n_ps=2):
+    import optax
+
+    from persia_tpu.config import EmbeddingSchema, uniform_slots
+    from persia_tpu.ctx import TrainCtx
+    from persia_tpu.embedding import EmbeddingConfig
+    from persia_tpu.embedding.optim import Adagrad
+    from persia_tpu.models import DLRM
+    from persia_tpu.ps.native import make_holder
+    from persia_tpu.worker.worker import EmbeddingWorker
+
+    schema = EmbeddingSchema(
+        slots_config=uniform_slots(
+            [f"slot_{s}" for s in range(NUM_SLOTS)], dim=DIM
+        )
+    )
+    holders = [make_holder(50_000_000, 16) for _ in range(n_ps)]
+    worker = EmbeddingWorker(schema, holders)
+    ctx = TrainCtx(
+        model=DLRM(embedding_dim=DIM),
+        dense_optimizer=optax.adagrad(0.02),
+        embedding_optimizer=Adagrad(lr=0.02),
+        schema=schema,
+        worker=worker,
+        embedding_config=EmbeddingConfig(),
+    )
+    batches = make_batches(warmup + steps, batch_size)
+    with ctx:
+        for b in batches[:warmup]:
+            loss, _ = ctx.train_step(b)
+        import jax
+
+        jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        for b in batches[warmup:]:
+            loss, _ = ctx.train_step(b)
+        jax.block_until_ready(loss)
+        elapsed = time.perf_counter() - t0
+    return steps * batch_size / elapsed
+
+
+def bench_device(batch_size, steps, warmup):
+    import jax
+    import optax
+
+    from persia_tpu.models import DLRM
+    from persia_tpu.parallel.device_mode import (
+        DeviceModeModel,
+        criteo_like_specs,
+        make_device_mode_trainer,
+        synthetic_device_batch,
+    )
+    from persia_tpu.parallel.mesh import make_mesh
+
+    devices = jax.devices()
+    mesh = make_mesh((len(devices), 1), devices=devices)
+    specs = criteo_like_specs(num_slots=NUM_SLOTS, vocab=1 << 20, dim=DIM)
+    model = DeviceModeModel(slot_specs=specs, tower=DLRM(embedding_dim=DIM))
+    non_id, ids, label = synthetic_device_batch(batch_size, NUM_DENSE, specs)
+    opt = optax.adagrad(0.02)
+    params, opt_state, step = make_device_mode_trainer(
+        model, opt, mesh, non_id, ids)
+    with mesh:
+        for _ in range(warmup):
+            params, opt_state, loss = step(params, opt_state, non_id, ids,
+                                           label)
+        jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, loss = step(params, opt_state, non_id, ids,
+                                           label)
+        jax.block_until_ready(loss)
+        elapsed = time.perf_counter() - t0
+    return steps * batch_size / elapsed
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--mode", choices=["hybrid", "device"], default="hybrid")
+    p.add_argument("--batch-size", type=int, default=4096)
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--warmup", type=int, default=5)
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny shapes, 3 steps — correctness only")
+    args = p.parse_args()
+    if args.smoke:
+        args.batch_size, args.steps, args.warmup = 256, 3, 1
+
+    log(f"bench: mode={args.mode} bs={args.batch_size} steps={args.steps}")
+    t0 = time.perf_counter()
+    if args.mode == "hybrid":
+        sps = bench_hybrid(args.batch_size, args.steps, args.warmup)
+        metric = "dlrm_hybrid_samples_per_sec_chip"
+    else:
+        sps = bench_device(args.batch_size, args.steps, args.warmup)
+        metric = "dlrm_device_samples_per_sec_chip"
+    log(f"bench: done in {time.perf_counter() - t0:.1f}s -> {sps:,.0f} samples/s")
+    print(json.dumps({
+        "metric": metric,
+        "value": round(sps, 1),
+        "unit": "samples/sec",
+        "vs_baseline": round(sps / BASELINE_SAMPLES_PER_SEC, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

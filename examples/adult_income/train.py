@@ -1,0 +1,88 @@
+"""End-to-end training example (reference: examples/src/adult-income/train.py).
+
+Local in-process mode: data generation, embedding worker, parameter
+servers, and the JAX dense tower all live in one process. Run:
+
+    python examples/adult_income/train.py [--steps N] [--device-mode]
+
+Service mode (multi-process cluster) is exercised by
+tests/test_service_e2e.py via persia_tpu.service.helper.
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/examples/", 1)[0])
+
+import optax
+
+from persia_tpu.config import EmbeddingSchema, uniform_slots
+from persia_tpu.ctx import TrainCtx, eval_ctx
+from persia_tpu.data.dataloader import IterableDataset
+from persia_tpu.embedding import EmbeddingConfig
+from persia_tpu.embedding.optim import Adagrad
+from persia_tpu.logger import get_default_logger
+from persia_tpu.models import DNN
+from persia_tpu.ps.native import make_holder
+from persia_tpu.utils import roc_auc, setup_seed
+from persia_tpu.worker.worker import EmbeddingWorker
+
+from data_generator import NUM_SLOTS, batches
+
+logger = get_default_logger("adult_income")
+
+EMBEDDING_DIM = 8
+
+
+def build_ctx(n_ps: int = 2, seed: int = 42) -> TrainCtx:
+    setup_seed(seed)
+    schema = EmbeddingSchema(
+        slots_config=uniform_slots(
+            [f"slot_{s}" for s in range(NUM_SLOTS)], dim=EMBEDDING_DIM
+        )
+    )
+    holders = [make_holder(1_000_000, 8) for _ in range(n_ps)]
+    worker = EmbeddingWorker(schema, holders)
+    return TrainCtx(
+        model=DNN(sparse_mlp_output_size=128),
+        dense_optimizer=optax.adam(1e-3),
+        embedding_optimizer=Adagrad(lr=1e-2),
+        schema=schema,
+        worker=worker,
+        embedding_config=EmbeddingConfig(emb_initialization=(-0.05, 0.05)),
+        seed=seed,
+    )
+
+
+def evaluate(ctx: TrainCtx, num_samples: int = 4096, seed: int = 99) -> float:
+    preds, labels = [], []
+    with eval_ctx(ctx) as ectx:
+        for batch in batches(num_samples, 512, seed=seed, requires_grad=False):
+            pred, label = ectx.forward(batch)
+            preds.append(np.asarray(pred))
+            labels.append(np.asarray(label[0]))
+    return roc_auc(np.concatenate(labels), np.concatenate(preds))
+
+
+def main(steps: int = 200, batch_size: int = 512) -> float:
+    ctx = build_ctx()
+    dataset = IterableDataset(batches(steps * batch_size, batch_size, seed=1))
+    with ctx:
+        for i, batch in enumerate(dataset):
+            loss, _pred = ctx.train_step(batch)
+            if i % 50 == 0:
+                logger.info("step %d loss %.4f", i, float(loss))
+        auc = evaluate(ctx)
+    logger.info("test auc %.4f", auc)
+    return auc
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=200)
+    p.add_argument("--batch-size", type=int, default=512)
+    args = p.parse_args()
+    auc = main(args.steps, args.batch_size)
+    print(f"AUC: {auc}")

@@ -1,0 +1,343 @@
+"""User-facing contexts: the core API (reference: persia/ctx.py).
+
+- :class:`BaseCtx` — enter/exit + ``current_ctx()`` registry
+  (ctx.py:202-271)
+- :class:`DataCtx` — data-loader role, ``send_data`` into the dataflow
+  (ctx.py:274-342)
+- :class:`EmbeddingCtx` — embedding lookup, feature preparation, dump/load
+  (ctx.py:345-652)
+- :class:`TrainCtx` — adds the dense optimizer and the full hybrid train
+  step (ctx.py:655-1064). In JAX the reference's forward/backward pair
+  collapses into one compiled step whose outputs include the embedding
+  gradients; ``train_step`` then routes them to the parameter servers —
+  the sparse update stays asynchronous with respect to the next batch's
+  lookup when driven through the DataLoader pipeline.
+- :class:`InferCtx` — direct lookup + eval-mode forward (ctx.py:1077-1133)
+
+The embedding tier is reached through an :class:`EmbeddingWorker`; in
+local (in-process) mode its PS clients are EmbeddingHolders, in service
+mode they are RPC clients — the ctx code is identical.
+"""
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from persia_tpu.config import EmbeddingSchema, GlobalConfig
+from persia_tpu.data.batch import PersiaBatch
+from persia_tpu.embedding import EmbeddingConfig, get_default_embedding_config
+from persia_tpu.logger import get_default_logger
+from persia_tpu.worker.middleware import RawEmbedding, SumEmbedding
+from persia_tpu.worker.worker import EmbeddingWorker
+
+_logger = get_default_logger(__name__)
+
+_ctx_lock = threading.Lock()
+_ctx_stack: List["BaseCtx"] = []
+
+
+def current_ctx() -> Optional["BaseCtx"]:
+    return _ctx_stack[-1] if _ctx_stack else None
+
+
+class BaseCtx:
+    """Contexts nest (an eval_ctx may open inside a TrainCtx with-block,
+    mirroring the reference's usage in examples/src/adult-income/train.py);
+    ``current_ctx`` returns the innermost."""
+
+    def __enter__(self):
+        with _ctx_lock:
+            _ctx_stack.append(self)
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        with _ctx_lock:
+            if self in _ctx_stack:
+                _ctx_stack.remove(self)
+        return False
+
+
+class DataCtx(BaseCtx):
+    """Data-loader role: push batches toward the embedding workers and
+    trainers (reference ctx.py:274-342).
+
+    In service mode ``dataflow`` is a persia_tpu.service dataflow client;
+    in local mode batches go straight to a local EmbeddingWorker.
+    """
+
+    def __init__(self, dataflow=None):
+        self.dataflow = dataflow
+        self._next_batch_id = 0
+
+    def send_data(self, batch: PersiaBatch):
+        if self.dataflow is None:
+            raise RuntimeError("DataCtx requires a dataflow client")
+        if batch.batch_id is None:
+            batch.batch_id = self._next_batch_id
+        self._next_batch_id = batch.batch_id + 1
+        self.dataflow.send(batch)
+
+
+class EmbeddingCtx(BaseCtx):
+    def __init__(
+        self,
+        model=None,
+        schema: Optional[EmbeddingSchema] = None,
+        worker: Optional[EmbeddingWorker] = None,
+        embedding_config: Optional[EmbeddingConfig] = None,
+        global_config: Optional[GlobalConfig] = None,
+    ):
+        self.model = model
+        self.schema = schema if schema is not None else (
+            worker.schema if worker is not None else None
+        )
+        self.worker = worker
+        self.embedding_config = embedding_config or get_default_embedding_config()
+        self.global_config = global_config or GlobalConfig()
+        self._configured_servers = False
+
+    def __enter__(self):
+        super().__enter__()
+        if self.worker is not None and not self._configured_servers:
+            self.configure_embedding_parameter_servers()
+        return self
+
+    def configure_embedding_parameter_servers(self):
+        """Broadcast hyperparameters to every PS
+        (reference: lib.rs:307-318 -> mod.rs:429-451)."""
+        ec = self.embedding_config
+        init = self.schema.initialization if self.schema else None
+        if init is not None and init.method.value != "bounded_uniform":
+            method, params = init.method.value, init.to_params()
+        else:
+            lower, upper = ec.emb_initialization
+            method, params = "bounded_uniform", {"lower": lower, "upper": upper}
+        self.worker.configure_parameter_servers(
+            method, params, ec.admit_probability, ec.weight_bound,
+            enable_weight_bound=True,
+        )
+        self._configured_servers = True
+
+    def register_optimizer(self, optimizer):
+        """Called by embedding Optimizer.apply()."""
+        self.worker.register_optimizer(optimizer.config)
+
+    # --- feature preparation -------------------------------------------
+
+    def prepare_features(
+        self, batch: PersiaBatch, lookup: Dict[str, Any]
+    ) -> Tuple[List[jnp.ndarray], List[Any], List[jnp.ndarray]]:
+        """Worker lookup results -> device-ready model inputs
+        (reference: _prepare_feature, ctx.py:75-199)."""
+        non_id = [jnp.asarray(f.data) for f in batch.non_id_type_features]
+        labels = [jnp.asarray(l.data) for l in batch.labels]
+        emb_inputs: List[Any] = []
+        for f in batch.id_type_features:
+            r = lookup[f.name]
+            if isinstance(r, SumEmbedding):
+                emb_inputs.append(jnp.asarray(r.embeddings))
+            elif isinstance(r, RawEmbedding):
+                emb_inputs.append(
+                    (jnp.asarray(r.embeddings), jnp.asarray(r.index))
+                )
+            else:
+                raise TypeError(f"unexpected lookup result {type(r)}")
+        return non_id, emb_inputs, labels
+
+    def forward(self, batch: PersiaBatch):
+        """Eval/infer forward: direct lookup + model apply
+        (reference: forward_directly path, ctx.py:433-469)."""
+        lookup = self.worker.lookup_direct(batch.id_type_features,
+                                           training=False)
+        non_id, emb_inputs, labels = self.prepare_features(batch, lookup)
+        pred = self._apply_model(non_id, emb_inputs)
+        return pred, labels
+
+    def _apply_model(self, non_id, emb_inputs):
+        raise NotImplementedError
+
+    # --- checkpointing ---------------------------------------------------
+
+    def dump_checkpoint(self, dst_dir: str, with_dense: bool = True):
+        from persia_tpu import checkpoint as ckpt
+
+        ckpt.dump_checkpoint(self, dst_dir, with_dense=with_dense)
+
+    def load_checkpoint(self, src_dir: str, with_dense: bool = True):
+        from persia_tpu import checkpoint as ckpt
+
+        ckpt.load_checkpoint(self, src_dir, with_dense=with_dense)
+
+
+class TrainCtx(EmbeddingCtx):
+    """Training context: hybrid sync-dense / async-sparse step.
+
+    Args mirror the reference TrainCtx (ctx.py:655-852) where they still
+    make sense on TPU; DDP options collapse into an optional mesh.
+    """
+
+    def __init__(
+        self,
+        model,
+        dense_optimizer: optax.GradientTransformation,
+        embedding_optimizer,
+        schema: EmbeddingSchema,
+        worker: EmbeddingWorker,
+        embedding_config: Optional[EmbeddingConfig] = None,
+        global_config: Optional[GlobalConfig] = None,
+        mesh=None,
+        loss_fn=None,
+        grad_update_interval: int = 1,
+        seed: int = 0,
+    ):
+        super().__init__(model=model, schema=schema, worker=worker,
+                         embedding_config=embedding_config,
+                         global_config=global_config)
+        from persia_tpu.parallel.train import bce_loss
+
+        self.dense_optimizer = dense_optimizer
+        self.embedding_optimizer = embedding_optimizer
+        self.mesh = mesh
+        self.loss_fn = loss_fn or bce_loss
+        self.grad_update_interval = grad_update_interval
+        self.seed = seed
+        self.state = None
+        self._train_step = None
+        self._eval_step = None
+        self._emb_shapes = None
+
+    def __enter__(self):
+        super().__enter__()
+        if self.embedding_optimizer is not None:
+            self.embedding_optimizer.apply()
+        return self
+
+    def _wire_dtype(self):
+        return (
+            jnp.bfloat16
+            if self.global_config.common.embedding_wire_dtype == "bf16"
+            else jnp.float32
+        )
+
+    def _ensure_compiled(self, non_id, emb_inputs):
+        from persia_tpu.parallel.train import (
+            create_train_state,
+            make_eval_step,
+            make_packed_train_step,
+            split_embedding_inputs,
+        )
+
+        emb_values, _ = split_embedding_inputs(emb_inputs)
+        emb_shapes = tuple(tuple(v.shape) for v in emb_values)
+        if self.state is None:
+            self.state = create_train_state(
+                self.model, self.dense_optimizer, jax.random.key(self.seed),
+                non_id, emb_inputs,
+            )
+            self._eval_step = make_eval_step(self.model)
+        if self._train_step is None or emb_shapes != self._emb_shapes:
+            # (re)build the packed step for this batch geometry; jit caches
+            # by shape so alternating geometries stay cheap
+            self._emb_shapes = emb_shapes
+            self._train_step = make_packed_train_step(
+                self.model, self.dense_optimizer, emb_shapes,
+                loss_fn=self.loss_fn, wire_dtype=self._wire_dtype(),
+            )
+
+    def train_step(self, batch: PersiaBatch) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """One full hybrid step: lookup -> dense step -> sparse update.
+
+        Embedding values/gradients cross the host<->device boundary as a
+        single packed bf16 array in each direction (the TPU analogue of
+        the reference's f16 wire, persia-common/src/lib.rs:85-113).
+        Returns (loss, pred)."""
+        from persia_tpu.parallel.train import (
+            pack_embedding_values,
+            split_embedding_inputs,
+            unpack_embedding_grads,
+        )
+
+        ref_id, lookup = self.worker.lookup_direct_training(
+            batch.id_type_features
+        )
+        non_id, emb_inputs, labels = self.prepare_features(batch, lookup)
+        self._ensure_compiled(non_id, emb_inputs)
+        emb_values, emb_indices = split_embedding_inputs(emb_inputs)
+        flat_emb = jnp.asarray(
+            pack_embedding_values(
+                [np.asarray(v) for v in emb_values], self._wire_dtype()
+            )
+        )
+        if self.mesh is not None:
+            from persia_tpu.parallel.mesh import shard_batch_pytree
+
+            placed = shard_batch_pytree(
+                {"n": non_id, "i": emb_indices, "l": labels[0]}, self.mesh
+            )
+            non_id, emb_indices, label = placed["n"], placed["i"], placed["l"]
+        else:
+            label = labels[0]
+        self.state, loss, flat_grads, pred = self._train_step(
+            self.state, non_id, flat_emb, emb_indices, label
+        )
+        per_slot = unpack_embedding_grads(flat_grads, self._emb_shapes)
+        grads = {
+            f.name: g for f, g in zip(batch.id_type_features, per_slot)
+        }
+        self.worker.update_gradients(ref_id, grads)
+        return loss, pred
+
+    def _apply_model(self, non_id, emb_inputs):
+        from persia_tpu.parallel.train import split_embedding_inputs
+
+        self._ensure_compiled(non_id, emb_inputs)
+        emb_values, emb_indices = split_embedding_inputs(emb_inputs)
+        return self._eval_step(self.state, non_id, emb_values, emb_indices)
+
+
+class InferCtx(EmbeddingCtx):
+    """Inference: fixed worker addresses, eval-mode lookups
+    (reference ctx.py:1077-1133)."""
+
+    def __init__(self, model, state, schema, worker, **kw):
+        super().__init__(model=model, schema=schema, worker=worker, **kw)
+        self.state = state
+        self._eval_step = None
+
+    def _apply_model(self, non_id, emb_inputs):
+        from persia_tpu.parallel.train import (
+            make_eval_step,
+            split_embedding_inputs,
+        )
+
+        if self._eval_step is None:
+            self._eval_step = make_eval_step(self.model)
+        emb_values, emb_indices = split_embedding_inputs(emb_inputs)
+        return self._eval_step(self.state, non_id, emb_values, emb_indices)
+
+
+class _EvalCtx(EmbeddingCtx):
+    def __init__(self, parent: TrainCtx):
+        super().__init__(model=parent.model, schema=parent.schema,
+                         worker=parent.worker,
+                         embedding_config=parent.embedding_config)
+        self._parent = parent
+        self._configured_servers = True  # already configured by parent
+
+    def _apply_model(self, non_id, emb_inputs):
+        return self._parent._apply_model(non_id, emb_inputs)
+
+
+def eval_ctx(train_ctx: Optional[TrainCtx] = None) -> _EvalCtx:
+    """Evaluation context over a trained TrainCtx (reference ctx.py:1072).
+
+    Must be entered after exiting (or outside) the TrainCtx with-block.
+    """
+    ctx = train_ctx or current_ctx()
+    if not isinstance(ctx, TrainCtx):
+        raise RuntimeError("eval_ctx requires a TrainCtx")
+    return _EvalCtx(ctx)

@@ -17,6 +17,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from flax import struct
 
@@ -123,6 +124,101 @@ def make_train_step(
         return new_state, loss, emb_grads, pred
 
     return jax.jit(step)
+
+
+def make_packed_train_step(
+    model,
+    optimizer: optax.GradientTransformation,
+    emb_shapes: Sequence[Tuple[int, ...]],
+    loss_fn: Callable = bce_loss,
+    wire_dtype=jnp.bfloat16,
+) -> Callable:
+    """Train step with **packed** embedding I/O for host-PS mode.
+
+    All slots' embedding values enter as ONE flat ``wire_dtype`` array and
+    all embedding gradients leave as ONE flat ``wire_dtype`` array — a
+    single host->device and device->host transfer per step instead of one
+    per slot. This is the TPU analogue of the reference's f16 wire format
+    (persia-common/src/lib.rs:85-113) and matters enormously when the
+    host<->device link has per-transfer latency.
+
+    ``emb_shapes`` fixes each slot's (rows, dim); changing batch size
+    retraces (shapes are static under XLA).
+
+    step(state, non_id, flat_emb, emb_indices, label)
+      -> (state, loss, flat_grads, pred)
+    """
+    sizes = [int(np.prod(s)) for s in emb_shapes]
+    offsets = np.concatenate([[0], np.cumsum(sizes)]).tolist()
+
+    def step(state: TrainState, non_id_tensors, flat_emb, emb_indices, label):
+        emb_values = [
+            flat_emb[offsets[i] : offsets[i + 1]]
+            .reshape(emb_shapes[i])
+            .astype(jnp.float32)
+            for i in range(len(emb_shapes))
+        ]
+
+        def compute_loss(params, emb_values):
+            variables = {"params": params}
+            if state.batch_stats:
+                variables["batch_stats"] = state.batch_stats
+            emb_inputs = _rebuild_embedding_inputs(emb_values, emb_indices)
+            out = model.apply(
+                variables, non_id_tensors, emb_inputs, train=True,
+                mutable=["batch_stats"] if state.batch_stats else [],
+            )
+            pred, mutated = out if isinstance(out, tuple) else (out, {})
+            loss = loss_fn(pred, label)
+            return loss, (pred, mutated)
+
+        grad_fn = jax.value_and_grad(compute_loss, argnums=(0, 1), has_aux=True)
+        (loss, (pred, mutated)), (param_grads, emb_grads) = grad_fn(
+            state.params, emb_values
+        )
+        updates, new_opt_state = optimizer.update(
+            param_grads, state.opt_state, state.params
+        )
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(
+            params=new_params,
+            batch_stats=mutated.get("batch_stats", state.batch_stats),
+            opt_state=new_opt_state,
+            step=state.step + 1,
+        )
+        flat_grads = jnp.concatenate(
+            [g.ravel() for g in emb_grads]
+        ).astype(wire_dtype)
+        return new_state, loss, flat_grads, pred
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def pack_embedding_values(emb_values: Sequence[np.ndarray], wire_dtype):
+    """Host-side pack: concat + cast for the single upload."""
+    import ml_dtypes  # ships with jax
+
+    np_dtype = (
+        ml_dtypes.bfloat16 if wire_dtype == jnp.bfloat16 else np.float32
+    )
+    flat = np.concatenate(
+        [np.ascontiguousarray(v, dtype=np.float32).ravel() for v in emb_values]
+    )
+    return flat.astype(np_dtype)
+
+
+def unpack_embedding_grads(
+    flat: np.ndarray, emb_shapes: Sequence[Tuple[int, ...]]
+) -> List[np.ndarray]:
+    """Host-side unpack of the single gradient download (to f32)."""
+    out = []
+    pos = 0
+    flat = np.asarray(flat)
+    for shape in emb_shapes:
+        n = int(np.prod(shape))
+        out.append(flat[pos : pos + n].astype(np.float32).reshape(shape))
+        pos += n
+    return out
 
 
 def make_eval_step(model) -> Callable:
