@@ -248,8 +248,14 @@ class TrainCtx(EmbeddingCtx):
                 loss_fn=self.loss_fn, wire_dtype=self._wire_dtype(),
             )
 
-    def train_step(self, batch: PersiaBatch) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    def train_step(self, batch) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """One full hybrid step: lookup -> dense step -> sparse update.
+
+        Accepts a raw :class:`PersiaBatch` (synchronous lookup + update)
+        or a pipeline :class:`~persia_tpu.pipeline.LookedUpBatch` from a
+        DataLoader, in which case the lookup already happened in a
+        prefetch worker and the gradient update is submitted to the async
+        backward engine (bounded by the staleness semaphore).
 
         Embedding values/gradients cross the host<->device boundary as a
         single packed bf16 array in each direction (the TPU analogue of
@@ -260,10 +266,16 @@ class TrainCtx(EmbeddingCtx):
             split_embedding_inputs,
             unpack_embedding_grads,
         )
+        from persia_tpu.pipeline import LookedUpBatch
 
-        ref_id, lookup = self.worker.lookup_direct_training(
-            batch.id_type_features
-        )
+        engine = None
+        if isinstance(batch, LookedUpBatch):
+            ref_id, lookup, engine = batch.ref_id, batch.lookup, batch.engine
+            batch = batch.batch
+        else:
+            ref_id, lookup = self.worker.lookup_direct_training(
+                batch.id_type_features
+            )
         non_id, emb_inputs, labels = self.prepare_features(batch, lookup)
         self._ensure_compiled(non_id, emb_inputs)
         emb_values, emb_indices = split_embedding_inputs(emb_inputs)
@@ -288,7 +300,10 @@ class TrainCtx(EmbeddingCtx):
         grads = {
             f.name: g for f, g in zip(batch.id_type_features, per_slot)
         }
-        self.worker.update_gradients(ref_id, grads)
+        if engine is not None:
+            engine.backward.submit(ref_id, grads)
+        else:
+            self.worker.update_gradients(ref_id, grads)
         return loss, pred
 
     def _apply_model(self, non_id, emb_inputs):

@@ -19,33 +19,9 @@ from persia_tpu.logger import get_default_logger
 _logger = get_default_logger(__name__)
 
 
-@dataclass
-class EmbeddingResult:
-    """Embeddings for one batch, keyed by feature name.
-
-    - summed slots: array of shape ``(batch, dim)``
-    - raw slots: ``(distinct, dim)`` embeddings + static-shape
-      ``(batch, sample_fixed_size)`` int32 index tensor (-1 = padding);
-      mask is derived on-device as ``index >= 0``.
-    """
-
-    summed: Dict[str, Any] = field(default_factory=dict)
-    raw: Dict[str, Any] = field(default_factory=dict)  # name -> (emb, index)
-    ref_id: Optional[int] = None  # worker-side gradient return handle
-    worker_addr: Optional[str] = None
-
-
-@dataclass
-class TrainingBatch:
-    """Device-ready batch handed to the training step
-    (reference: PersiaTrainingBatch in forward.rs)."""
-
-    non_id_type_features: Dict[str, Any]
-    embeddings: EmbeddingResult
-    labels: Dict[str, Any]
-    batch_id: Optional[int] = None
-    meta: Optional[bytes] = None
-    requires_grad: bool = True
+# The batch type yielded by DataLoader: embeddings fetched, gradient
+# handle attached (reference: PersiaTrainingBatch, forward.rs:101-117).
+from persia_tpu.pipeline import LookedUpBatch as TrainingBatch  # noqa: E402
 
 
 class IterableDatasetBase(Iterable[PersiaBatch]):
@@ -173,4 +149,9 @@ class DataLoader:
 
     def __iter__(self) -> Iterator[TrainingBatch]:
         engine = self._ensure_engine()
-        yield from engine.run(iter(self.dataset), timeout_ms=self.timeout_ms)
+        try:
+            yield from engine.run(iter(self.dataset), timeout_ms=self.timeout_ms)
+        finally:
+            # drain in-flight gradient updates so a finished epoch leaves
+            # no pending PS writes (reference: backward.rs release path)
+            engine.flush(timeout=self.timeout_ms / 1000.0)

@@ -1,0 +1,233 @@
+"""Async forward/backward pipeline engines.
+
+Re-design of the reference's pipelined nn-worker runtime
+(rust/persia-core/src/forward.rs + backward.rs) on Python threads (the
+lookup path releases the GIL inside the C++ store and inside device
+transfers, so threads overlap for the operations that matter):
+
+- **ForwardEngine** (forward.rs:470-780): a feeder pulls ``PersiaBatch``es
+  from the dataset; N lookup workers ingest them into the embedding
+  worker and perform the lookup, bounded by the **embedding-staleness
+  semaphore** (forward.rs:509-511, :686-700); results flow through an
+  optional **reorder buffer** so iteration order is deterministic under
+  ``reproducible=True`` (PerisaDataOrderManager, forward.rs:396-468).
+- **BackwardEngine** (backward.rs:233-354): gradient updates are queued
+  and shipped to the embedding worker from background threads; the
+  staleness permit is released only after the update lands, giving the
+  same bounded-staleness semantics as the reference.
+
+``TrainCtx.train_step`` accepts the engine's :class:`LookedUpBatch` and
+routes its gradients through the batch's backward engine instead of
+updating synchronously.
+"""
+
+import heapq
+import itertools
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional
+
+from persia_tpu.data.batch import PersiaBatch
+from persia_tpu.logger import get_default_logger
+
+_logger = get_default_logger(__name__)
+
+_SENTINEL = object()
+
+
+@dataclass
+class LookedUpBatch:
+    """A batch whose embeddings have been fetched — ready for the jitted
+    dense step (reference: PersiaTrainingBatch, forward.rs:101-117)."""
+
+    batch: PersiaBatch
+    lookup: Dict[str, Any]
+    ref_id: Optional[int]
+    engine: Optional["ForwardEngine"] = None
+
+    @property
+    def requires_grad(self) -> bool:
+        return self.batch.requires_grad
+
+
+class BackwardEngine:
+    """Async gradient return path (reference backward.rs:233-354)."""
+
+    def __init__(self, worker, num_workers: int = 2,
+                 staleness_sem: Optional[threading.Semaphore] = None,
+                 loss_scale: float = 1.0):
+        self.worker = worker
+        self.staleness_sem = staleness_sem
+        self.loss_scale = loss_scale
+        self._q: "queue.Queue" = queue.Queue()
+        self._pending = 0
+        self._pending_cv = threading.Condition()
+        self._errors: List[BaseException] = []
+        self._threads = [
+            threading.Thread(target=self._run, daemon=True,
+                             name=f"backward-worker-{i}")
+            for i in range(num_workers)
+        ]
+        for t in self._threads:
+            t.start()
+
+    def submit(self, ref_id: int, grads: Dict[str, Any]):
+        if self._errors:
+            raise self._errors[0]
+        with self._pending_cv:
+            self._pending += 1
+        self._q.put((ref_id, grads))
+
+    def _run(self):
+        while True:
+            item = self._q.get()
+            if item is _SENTINEL:
+                return
+            ref_id, grads = item
+            try:
+                self.worker.update_gradients(ref_id, grads,
+                                             loss_scale=self.loss_scale)
+            except BaseException as e:  # propagate to the training thread
+                _logger.error("backward update failed: %s", e)
+                self._errors.append(e)
+            finally:
+                if self.staleness_sem is not None:
+                    self.staleness_sem.release()
+                with self._pending_cv:
+                    self._pending -= 1
+                    self._pending_cv.notify_all()
+
+    def flush(self, timeout: Optional[float] = None):
+        """Block until every queued update has been applied."""
+        with self._pending_cv:
+            ok = self._pending_cv.wait_for(
+                lambda: self._pending == 0, timeout=timeout
+            )
+        if not ok:
+            raise TimeoutError("backward engine flush timed out")
+        if self._errors:
+            raise self._errors[0]
+
+    def shutdown(self):
+        for _ in self._threads:
+            self._q.put(_SENTINEL)
+
+
+class ForwardEngine:
+    """Prefetching lookup pipeline (reference forward.rs:470-780)."""
+
+    def __init__(
+        self,
+        ctx,
+        num_workers: int = 8,
+        buffer_size: int = 10,
+        reproducible: bool = False,
+        embedding_staleness: Optional[int] = None,
+    ):
+        self.ctx = ctx
+        self.worker = ctx.worker
+        self.num_workers = num_workers
+        self.buffer_size = buffer_size
+        self.reproducible = reproducible
+        self.staleness_sem = (
+            threading.Semaphore(embedding_staleness)
+            if embedding_staleness is not None else None
+        )
+        self.backward = BackwardEngine(
+            self.worker, staleness_sem=self.staleness_sem
+        )
+
+    def run(self, batches: Iterator[PersiaBatch],
+            timeout_ms: int = 600_000) -> Iterator[LookedUpBatch]:
+        timeout = timeout_ms / 1000.0
+        in_q: "queue.Queue" = queue.Queue(maxsize=self.buffer_size)
+        out_q: "queue.Queue" = queue.Queue(maxsize=self.buffer_size)
+        errors: List[BaseException] = []
+        n_workers = 1 if self.reproducible else self.num_workers
+        seq_counter = itertools.count()
+
+        def feeder():
+            try:
+                for batch in batches:
+                    # Acquire the staleness permit HERE, in sequence order.
+                    # Acquiring inside the racing lookup workers can
+                    # deadlock with the output reorder buffer: permits all
+                    # held by out-of-order batches while the next-needed
+                    # sequence waits for a permit.
+                    if batch.requires_grad and self.staleness_sem is not None:
+                        self.staleness_sem.acquire()
+                    in_q.put((next(seq_counter), batch))
+            except BaseException as e:
+                errors.append(e)
+            finally:
+                for _ in range(n_workers):
+                    in_q.put(_SENTINEL)
+
+        def lookup_worker():
+            while True:
+                item = in_q.get()
+                if item is _SENTINEL:
+                    out_q.put(_SENTINEL)
+                    return
+                seq, batch = item
+                try:
+                    if batch.requires_grad:
+                        ref_id = self.worker.put_batch(batch.id_type_features)
+                        lookup = self.worker.lookup(ref_id, training=True)
+                    else:
+                        ref_id = None
+                        lookup = self.worker.lookup_direct(
+                            batch.id_type_features, training=False
+                        )
+                    out_q.put((seq, LookedUpBatch(batch, lookup, ref_id, self)))
+                except BaseException as e:
+                    errors.append(e)
+                    out_q.put(_SENTINEL)
+                    return
+
+        threads = [threading.Thread(target=feeder, daemon=True,
+                                    name="forward-feeder")]
+        threads += [
+            threading.Thread(target=lookup_worker, daemon=True,
+                             name=f"forward-worker-{i}")
+            for i in range(n_workers)
+        ]
+        for t in threads:
+            t.start()
+
+        finished_workers = 0
+        if self.reproducible:
+            # single ordered worker: results arrive in sequence already
+            while True:
+                item = out_q.get(timeout=timeout)
+                if item is _SENTINEL:
+                    break
+                yield item[1]
+        else:
+            # reorder by seq so iteration order is stable even with
+            # concurrent workers (cheap; determinism of *updates* still
+            # requires staleness=1)
+            heap: list = []
+            next_seq = 0
+            while finished_workers < n_workers:
+                item = out_q.get(timeout=timeout)
+                if item is _SENTINEL:
+                    finished_workers += 1
+                    continue
+                heapq.heappush(heap, item)
+                while heap and heap[0][0] == next_seq:
+                    _, lb = heapq.heappop(heap)
+                    next_seq += 1
+                    yield lb
+            while heap:
+                _, lb = heapq.heappop(heap)
+                yield lb
+        if errors:
+            raise errors[0]
+
+    def flush(self, timeout: Optional[float] = None):
+        self.backward.flush(timeout=timeout)
+
+    def shutdown(self):
+        self.backward.shutdown()

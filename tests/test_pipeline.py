@@ -1,0 +1,98 @@
+"""ForwardEngine / BackwardEngine / DataLoader pipeline tests."""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "examples" / "adult_income"))
+
+import train as adult_income  # noqa: E402
+from data_generator import batches  # noqa: E402
+
+from persia_tpu.data.dataloader import DataLoader, IterableDataset  # noqa: E402
+from persia_tpu.pipeline import ForwardEngine, LookedUpBatch  # noqa: E402
+
+
+def test_dataloader_pipelined_training_learns():
+    ctx = adult_income.build_ctx(seed=11)
+    loader = DataLoader(
+        IterableDataset(batches(100 * 256, 256, seed=2)),
+        num_workers=4,
+        embedding_staleness=4,
+    )
+    losses = []
+    with ctx:
+        for lb in loader:
+            assert isinstance(lb, LookedUpBatch)
+            loss, _ = ctx.train_step(lb)
+            losses.append(float(loss))
+    assert len(losses) == 100
+    assert np.mean(losses[-10:]) < np.mean(losses[:10])
+    # all async updates flushed, staleness back to zero
+    assert ctx.worker.staleness == 0
+
+
+def test_reproducible_mode_matches_sync_exactly():
+    """reproducible=True + staleness=1 must equal the synchronous path
+    bit for bit (the reference's deterministic e2e setup,
+    examples train.py:149-154)."""
+
+    def run_sync():
+        ctx = adult_income.build_ctx(seed=5)
+        losses = []
+        with ctx:
+            for b in batches(12 * 128, 128, seed=9):
+                loss, _ = ctx.train_step(b)
+                losses.append(float(loss))
+        return losses
+
+    def run_pipelined():
+        ctx = adult_income.build_ctx(seed=5)
+        loader = DataLoader(
+            IterableDataset(batches(12 * 128, 128, seed=9)),
+            num_workers=4,
+            reproducible=True,
+            embedding_staleness=1,
+        )
+        losses = []
+        with ctx:
+            for lb in loader:
+                loss, _ = ctx.train_step(lb)
+                losses.append(float(loss))
+        return losses
+
+    assert run_sync() == run_pipelined()
+
+
+def test_forward_engine_preserves_order_and_eval_batches():
+    ctx = adult_income.build_ctx(seed=3)
+    with ctx:
+        engine = ForwardEngine(ctx, num_workers=4)
+        out = list(engine.run(batches(8 * 64, 64, seed=4,
+                                      requires_grad=False)))
+        assert [lb.batch.batch_id for lb in out] == list(range(8))
+        assert all(lb.ref_id is None for lb in out)
+        engine.shutdown()
+
+
+def test_backward_engine_propagates_errors():
+    ctx = adult_income.build_ctx(seed=3)
+    with ctx:
+        engine = ForwardEngine(ctx, num_workers=1)
+        engine.backward.submit(424242, {})  # unknown ref_id
+        with pytest.raises(KeyError):
+            engine.flush(timeout=10)
+        engine.shutdown()
+
+
+def test_dataset_buffer_and_producer_error():
+    class Boom:
+        def __iter__(self):
+            yield from batches(2 * 32, 32)
+            raise RuntimeError("boom")
+
+    ds = IterableDataset(Boom(), buffer_size=2)
+    with pytest.raises(RuntimeError, match="boom"):
+        list(ds)
