@@ -167,7 +167,10 @@ class Store {
       uint32_t s = internal_shard_of(sign, num_shards_);
       std::lock_guard<std::mutex> lk(*locks_[s]);
       Entry* e = shards_[s]->get(sign);
-      if (e == nullptr || e->dim != dim) {
+      // width check also skips entries created under a different
+      // optimizer's state layout (would read past the vector otherwise)
+      if (e == nullptr || e->dim != dim ||
+          e->vec.size() != dim + optimizer_->require_space(dim)) {
         ++misses;
         continue;
       }

@@ -201,6 +201,10 @@ class PersiaBatch:
         self.requires_grad = requires_grad
         self.meta = meta
         self.batch_size = batch_size
+        # (worker_addr, ref_id) when this batch's ID features were already
+        # ingested into a remote embedding worker by a data-loader
+        # (reference: IDTypeFeatureRemoteRef, persia-common/src/lib.rs:115-155)
+        self.remote_ref = None
 
     # --- wire format -----------------------------------------------------
 

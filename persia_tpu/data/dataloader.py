@@ -75,9 +75,9 @@ class StreamingDataset(IterableDatasetBase):
     blocks on the network queue forever (training-stream semantics).
     """
 
-    def __init__(self, buffer_size: int = 128):
+    def __init__(self, receiver=None, buffer_size: int = 128):
         super().__init__(buffer_size)
-        self._receiver = None  # persia_tpu.service.dataflow.DataflowReceiver
+        self._receiver = receiver  # persia_tpu.service.dataflow.DataflowReceiver
 
     def bind_receiver(self, receiver):
         self._receiver = receiver
@@ -86,13 +86,14 @@ class StreamingDataset(IterableDatasetBase):
         if self._receiver is None:
             raise RuntimeError(
                 "StreamingDataset not bound to a dataflow receiver; "
-                "enter a TrainCtx/EmbeddingCtx first"
+                "construct it with a persia_tpu.service.dataflow."
+                "DataflowReceiver (or call bind_receiver)"
             )
         while True:
-            payload = self._receiver.get()
-            if payload is None:
+            batch = self._receiver.get()
+            if batch is None:
                 return
-            yield PersiaBatch.from_bytes(payload)
+            yield batch
 
 
 class DataLoader:

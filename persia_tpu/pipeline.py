@@ -172,7 +172,15 @@ class ForwardEngine:
                     return
                 seq, batch = item
                 try:
-                    if batch.requires_grad:
+                    rref = getattr(batch, "remote_ref", None)
+                    if rref is not None:
+                        # ID features already live in a worker's forward
+                        # buffer (sent by a remote data-loader)
+                        ref_id = rref if batch.requires_grad else None
+                        lookup = self.worker.lookup(
+                            rref, training=batch.requires_grad
+                        )
+                    elif batch.requires_grad:
                         ref_id = self.worker.put_batch(batch.id_type_features)
                         lookup = self.worker.lookup(ref_id, training=True)
                     else:

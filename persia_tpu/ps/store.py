@@ -228,7 +228,10 @@ class EmbeddingHolder:
             with self._locks[shard_idx]:
                 for pos in sel:
                     entry = shard.get(int(signs[pos]))
-                    if entry is not None and entry[0] == dim:
+                    # width check also skips entries created under a
+                    # different optimizer's state layout
+                    if entry is not None and entry[0] == dim and \
+                            len(entry[1]) == width:
                         found_pos.append(pos)
                         found_entries.append(entry[1])
                     else:

@@ -1,0 +1,241 @@
+"""Length-prefixed TCP RPC — the data plane.
+
+Replaces the reference's hyper-HTTP RPC with speedy + lz4
+(rust/others/persia-rpc/src/lib.rs:68-145). Wire format per message:
+
+    u32 frame_len | u8 flags | msgpack envelope | raw payload
+
+Envelope: ``[method, payload_len]`` for requests, ``[status, payload_len]``
+for responses; the payload is raw bytes (numpy buffers travel uncopied
+into the socket). flags bit 0 = payload is zstd-compressed (mirrors the
+reference's ``_compressed`` method variants).
+
+Numpy arrays are framed with :func:`pack_arrays` / :func:`unpack_arrays`.
+The server runs a thread per connection (clients hold few, long-lived
+connections — trainers and workers, not end users).
+"""
+
+import socket
+import struct
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+import msgpack
+import numpy as np
+
+try:
+    import zstandard
+
+    _ZSTD_C = zstandard.ZstdCompressor(level=3)
+    _ZSTD_D = zstandard.ZstdDecompressor()
+except ImportError:  # pragma: no cover
+    zstandard = None
+
+_FLAG_COMPRESSED = 1
+COMPRESS_THRESHOLD = 1 << 16
+
+
+class RpcError(RuntimeError):
+    pass
+
+
+def pack_arrays(meta: dict, arrays: List[np.ndarray]) -> bytes:
+    """Frame a small msgpack meta dict + a list of numpy arrays."""
+    heads = []
+    bufs = []
+    for a in arrays:
+        a = np.ascontiguousarray(a)
+        heads.append((str(a.dtype), list(a.shape)))
+        bufs.append(a.tobytes())
+    head = msgpack.packb({"m": meta, "a": heads}, use_bin_type=True)
+    out = [struct.pack("<I", len(head)), head]
+    out.extend(bufs)
+    return b"".join(out)
+
+
+def unpack_arrays(payload: bytes) -> Tuple[dict, List[np.ndarray]]:
+    (head_len,) = struct.unpack_from("<I", payload, 0)
+    head = msgpack.unpackb(payload[4 : 4 + head_len], raw=False)
+    arrays = []
+    pos = 4 + head_len
+    for dtype, shape in head["a"]:
+        dt = np.dtype(dtype)
+        n = int(np.prod(shape)) if shape else 1
+        arr = np.frombuffer(payload, dtype=dt, count=n, offset=pos).reshape(shape)
+        pos += n * dt.itemsize
+        arrays.append(arr)
+    return head["m"], arrays
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            raise ConnectionError("socket closed")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def _send_msg(sock: socket.socket, envelope: list, payload: bytes,
+              compress: bool):
+    flags = 0
+    if compress and zstandard is not None and len(payload) > COMPRESS_THRESHOLD:
+        payload = _ZSTD_C.compress(payload)
+        flags |= _FLAG_COMPRESSED
+    env = msgpack.packb(envelope + [len(payload)], use_bin_type=True)
+    # frame_len counts everything after the u32: flags+env_len fields (3
+    # bytes, already consumed by the fixed 7-byte header read) + env + payload
+    frame_len = 3 + len(env) + len(payload)
+    header = struct.pack("<IBH", frame_len, flags, len(env))
+    sock.sendall(header + env + payload)
+
+
+def _recv_msg(sock: socket.socket) -> Tuple[list, bytes]:
+    head = _recv_exact(sock, 7)
+    frame_len, flags, env_len = struct.unpack("<IBH", head)
+    body = _recv_exact(sock, frame_len - 3)
+    env = msgpack.unpackb(body[:env_len], raw=False)
+    payload = body[env_len:]
+    if flags & _FLAG_COMPRESSED:
+        if zstandard is None:  # pragma: no cover
+            raise RpcError("compressed payload but zstandard unavailable")
+        payload = _ZSTD_D.decompress(payload)
+    return env, payload
+
+
+class RpcServer:
+    """Thread-per-connection RPC server with named handlers.
+
+    Handlers take ``(payload: bytes) -> bytes`` and run concurrently;
+    state they touch must be internally synchronized (the stores are).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._handlers: Dict[str, Callable[[bytes], bytes]] = {}
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(128)
+        self.addr = f"{host}:{self._sock.getsockname()[1]}"
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        self._shutdown_cb: Optional[Callable[[], None]] = None
+
+    def register(self, name: str, fn: Callable[[bytes], bytes]):
+        self._handlers[name] = fn
+
+    def on_shutdown(self, cb: Callable[[], None]):
+        self._shutdown_cb = cb
+
+    def serve_background(self):
+        self._running = True
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True,
+                                        name=f"rpc-server-{self.addr}")
+        self._thread.start()
+
+    def serve_forever(self):
+        self._running = True
+        self._accept_loop()
+
+    def _accept_loop(self):
+        self._sock.settimeout(0.5)
+        while self._running:
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket):
+        with conn:
+            while self._running:
+                try:
+                    env, payload = _recv_msg(conn)
+                except (ConnectionError, OSError):
+                    return
+                method = env[0]
+                try:
+                    if method == "__shutdown__":
+                        _send_msg(conn, ["ok"], b"", False)
+                        self.stop()
+                        if self._shutdown_cb is not None:
+                            self._shutdown_cb()
+                        return
+                    handler = self._handlers.get(method)
+                    if handler is None:
+                        raise RpcError(f"no such method {method!r}")
+                    result = handler(payload)
+                    _send_msg(conn, ["ok"], result, True)
+                except BaseException as e:
+                    try:
+                        _send_msg(conn, ["err", f"{type(e).__name__}: {e}"],
+                                  b"", False)
+                    except OSError:
+                        return
+
+    def stop(self):
+        self._running = False
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class RpcClient:
+    """Blocking client with one pooled connection per thread."""
+
+    def __init__(self, addr: str, timeout: float = 60.0):
+        self.addr = addr
+        host, port = addr.rsplit(":", 1)
+        self._target = (host, int(port))
+        self.timeout = timeout
+        self._local = threading.local()
+
+    def _conn(self) -> socket.socket:
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = socket.create_connection(self._target, timeout=self.timeout)
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._local.conn = conn
+        return conn
+
+    def call(self, method: str, payload: bytes = b"") -> bytes:
+        try:
+            conn = self._conn()
+            _send_msg(conn, [method], payload, True)
+            env, result = _recv_msg(conn)
+        except (ConnectionError, OSError):
+            # one reconnect attempt (server may have restarted)
+            self._local.conn = None
+            conn = self._conn()
+            _send_msg(conn, [method], payload, True)
+            env, result = _recv_msg(conn)
+        if env[0] != "ok":
+            raise RpcError(f"{self.addr} {method}: {env[1]}")
+        return result
+
+    def call_msg(self, method: str, **kwargs) -> dict:
+        """msgpack-dict convenience call."""
+        result = self.call(method, msgpack.packb(kwargs, use_bin_type=True))
+        return msgpack.unpackb(result, raw=False) if result else {}
+
+    def shutdown_server(self):
+        try:
+            self.call("__shutdown__")
+        except (RpcError, ConnectionError, OSError):
+            pass
+
+    def close(self):
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            self._local.conn = None

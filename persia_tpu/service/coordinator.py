@@ -1,0 +1,154 @@
+"""Coordinator: service discovery + rendezvous control plane.
+
+Plays the role NATS plays in the reference (persia-nats-client subject
+scheme, master discovery in persia-core/src/nats.rs:22-100, address
+polling in embedding_worker_service/mod.rs:139-339): a tiny in-memory
+registry behind the TCP RPC. Services register ``(role, replica_index,
+addr)``; clients poll until the expected replica count is present. A
+kv namespace covers master-addr rendezvous and optimizer broadcast.
+
+Run: ``python -m persia_tpu.service.coordinator --port 23333``
+"""
+
+import argparse
+import threading
+import time
+from typing import Dict, Tuple
+
+import msgpack
+
+from persia_tpu.logger import get_default_logger
+from persia_tpu.rpc import RpcClient, RpcServer
+
+_logger = get_default_logger(__name__)
+
+ROLE_PS = "embedding-parameter-server"
+ROLE_WORKER = "embedding-worker"
+ROLE_TRAINER = "nn-worker"
+ROLE_DATALOADER = "data-loader"
+
+
+class Coordinator:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._lock = threading.Lock()
+        # role -> {replica_index: addr}
+        self._services: Dict[str, Dict[int, str]] = {}
+        self._kv: Dict[str, bytes] = {}
+        self.server = RpcServer(host, port)
+        self.server.register("register", self._register)
+        self.server.register("deregister", self._deregister)
+        self.server.register("list", self._list)
+        self.server.register("kv_put", self._kv_put)
+        self.server.register("kv_get", self._kv_get)
+        self.server.register("ping", lambda p: b"pong")
+
+    @property
+    def addr(self) -> str:
+        return self.server.addr
+
+    def _register(self, payload: bytes) -> bytes:
+        req = msgpack.unpackb(payload, raw=False)
+        with self._lock:
+            self._services.setdefault(req["role"], {})[req["replica_index"]] = (
+                req["addr"]
+            )
+        _logger.info("registered %s[%d] at %s", req["role"],
+                     req["replica_index"], req["addr"])
+        return b""
+
+    def _deregister(self, payload: bytes) -> bytes:
+        req = msgpack.unpackb(payload, raw=False)
+        with self._lock:
+            self._services.get(req["role"], {}).pop(req["replica_index"], None)
+        return b""
+
+    def _list(self, payload: bytes) -> bytes:
+        req = msgpack.unpackb(payload, raw=False)
+        with self._lock:
+            members = self._services.get(req["role"], {})
+            addrs = [members[i] for i in sorted(members)]
+        return msgpack.packb({"addrs": addrs}, use_bin_type=True)
+
+    def _kv_put(self, payload: bytes) -> bytes:
+        req = msgpack.unpackb(payload, raw=False)
+        with self._lock:
+            self._kv[req["key"]] = req["value"]
+        return b""
+
+    def _kv_get(self, payload: bytes) -> bytes:
+        req = msgpack.unpackb(payload, raw=False)
+        with self._lock:
+            value = self._kv.get(req["key"])
+        return msgpack.packb({"value": value}, use_bin_type=True)
+
+
+class CoordinatorClient:
+    """Client with the exponential-backoff wait patterns the reference
+    uses on every NATS call (nats.rs:77-95, :163-203)."""
+
+    def __init__(self, addr: str):
+        self.client = RpcClient(addr)
+
+    def register(self, role: str, replica_index: int, addr: str):
+        self.client.call_msg("register", role=role,
+                             replica_index=replica_index, addr=addr)
+
+    def deregister(self, role: str, replica_index: int):
+        self.client.call_msg("deregister", role=role,
+                             replica_index=replica_index)
+
+    def list(self, role: str):
+        return self.client.call_msg("list", role=role)["addrs"]
+
+    def wait_members(self, role: str, count: int, timeout: float = 60.0):
+        """Poll until `count` replicas of `role` registered."""
+        deadline = time.monotonic() + timeout
+        delay = 0.05
+        while True:
+            addrs = self.list(role)
+            if len(addrs) >= count:
+                return addrs
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"waited {timeout}s for {count} x {role}, have {addrs}"
+                )
+            time.sleep(delay)
+            delay = min(delay * 2, 1.0)
+
+    def kv_put(self, key: str, value: bytes):
+        self.client.call_msg("kv_put", key=key, value=value)
+
+    def kv_get(self, key: str):
+        return self.client.call_msg("kv_get", key=key)["value"]
+
+    def wait_kv(self, key: str, timeout: float = 60.0) -> bytes:
+        deadline = time.monotonic() + timeout
+        delay = 0.05
+        while True:
+            v = self.kv_get(key)
+            if v is not None:
+                return v
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"waited {timeout}s for kv key {key!r}")
+            time.sleep(delay)
+            delay = min(delay * 2, 1.0)
+
+    def ping(self) -> bool:
+        try:
+            return self.client.call("ping") == b"pong"
+        except Exception:
+            return False
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=23333)
+    args = p.parse_args()
+    coord = Coordinator(args.host, args.port)
+    _logger.info("coordinator listening on %s", coord.addr)
+    coord.server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,107 @@
+"""Dataflow: batch routing from data-loaders to workers and trainers.
+
+The reference pushes ID features to embedding workers and the rest of the
+batch to nn-workers over NATS, routed by ``batch_id % world_size``
+(persia-core/src/nats.rs:145-353). Here:
+
+- :class:`DataflowClient` (data-loader side): ingests the batch's ID
+  features into a worker replica (round-robin, with backoff-retry on
+  ``ForwardBufferFull`` — reference nats.rs:267-291), then ships the
+  batch + its ``(worker_addr, ref_id)`` handle to the owning trainer.
+- :class:`DataflowReceiver` (trainer side): a tiny RPC endpoint feeding a
+  bounded queue that :class:`~persia_tpu.data.dataloader.StreamingDataset`
+  drains (reference: DataflowService, nats.rs:102-140).
+"""
+
+import queue
+import time
+from typing import List, Optional, Sequence
+
+import msgpack
+
+from persia_tpu.data.batch import PersiaBatch
+from persia_tpu.logger import get_default_logger
+from persia_tpu.rpc import RpcClient, RpcError, RpcServer
+
+_logger = get_default_logger(__name__)
+
+_EOS = object()
+
+
+class DataflowReceiver:
+    """Trainer-side ingestion endpoint."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 buffer_size: int = 128):
+        self._q: "queue.Queue" = queue.Queue(maxsize=buffer_size)
+        self.server = RpcServer(host, port)
+        self.server.register("enqueue_batch", self._enqueue)
+        self.server.register("end_of_stream", self._eos)
+        self.server.serve_background()
+
+    @property
+    def addr(self) -> str:
+        return self.server.addr
+
+    def _enqueue(self, payload: bytes) -> bytes:
+        head_len = int.from_bytes(payload[:4], "little")
+        head = msgpack.unpackb(payload[4 : 4 + head_len], raw=False)
+        batch = PersiaBatch.from_bytes(payload[4 + head_len :])
+        if head.get("worker_addr") is not None:
+            batch.remote_ref = (head["worker_addr"], head["ref_id"])
+        self._q.put(batch)
+        return b""
+
+    def _eos(self, payload: bytes) -> bytes:
+        self._q.put(_EOS)
+        return b""
+
+    def get(self, timeout: Optional[float] = None) -> Optional[PersiaBatch]:
+        item = self._q.get(timeout=timeout)
+        return None if item is _EOS else item
+
+    def close(self):
+        self.server.stop()
+
+
+class DataflowClient:
+    """Data-loader side: worker ingestion + trainer routing."""
+
+    def __init__(self, worker, trainer_addrs: Sequence[str],
+                 max_retries: int = 60):
+        self.worker = worker
+        self.trainer_addrs = list(trainer_addrs)
+        self._trainers = [RpcClient(a) for a in self.trainer_addrs]
+        self.max_retries = max_retries
+
+    def send(self, batch: PersiaBatch):
+        ref = None
+        if batch.requires_grad:
+            delay = 0.05
+            for attempt in range(self.max_retries):
+                try:
+                    ref = self.worker.put_batch(batch.id_type_features)
+                    break
+                except RpcError as e:
+                    if "ForwardBufferFull" not in str(e):
+                        raise
+                    time.sleep(delay)
+                    delay = min(delay * 2, 2.0)
+            else:
+                raise TimeoutError("embedding workers stayed full")
+        head = msgpack.packb(
+            {
+                "worker_addr": ref[0] if ref else None,
+                "ref_id": ref[1] if ref else None,
+            },
+            use_bin_type=True,
+        )
+        payload = len(head).to_bytes(4, "little") + head + batch.to_bytes()
+        trainer = self._trainers[
+            (batch.batch_id or 0) % len(self._trainers)
+        ]
+        trainer.call("enqueue_batch", payload)
+
+    def send_eos(self):
+        for t in self._trainers:
+            t.call("end_of_stream")

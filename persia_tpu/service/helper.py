@@ -1,0 +1,197 @@
+"""Cluster-in-a-box: spawn a full persia_tpu service topology locally.
+
+The reference's key test trick (persia/helper.py:125-327): a context
+manager that launches the real service binaries as subprocesses —
+coordinator + N embedding-workers + M parameter-servers — on free ports,
+monitors them for crashes, and tears the group down on exit. Integration
+tests drive a genuine multi-process cluster over real sockets inside one
+pytest.
+"""
+
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import List, Optional
+
+from persia_tpu.config import EmbeddingSchema
+from persia_tpu.logger import get_default_logger
+from persia_tpu.service.coordinator import (
+    ROLE_PS,
+    ROLE_WORKER,
+    CoordinatorClient,
+)
+from persia_tpu.utils import dump_yaml, find_free_port
+
+_logger = get_default_logger(__name__)
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _schema_to_yaml_dict(schema: EmbeddingSchema) -> dict:
+    """Serialize a schema for the worker subprocess; prefix assignment is
+    deterministic (sorted group names), so reconstruction matches."""
+    return {
+        "feature_index_prefix_bit": schema.feature_index_prefix_bit,
+        "feature_groups": {
+            g: list(slots) for g, slots in schema.feature_groups.items()
+        },
+        "slots_config": {
+            name: {
+                "dim": s.dim,
+                "sample_fixed_size": s.sample_fixed_size,
+                "embedding_summation": s.embedding_summation,
+                "sqrt_scaling": s.sqrt_scaling,
+                "hash_stack_config": {
+                    "hash_stack_rounds": s.hash_stack_config.hash_stack_rounds,
+                    "embedding_size": s.hash_stack_config.embedding_size,
+                },
+            }
+            for name, s in schema.slots_config.items()
+        },
+    }
+
+
+class ServiceCtx:
+    """Launch coordinator + PS + worker subprocesses; join as a client.
+
+    Usage::
+
+        with ServiceCtx(schema, n_workers=1, n_ps=2) as svc:
+            worker = svc.remote_worker()     # RemoteEmbeddingWorker
+            ...
+    """
+
+    def __init__(
+        self,
+        schema: EmbeddingSchema,
+        n_workers: int = 1,
+        n_ps: int = 1,
+        global_config_path: Optional[str] = None,
+        env: Optional[dict] = None,
+        startup_timeout: float = 120.0,
+    ):
+        self.schema = schema
+        self.n_workers = n_workers
+        self.n_ps = n_ps
+        self.global_config_path = global_config_path
+        self.extra_env = env or {}
+        self.startup_timeout = startup_timeout
+        self.procs: List[subprocess.Popen] = []
+        self.coordinator_addr: Optional[str] = None
+        self.worker_addrs: List[str] = []
+        self.ps_addrs: List[str] = []
+        self._tmpdir: Optional[tempfile.TemporaryDirectory] = None
+        self._monitor: Optional[threading.Thread] = None
+        self._closing = False
+        self.crashed: List[str] = []
+
+    def _spawn(self, args: List[str], name: str, replica_index: int,
+               replica_size: int) -> subprocess.Popen:
+        env = dict(os.environ)
+        env["PYTHONPATH"] = _REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+        env["REPLICA_INDEX"] = str(replica_index)
+        env["REPLICA_SIZE"] = str(replica_size)
+        if self.coordinator_addr:
+            env["PERSIA_COORDINATOR_ADDR"] = self.coordinator_addr
+        env.update({k: str(v) for k, v in self.extra_env.items()})
+        proc = subprocess.Popen([sys.executable, *args], env=env)
+        proc._persia_name = name  # type: ignore[attr-defined]
+        self.procs.append(proc)
+        return proc
+
+    def __enter__(self) -> "ServiceCtx":
+        self._tmpdir = tempfile.TemporaryDirectory(prefix="persia_svc_")
+        schema_path = os.path.join(self._tmpdir.name, "embedding_config.yml")
+        raw = _schema_to_yaml_dict(self.schema)
+        dump_yaml(raw, schema_path)
+
+        port = find_free_port()
+        self.coordinator_addr = f"127.0.0.1:{port}"
+        self._spawn(["-m", "persia_tpu.service.coordinator", "--port",
+                     str(port)], "coordinator", 0, 1)
+        coord = CoordinatorClient(self.coordinator_addr)
+        deadline = time.monotonic() + self.startup_timeout
+        while not coord.ping():
+            if time.monotonic() > deadline:
+                self.__exit__(None, None, None)
+                raise TimeoutError("coordinator did not come up")
+            time.sleep(0.05)
+
+        for i in range(self.n_ps):
+            args = ["-m", "persia_tpu.service.ps_service",
+                    "--replica-index", str(i),
+                    "--replica-size", str(self.n_ps),
+                    "--coordinator", self.coordinator_addr]
+            if self.global_config_path:
+                args += ["--global-config", self.global_config_path]
+            self._spawn(args, f"ps-{i}", i, self.n_ps)
+        for i in range(self.n_workers):
+            args = ["-m", "persia_tpu.service.worker_service",
+                    "--replica-index", str(i),
+                    "--replica-size", str(self.n_workers),
+                    "--coordinator", self.coordinator_addr,
+                    "--embedding-config", schema_path,
+                    "--num-ps", str(self.n_ps)]
+            if self.global_config_path:
+                args += ["--global-config", self.global_config_path]
+            self._spawn(args, f"worker-{i}", i, self.n_workers)
+
+        try:
+            self.ps_addrs = coord.wait_members(ROLE_PS, self.n_ps,
+                                               self.startup_timeout)
+            self.worker_addrs = coord.wait_members(ROLE_WORKER, self.n_workers,
+                                                   self.startup_timeout)
+        except TimeoutError:
+            self.__exit__(None, None, None)
+            raise
+        self._monitor = threading.Thread(target=self._watch, daemon=True,
+                                         name="service-ctx-monitor")
+        self._monitor.start()
+        _logger.info("cluster up: coordinator=%s ps=%s workers=%s",
+                     self.coordinator_addr, self.ps_addrs, self.worker_addrs)
+        return self
+
+    def _watch(self):
+        """Kill the whole group if any child crashes
+        (reference helper.py:296-315)."""
+        while not self._closing:
+            for p in self.procs:
+                rc = p.poll()
+                if rc is not None and rc != 0 and not self._closing:
+                    name = getattr(p, "_persia_name", "?")
+                    self.crashed.append(f"{name} rc={rc}")
+                    _logger.error("service %s crashed (rc=%d); tearing down",
+                                  name, rc)
+                    self._terminate_all()
+                    return
+            time.sleep(0.2)
+
+    def remote_worker(self):
+        from persia_tpu.service.worker_service import RemoteEmbeddingWorker
+
+        w = RemoteEmbeddingWorker(self.worker_addrs)
+        w.schema = self.schema
+        return w
+
+    def coordinator_client(self) -> CoordinatorClient:
+        return CoordinatorClient(self.coordinator_addr)
+
+    def _terminate_all(self):
+        for p in self.procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in self.procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self._closing = True
+        self._terminate_all()
+        if self._tmpdir is not None:
+            self._tmpdir.cleanup()
+        return False

@@ -1,0 +1,262 @@
+"""Embedding parameter-server service + its RPC client.
+
+Service binary for one PS replica (reference:
+src/bin/persia-embedding-parameter-server.rs + the RPC surface of
+embedding_parameter_service/mod.rs:491-593). Wraps the fastest available
+store backend (C++ native, numpy fallback) behind the TCP RPC; registers
+itself with the coordinator; in Infer mode loads the initial sparse
+checkpoint at boot (reference: bin rs:108-116).
+
+Run: ``python -m persia_tpu.service.ps_service --port 0 --replica-index 0
+--replica-size 2 [--coordinator 127.0.0.1:23333]``
+
+``PsClient`` exposes the in-process holder interface (configure /
+register_optimizer / lookup / update_gradients / ...), so an
+:class:`~persia_tpu.worker.worker.EmbeddingWorker` runs over the network
+without code changes.
+"""
+
+import argparse
+import os
+import threading
+from typing import Optional
+
+import msgpack
+import numpy as np
+
+from persia_tpu.logger import get_default_logger
+from persia_tpu.rpc import RpcClient, RpcServer, pack_arrays, unpack_arrays
+from persia_tpu.service.coordinator import ROLE_PS, CoordinatorClient
+
+_logger = get_default_logger(__name__)
+
+
+class PsService:
+    def __init__(self, holder, host: str = "127.0.0.1", port: int = 0):
+        self.holder = holder
+        self.server = RpcServer(host, port)
+        self.status = "Idle"  # Idle | Dumping | Loading | Failed (model mgr)
+        self._status_lock = threading.Lock()
+        s = self.server
+        s.register("configure", self._configure)
+        s.register("register_optimizer", self._register_optimizer)
+        s.register("lookup", self._lookup)
+        s.register("update_gradients", self._update_gradients)
+        s.register("len", self._len)
+        s.register("get_entry", self._get_entry)
+        s.register("set_entry", self._set_entry)
+        s.register("clear", self._clear)
+        s.register("dump", self._dump)
+        s.register("load", self._load)
+        s.register("status", self._status)
+        s.register("ready_for_serving", self._ready)
+
+    @property
+    def addr(self):
+        return self.server.addr
+
+    def _configure(self, payload: bytes) -> bytes:
+        req = msgpack.unpackb(payload, raw=False)
+        self.holder.configure(
+            req["init_method"], req["init_params"],
+            admit_probability=req["admit_probability"],
+            weight_bound=req["weight_bound"],
+            enable_weight_bound=req["enable_weight_bound"],
+        )
+        return b""
+
+    def _register_optimizer(self, payload: bytes) -> bytes:
+        req = msgpack.unpackb(payload, raw=False)
+        self.holder.register_optimizer(
+            req["config"],
+            feature_index_prefix_bit=req["feature_index_prefix_bit"],
+        )
+        return b""
+
+    def _lookup(self, payload: bytes) -> bytes:
+        meta, (signs,) = unpack_arrays(payload)
+        out = self.holder.lookup(signs, meta["dim"], meta["training"])
+        return pack_arrays({}, [out])
+
+    def _update_gradients(self, payload: bytes) -> bytes:
+        meta, (signs, grads) = unpack_arrays(payload)
+        self.holder.update_gradients(signs, grads, meta["dim"])
+        return b""
+
+    def _len(self, payload: bytes) -> bytes:
+        return msgpack.packb({"len": len(self.holder)})
+
+    def _get_entry(self, payload: bytes) -> bytes:
+        req = msgpack.unpackb(payload, raw=False)
+        entry = self.holder.get_entry(req["sign"])
+        if entry is None:
+            return pack_arrays({"found": False, "dim": 0}, [])
+        dim, vec = entry
+        return pack_arrays({"found": True, "dim": dim}, [vec])
+
+    def _set_entry(self, payload: bytes) -> bytes:
+        meta, (vec,) = unpack_arrays(payload)
+        self.holder.set_entry(meta["sign"], meta["dim"], vec)
+        return b""
+
+    def _clear(self, payload: bytes) -> bytes:
+        self.holder.clear()
+        return b""
+
+    def _set_status(self, status: str):
+        with self._status_lock:
+            self.status = status
+
+    def _dump(self, payload: bytes) -> bytes:
+        req = msgpack.unpackb(payload, raw=False)
+        self._set_status("Dumping")
+
+        def run():
+            try:
+                self.holder.dump_file(req["path"])
+                self._set_status("Idle")
+            except BaseException as e:  # recorded for status polling
+                _logger.error("dump failed: %s", e)
+                self._set_status(f"Failed: {e}")
+
+        if req.get("blocking", True):
+            run()
+        else:
+            threading.Thread(target=run, daemon=True).start()
+        return b""
+
+    def _load(self, payload: bytes) -> bytes:
+        req = msgpack.unpackb(payload, raw=False)
+        self._set_status("Loading")
+
+        def run():
+            try:
+                self.holder.load_file(req["path"], clear=req.get("clear", True))
+                self._set_status("Idle")
+            except BaseException as e:
+                _logger.error("load failed: %s", e)
+                self._set_status(f"Failed: {e}")
+
+        if req.get("blocking", True):
+            run()
+        else:
+            threading.Thread(target=run, daemon=True).start()
+        return b""
+
+    def _status(self, payload: bytes) -> bytes:
+        with self._status_lock:
+            return msgpack.packb({"status": self.status})
+
+    def _ready(self, payload: bytes) -> bytes:
+        ready = (
+            getattr(self.holder, "optimizer", True) is not None
+            and self.status == "Idle"
+        )
+        return msgpack.packb({"ready": bool(ready)})
+
+
+class PsClient:
+    """RPC twin of the in-process holder interface."""
+
+    def __init__(self, addr: str):
+        self.addr = addr
+        self.client = RpcClient(addr)
+
+    def configure(self, init_method, init_params, admit_probability=1.0,
+                  weight_bound=10.0, enable_weight_bound=True):
+        self.client.call_msg(
+            "configure", init_method=init_method, init_params=init_params,
+            admit_probability=admit_probability, weight_bound=weight_bound,
+            enable_weight_bound=enable_weight_bound,
+        )
+
+    def register_optimizer(self, config: dict, feature_index_prefix_bit=0):
+        self.client.call_msg(
+            "register_optimizer", config=config,
+            feature_index_prefix_bit=feature_index_prefix_bit,
+        )
+
+    def lookup(self, signs: np.ndarray, dim: int, training: bool) -> np.ndarray:
+        payload = pack_arrays({"dim": int(dim), "training": bool(training)},
+                              [np.ascontiguousarray(signs, np.uint64)])
+        _, (out,) = unpack_arrays(self.client.call("lookup", payload))
+        return out.reshape(len(signs), dim)
+
+    def update_gradients(self, signs: np.ndarray, grads: np.ndarray, dim: int):
+        payload = pack_arrays({"dim": int(dim)}, [
+            np.ascontiguousarray(signs, np.uint64),
+            np.ascontiguousarray(grads, np.float32),
+        ])
+        self.client.call("update_gradients", payload)
+
+    def __len__(self) -> int:
+        return msgpack.unpackb(self.client.call("len"), raw=False)["len"]
+
+    def get_entry(self, sign: int):
+        payload = msgpack.packb({"sign": int(sign)}, use_bin_type=True)
+        meta, arrays = unpack_arrays(self.client.call("get_entry", payload))
+        if not meta["found"]:
+            return None
+        return meta["dim"], arrays[0]
+
+    def set_entry(self, sign: int, dim: int, vec: np.ndarray):
+        self.client.call("set_entry", pack_arrays(
+            {"sign": int(sign), "dim": int(dim)},
+            [np.ascontiguousarray(vec, np.float32)],
+        ))
+
+    def clear(self):
+        self.client.call("clear")
+
+    def dump_file(self, path: str, blocking: bool = True):
+        self.client.call_msg("dump", path=path, blocking=blocking)
+
+    def load_file(self, path: str, clear: bool = True, blocking: bool = True):
+        self.client.call_msg("load", path=path, clear=clear, blocking=blocking)
+
+    def model_manager_status(self) -> str:
+        return msgpack.unpackb(self.client.call("status"), raw=False)["status"]
+
+    def ready_for_serving(self) -> bool:
+        return msgpack.unpackb(self.client.call("ready_for_serving"),
+                               raw=False)["ready"]
+
+    def shutdown(self):
+        self.client.shutdown_server()
+
+
+def main():
+    from persia_tpu.config import GlobalConfig
+    from persia_tpu.ps.native import make_holder
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--replica-index", type=int,
+                   default=int(os.environ.get("REPLICA_INDEX", 0)))
+    p.add_argument("--replica-size", type=int,
+                   default=int(os.environ.get("REPLICA_SIZE", 1)))
+    p.add_argument("--coordinator",
+                   default=os.environ.get("PERSIA_COORDINATOR_ADDR"))
+    p.add_argument("--global-config", default=None)
+    p.add_argument("--initial-checkpoint", default=None)
+    args = p.parse_args()
+
+    gc = GlobalConfig.load(args.global_config) if args.global_config else GlobalConfig()
+    holder = make_holder(gc.parameter_server.capacity,
+                         gc.parameter_server.num_hashmap_internal_shards)
+    service = PsService(holder, args.host, args.port)
+    if args.initial_checkpoint:
+        holder.load_file(args.initial_checkpoint)
+        _logger.info("loaded initial checkpoint from %s",
+                     args.initial_checkpoint)
+    _logger.info("parameter server %d/%d listening on %s",
+                 args.replica_index, args.replica_size, service.addr)
+    if args.coordinator:
+        CoordinatorClient(args.coordinator).register(
+            ROLE_PS, args.replica_index, service.addr)
+    service.server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,244 @@
+"""Embedding-worker service + the remote worker client.
+
+Service binary for one embedding-worker replica (reference:
+src/bin/persia-embedding-worker.rs + the RPC surface of
+embedding_worker_service/mod.rs:1372-1561). Hosts an
+:class:`~persia_tpu.worker.worker.EmbeddingWorker` whose PS clients are
+:class:`~persia_tpu.service.ps_service.PsClient` RPC stubs discovered
+through the coordinator (with replica-count wait + backoff, mirroring
+AllEmbeddingServerClient, mod.rs:139-339).
+
+``RemoteEmbeddingWorker`` is the trainer/data-loader side: it exposes the
+same interface as the in-process EmbeddingWorker, with composite
+``(worker_addr, ref_id)`` handles so a fleet of worker replicas behaves
+like one object (round-robin ingestion like the reference's data-loader
+publisher, persia-core/src/nats.rs:250-312).
+
+Run: ``python -m persia_tpu.service.worker_service --replica-index 0
+--replica-size 1 --coordinator ... --embedding-config schema.yml``
+"""
+
+import argparse
+import itertools
+import os
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import msgpack
+import numpy as np
+
+from persia_tpu.config import EmbeddingSchema, GlobalConfig
+from persia_tpu.logger import get_default_logger
+from persia_tpu.rpc import RpcClient, RpcServer
+from persia_tpu.service import serialization as ser
+from persia_tpu.service.coordinator import (
+    ROLE_PS,
+    ROLE_WORKER,
+    CoordinatorClient,
+)
+from persia_tpu.service.ps_service import PsClient
+from persia_tpu.worker.worker import EmbeddingWorker, ForwardBufferFull
+
+_logger = get_default_logger(__name__)
+
+
+class WorkerService:
+    def __init__(self, worker: EmbeddingWorker, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.worker = worker
+        self.server = RpcServer(host, port)
+        s = self.server
+        s.register("forward_batched", self._forward_batched)
+        s.register("forward_batch_id", self._forward_batch_id)
+        s.register("forward_batched_direct", self._forward_batched_direct)
+        s.register("update_gradients", self._update_gradients)
+        s.register("configure", self._configure)
+        s.register("register_optimizer", self._register_optimizer)
+        s.register("dump", self._dump)
+        s.register("load", self._load)
+        s.register("staleness", self._staleness)
+        s.register("ready", lambda p: msgpack.packb({"ready": True}))
+
+    @property
+    def addr(self):
+        return self.server.addr
+
+    def _forward_batched(self, payload: bytes) -> bytes:
+        _, feats = ser.unpack_id_features(payload)
+        ref_id = self.worker.put_batch(feats)  # raises ForwardBufferFull
+        return msgpack.packb({"ref_id": ref_id})
+
+    def _forward_batch_id(self, payload: bytes) -> bytes:
+        req = msgpack.unpackb(payload, raw=False)
+        result = self.worker.lookup(req["ref_id"], training=req["training"])
+        return ser.pack_lookup_result(result)
+
+    def _forward_batched_direct(self, payload: bytes) -> bytes:
+        meta, feats = ser.unpack_id_features(payload)
+        result = self.worker.lookup_direct(feats,
+                                           training=meta.get("training", False))
+        return ser.pack_lookup_result(result)
+
+    def _update_gradients(self, payload: bytes) -> bytes:
+        meta, grads = ser.unpack_gradients(payload)
+        self.worker.update_gradients(meta["ref_id"], grads,
+                                     loss_scale=meta.get("loss_scale", 1.0))
+        return b""
+
+    def _configure(self, payload: bytes) -> bytes:
+        req = msgpack.unpackb(payload, raw=False)
+        self.worker.configure_parameter_servers(
+            req["init_method"], req["init_params"], req["admit_probability"],
+            req["weight_bound"], req["enable_weight_bound"],
+        )
+        return b""
+
+    def _register_optimizer(self, payload: bytes) -> bytes:
+        req = msgpack.unpackb(payload, raw=False)
+        self.worker.register_optimizer(req["config"])
+        return b""
+
+    def _dump(self, payload: bytes) -> bytes:
+        req = msgpack.unpackb(payload, raw=False)
+        self.worker.dump(req["path"])
+        return b""
+
+    def _load(self, payload: bytes) -> bytes:
+        req = msgpack.unpackb(payload, raw=False)
+        self.worker.load(req["path"])
+        return b""
+
+    def _staleness(self, payload: bytes) -> bytes:
+        return msgpack.packb({"staleness": self.worker.staleness})
+
+
+class RemoteEmbeddingWorker:
+    """Client fan-in over one or more worker replicas, presenting the
+    in-process EmbeddingWorker interface with (addr, id) composite refs."""
+
+    def __init__(self, addrs: Sequence[str]):
+        if not addrs:
+            raise ValueError("need at least one embedding-worker address")
+        self.addrs = list(addrs)
+        self._clients = {a: RpcClient(a) for a in self.addrs}
+        self._rr = itertools.cycle(self.addrs)
+        self._rr_lock = threading.Lock()
+        self.schema = None  # populated lazily for prepare_features parity
+
+    def _next_addr(self) -> str:
+        with self._rr_lock:
+            return next(self._rr)
+
+    def _client_for(self, ref) -> RpcClient:
+        return self._clients[ref[0]]
+
+    # --- data-loader / trainer interface --------------------------------
+
+    def put_batch(self, id_type_features) -> tuple:
+        addr = self._next_addr()
+        resp = self._clients[addr].call(
+            "forward_batched", ser.pack_id_features(id_type_features))
+        return (addr, msgpack.unpackb(resp, raw=False)["ref_id"])
+
+    def lookup(self, ref, training: bool = True) -> Dict[str, object]:
+        client = self._client_for(ref)
+        payload = msgpack.packb({"ref_id": ref[1], "training": training},
+                                use_bin_type=True)
+        return ser.unpack_lookup_result(client.call("forward_batch_id", payload))
+
+    def lookup_direct(self, id_type_features, training: bool = False):
+        addr = self._next_addr()
+        payload = ser.pack_id_features(id_type_features,
+                                       {"training": training})
+        return ser.unpack_lookup_result(
+            self._clients[addr].call("forward_batched_direct", payload))
+
+    def lookup_direct_training(self, id_type_features):
+        ref = self.put_batch(id_type_features)
+        return ref, self.lookup(ref, training=True)
+
+    def update_gradients(self, ref, grads: Dict[str, np.ndarray],
+                         loss_scale: float = 1.0):
+        client = self._client_for(ref)
+        client.call("update_gradients", ser.pack_gradients(
+            grads, {"ref_id": ref[1], "loss_scale": loss_scale}))
+
+    # --- control plane ---------------------------------------------------
+
+    def configure_parameter_servers(self, init_method, init_params,
+                                    admit_probability, weight_bound,
+                                    enable_weight_bound=True):
+        for c in self._clients.values():
+            c.call_msg(
+                "configure", init_method=init_method, init_params=init_params,
+                admit_probability=admit_probability, weight_bound=weight_bound,
+                enable_weight_bound=enable_weight_bound,
+            )
+
+    def register_optimizer(self, config: dict):
+        for c in self._clients.values():
+            c.call_msg("register_optimizer", config=config)
+
+    @property
+    def staleness(self) -> int:
+        return sum(
+            msgpack.unpackb(c.call("staleness"), raw=False)["staleness"]
+            for c in self._clients.values()
+        )
+
+    def dump(self, path: str):
+        # first worker fans out to every PS (reference rpc.rs:118-121)
+        self._clients[self.addrs[0]].call_msg("dump", path=path)
+
+    def load(self, path: str):
+        self._clients[self.addrs[0]].call_msg("load", path=path)
+
+    def shutdown(self):
+        for c in self._clients.values():
+            c.shutdown_server()
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--replica-index", type=int,
+                   default=int(os.environ.get("REPLICA_INDEX", 0)))
+    p.add_argument("--replica-size", type=int,
+                   default=int(os.environ.get("REPLICA_SIZE", 1)))
+    p.add_argument("--coordinator",
+                   default=os.environ.get("PERSIA_COORDINATOR_ADDR"))
+    p.add_argument("--embedding-config", required=True,
+                   help="embedding schema YAML")
+    p.add_argument("--global-config", default=None)
+    p.add_argument("--num-ps", type=int,
+                   default=int(os.environ.get("PERSIA_NUM_PS", 1)))
+    p.add_argument("--ps-addrs", default=None,
+                   help="comma-separated fixed PS addresses (Infer mode)")
+    args = p.parse_args()
+
+    schema = EmbeddingSchema.load(args.embedding_config)
+    gc = GlobalConfig.load(args.global_config) if args.global_config else GlobalConfig()
+    if args.ps_addrs:
+        ps_addrs = args.ps_addrs.split(",")
+    else:
+        coord = CoordinatorClient(args.coordinator)
+        ps_addrs = coord.wait_members(ROLE_PS, args.num_ps, timeout=120)
+    ps_clients = [PsClient(a) for a in ps_addrs]
+    worker = EmbeddingWorker(
+        schema, ps_clients,
+        forward_buffer_size=gc.embedding_worker.forward_buffer_size,
+        buffered_data_expired_sec=gc.embedding_worker.buffered_data_expired_sec,
+    )
+    service = WorkerService(worker, args.host, args.port)
+    _logger.info("embedding worker %d/%d listening on %s (%d PS)",
+                 args.replica_index, args.replica_size, service.addr,
+                 len(ps_clients))
+    if args.coordinator:
+        CoordinatorClient(args.coordinator).register(
+            ROLE_WORKER, args.replica_index, service.addr)
+    service.server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()
