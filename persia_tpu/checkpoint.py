@@ -1,0 +1,169 @@
+"""Checkpoint subsystem: sharded sparse dump/load + dense state.
+
+Re-design of the reference model manager
+(rust/persia-model-manager/src/lib.rs):
+
+- **Layout**: ``<dst>/replica_<i>.psd`` (PSD1, one file per PS replica)
+  plus a ``embedding_dump_done`` marker holding
+  ``{"num_shards", "datetime"}`` (reference lib.rs:124-198 writes
+  per-replica markers then a global one; with a shared filesystem and a
+  single dump coordinator one marker suffices).
+- **Status machine**: each PS reports Idle/Dumping/Loading/Failed over
+  RPC (lib.rs:63-69); ``wait_for_idle`` polls like the reference's
+  ``wait_for_emb_dumping`` (persia-core/src/rpc.rs:211-241).
+- **Resharding on load** (embedding_worker_service/mod.rs:1150-1259):
+  when the checkpoint's shard count differs from the current PS count,
+  entries are re-routed by ``farmhash64(sign) % replica_size`` — the same
+  hash the worker uses — and installed with ``set_entry``.
+- **Dense side**: TrainState via flax.serialization msgpack bytes.
+"""
+
+import json
+import os
+import struct
+import time
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from persia_tpu.hashing import farmhash64_np
+from persia_tpu.logger import get_default_logger
+from persia_tpu.ps.store import DUMP_MAGIC
+
+_logger = get_default_logger(__name__)
+
+DONE_MARKER = "embedding_dump_done"
+DENSE_FILE = "dense.msgpack"
+
+
+def _replica_path(dirpath: str, i: int) -> str:
+    return os.path.join(dirpath, f"replica_{i}.psd")
+
+
+def dump_sharded(ps_clients: Sequence, dirpath: str):
+    """Fan out a dump to every PS replica, then write the done marker."""
+    os.makedirs(dirpath, exist_ok=True)
+    marker = os.path.join(dirpath, DONE_MARKER)
+    if os.path.exists(marker):
+        os.remove(marker)
+    for i, client in enumerate(ps_clients):
+        client.dump_file(_replica_path(dirpath, i))
+    wait_for_idle(ps_clients)
+    with open(marker, "w") as f:
+        json.dump(
+            {"num_shards": len(ps_clients),
+             "datetime": time.strftime("%Y-%m-%dT%H:%M:%S")},
+            f,
+        )
+
+
+def read_done_marker(dirpath: str) -> dict:
+    marker = os.path.join(dirpath, DONE_MARKER)
+    if not os.path.exists(marker):
+        raise FileNotFoundError(
+            f"{dirpath} has no {DONE_MARKER}; incomplete or missing dump"
+        )
+    with open(marker) as f:
+        return json.load(f)
+
+
+def wait_for_idle(ps_clients: Sequence, timeout: float = 600.0):
+    """Poll every PS until its model-manager status returns to Idle."""
+    deadline = time.monotonic() + timeout
+    for client in ps_clients:
+        status_fn = getattr(client, "model_manager_status", None)
+        if status_fn is None:
+            continue  # in-process holder: dump/load are synchronous
+        while True:
+            status = status_fn()
+            if status == "Idle":
+                break
+            if status.startswith("Failed"):
+                raise RuntimeError(f"PS checkpoint failed: {status}")
+            if time.monotonic() > deadline:
+                raise TimeoutError("checkpoint status polling timed out")
+            time.sleep(0.2)
+
+
+def iter_psd_entries(path: str):
+    """Stream (sign, dim, vec) records out of one PSD1 file."""
+    with open(path, "rb") as f:
+        head = f.read(4 + struct.calcsize("<IQ"))
+        if head[:4] != DUMP_MAGIC:
+            raise ValueError(f"{path}: bad PSD1 magic")
+        _version, count = struct.unpack_from("<IQ", head, 4)
+        for _ in range(count):
+            rec = f.read(struct.calcsize("<QII"))
+            sign, dim, total = struct.unpack("<QII", rec)
+            vec = np.frombuffer(f.read(4 * total), dtype=np.float32)
+            yield sign, dim, vec
+
+
+def load_sharded(ps_clients: Sequence, dirpath: str,
+                 replica_size: Optional[int] = None):
+    """Load a dump, resharding if the PS count changed."""
+    replica_size = replica_size or len(ps_clients)
+    info = read_done_marker(dirpath)
+    num_shards = info["num_shards"]
+    if num_shards == len(ps_clients):
+        for i, client in enumerate(ps_clients):
+            client.load_file(_replica_path(dirpath, i))
+        wait_for_idle(ps_clients)
+        return
+    _logger.info(
+        "resharding checkpoint: %d dump shards -> %d parameter servers",
+        num_shards, len(ps_clients),
+    )
+    for client in ps_clients:
+        client.clear()
+    # Re-route every entry by the worker's shard function. Batched per
+    # source file to keep memory flat.
+    for i in range(num_shards):
+        batch_signs: List[int] = []
+        batch_entries: List = []
+        for sign, dim, vec in iter_psd_entries(_replica_path(dirpath, i)):
+            batch_signs.append(sign)
+            batch_entries.append((dim, vec))
+            if len(batch_signs) >= 65536:
+                _install(ps_clients, batch_signs, batch_entries)
+                batch_signs, batch_entries = [], []
+        if batch_signs:
+            _install(ps_clients, batch_signs, batch_entries)
+
+
+def _install(ps_clients, signs, entries):
+    shards = (
+        farmhash64_np(np.array(signs, dtype=np.uint64))
+        % np.uint64(len(ps_clients))
+    ).astype(np.int64)
+    for sign, shard, (dim, vec) in zip(signs, shards, entries):
+        ps_clients[shard].set_entry(int(sign), dim, vec)
+
+
+# --- ctx-level checkpoint (dense + sparse) -------------------------------
+
+
+def dump_checkpoint(ctx, dst_dir: str, with_dense: bool = True):
+    """Full job checkpoint (reference: persia/ctx.py:471-495, 1007-1034)."""
+    os.makedirs(dst_dir, exist_ok=True)
+    ctx.worker.dump(dst_dir)
+    if with_dense and getattr(ctx, "state", None) is not None:
+        from flax import serialization
+
+        with open(os.path.join(dst_dir, DENSE_FILE), "wb") as f:
+            f.write(serialization.to_bytes(ctx.state))
+
+
+def load_checkpoint(ctx, src_dir: str, with_dense: bool = True):
+    ctx.worker.load(src_dir)
+    dense_path = os.path.join(src_dir, DENSE_FILE)
+    if with_dense and os.path.exists(dense_path):
+        if getattr(ctx, "state", None) is None:
+            raise RuntimeError(
+                "dense state not initialized; run one train_step (or build "
+                "the state) before loading a dense checkpoint into it"
+            )
+        from flax import serialization
+
+        with open(dense_path, "rb") as f:
+            ctx.state = serialization.from_bytes(ctx.state, f.read())

@@ -1,0 +1,144 @@
+"""Incremental update manager: train -> serve online delta sync.
+
+Re-design of rust/persia-incremental-update-manager/src/lib.rs:
+
+- **Train side** (lib.rs:178-312): updated signs accumulate in a dedup
+  buffer; when it exceeds ``incremental_buffer_size`` the current entry
+  values are dumped as a timestamped packet directory
+  ``inc_<ts>_<seq>/<replica>.inc`` (PSD1 layout) with an
+  ``inc_update_done`` marker.
+- **Infer side** (lib.rs:314-364): a scanner thread polls the directory,
+  loads packets newer than the last applied one into the store, and
+  tracks the sync delay.
+"""
+
+import json
+import os
+import threading
+import time
+from typing import List, Optional, Set
+
+import numpy as np
+
+from persia_tpu.logger import get_default_logger
+
+_logger = get_default_logger(__name__)
+
+DONE_MARKER = "inc_update_done"
+
+
+class IncrementalUpdateDumper:
+    """Train-side: attach to a holder; call ``commit(signs)`` after every
+    gradient update."""
+
+    def __init__(self, holder, inc_dir: str, buffer_size: int = 1_000_000,
+                 replica_index: int = 0):
+        self.holder = holder
+        self.inc_dir = inc_dir
+        self.buffer_size = buffer_size
+        self.replica_index = replica_index
+        self._buffer: Set[int] = set()
+        self._lock = threading.Lock()
+        self._seq = 0
+        os.makedirs(inc_dir, exist_ok=True)
+
+    def commit(self, signs: np.ndarray):
+        flush: Optional[Set[int]] = None
+        with self._lock:
+            self._buffer.update(int(s) for s in signs)
+            if len(self._buffer) >= self.buffer_size:
+                flush = self._buffer
+                self._buffer = set()
+        if flush:
+            self._dump_packet(flush)
+
+    def flush(self):
+        with self._lock:
+            flush, self._buffer = self._buffer, set()
+        if flush:
+            self._dump_packet(flush)
+
+    def _dump_packet(self, signs: Set[int]):
+        import struct
+
+        from persia_tpu.ps.store import DUMP_MAGIC
+
+        self._seq += 1
+        name = f"inc_{time.strftime('%Y%m%d%H%M%S')}_{self._seq:06d}"
+        pkt_dir = os.path.join(self.inc_dir, name)
+        tmp_dir = pkt_dir + ".tmp"
+        os.makedirs(tmp_dir, exist_ok=True)
+        path = os.path.join(tmp_dir, f"{self.replica_index}.inc")
+        records = []
+        count = 0
+        for sign in signs:
+            entry = self.holder.get_entry(sign)
+            if entry is None:
+                continue
+            dim, vec = entry
+            records.append(struct.pack("<QII", sign, dim, len(vec)))
+            records.append(np.ascontiguousarray(vec, np.float32).tobytes())
+            count += 1
+        with open(path, "wb") as f:
+            f.write(DUMP_MAGIC)
+            f.write(struct.pack("<IQ", 1, count))
+            for r in records:
+                f.write(r)
+        with open(os.path.join(tmp_dir, DONE_MARKER), "w") as f:
+            json.dump({"count": count, "time": time.time()}, f)
+        os.rename(tmp_dir, pkt_dir)
+        _logger.info("incremental packet %s: %d entries", name, count)
+
+
+class IncrementalUpdateLoader:
+    """Infer-side: scan ``inc_dir`` and hot-load new packets."""
+
+    def __init__(self, holder, inc_dir: str, scan_interval_sec: float = 10.0):
+        self.holder = holder
+        self.inc_dir = inc_dir
+        self.scan_interval_sec = scan_interval_sec
+        self._applied: Set[str] = set()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.last_delay_sec: float = 0.0
+
+    def scan_once(self) -> int:
+        """Apply any unapplied complete packets; returns entries loaded."""
+        from persia_tpu.checkpoint import iter_psd_entries
+
+        if not os.path.isdir(self.inc_dir):
+            return 0
+        loaded = 0
+        for name in sorted(os.listdir(self.inc_dir)):
+            pkt_dir = os.path.join(self.inc_dir, name)
+            marker = os.path.join(pkt_dir, DONE_MARKER)
+            if (name in self._applied or not name.startswith("inc_")
+                    or not os.path.exists(marker)):
+                continue
+            with open(marker) as f:
+                info = json.load(f)
+            for fn in sorted(os.listdir(pkt_dir)):
+                if not fn.endswith(".inc"):
+                    continue
+                for sign, dim, vec in iter_psd_entries(
+                        os.path.join(pkt_dir, fn)):
+                    self.holder.set_entry(sign, dim, vec)
+                    loaded += 1
+            self._applied.add(name)
+            self.last_delay_sec = max(0.0, time.time() - info["time"])
+        return loaded
+
+    def start(self):
+        def run():
+            while not self._stop.wait(self.scan_interval_sec):
+                try:
+                    self.scan_once()
+                except Exception as e:  # keep scanning on bad packets
+                    _logger.error("incremental scan failed: %s", e)
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="inc-update-scanner")
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()

@@ -1,0 +1,140 @@
+"""Kubernetes manifest generation for persia_tpu jobs.
+
+The reference ships a Rust operator + CRD (k8s/src/crd.rs:174-467
+synthesizes per-role pods from a PersiaJob spec). The TPU-idiomatic
+equivalent is declarative manifest generation: a job YAML in the same
+shape (per-role replicas/resources/env) renders to plain Pod + Service
+manifests, wiring REPLICA_INDEX/REPLICA_SIZE and the coordinator address
+the way crd.rs does. Apply with kubectl or any GitOps pipeline; no
+long-running operator binary is required for the core workflow.
+
+Job spec shape::
+
+    jobName: my-job
+    image: persia-tpu-runtime:latest
+    coordinatorPort: 23333
+    embeddingConfigPath: /config/embedding_config.yml
+    globalConfigPath: /config/global_config.yml
+    roles:
+      embeddingParameterServer: {replicas: 2, env: {...}}
+      embeddingWorker: {replicas: 2}
+      nnWorker: {replicas: 1, tpu: {type: v5p-8}}
+      dataloader: {replicas: 1, entry: data_loader.py}
+
+CLI: ``python -m persia_tpu.k8s_utils gen job.yml > manifests.yml``
+"""
+
+import argparse
+import sys
+from typing import Dict, List
+
+import yaml
+
+from persia_tpu.utils import load_yaml
+
+_ROLE_LAUNCHER = {
+    "embeddingParameterServer": "embedding-parameter-server",
+    "embeddingWorker": "embedding-worker",
+    "nnWorker": "nn-worker",
+    "dataloader": "data-loader",
+}
+
+
+def _pod(job: str, image: str, role: str, index: int, replicas: int,
+         command: List[str], env: Dict[str, str], extra: dict) -> dict:
+    env_list = [{"name": k, "value": str(v)} for k, v in env.items()]
+    container = {
+        "name": role.lower(),
+        "image": image,
+        "command": command,
+        "env": env_list,
+    }
+    if extra.get("resources"):
+        container["resources"] = extra["resources"]
+    spec = {"containers": [container], "restartPolicy": "OnFailure"}
+    if extra.get("tpu"):
+        # TPU attachment via the standard GKE node selectors
+        spec["nodeSelector"] = {
+            "cloud.google.com/gke-tpu-accelerator": extra["tpu"]["type"],
+            "cloud.google.com/gke-tpu-topology": extra["tpu"].get(
+                "topology", "2x2"),
+        }
+        container.setdefault("resources", {}).setdefault("limits", {})[
+            "google.com/tpu"] = extra["tpu"].get("chips", 4)
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": f"{job}-{role.lower()}-{index}",
+            "labels": {"persia-job": job, "persia-role": role,
+                       "replica-index": str(index)},
+        },
+        "spec": spec,
+    }
+
+
+def gen_manifests(spec: dict) -> List[dict]:
+    job = spec["jobName"]
+    image = spec.get("image", "persia-tpu-runtime:latest")
+    coord_port = int(spec.get("coordinatorPort", 23333))
+    coord_host = f"{job}-coordinator"
+    manifests: List[dict] = []
+
+    manifests.append(_pod(
+        job, image, "coordinator", 0, 1,
+        ["python", "-m", "persia_tpu.launcher", "coordinator",
+         "--host", "0.0.0.0", "--port", str(coord_port)],
+        {}, {},
+    ))
+    manifests.append({
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": coord_host, "labels": {"persia-job": job}},
+        "spec": {
+            "selector": {"persia-job": job, "persia-role": "coordinator"},
+            "ports": [{"port": coord_port, "targetPort": coord_port}],
+        },
+    })
+
+    roles = spec.get("roles", {})
+    n_ps = int(roles.get("embeddingParameterServer", {}).get("replicas", 0))
+    for role, conf in roles.items():
+        replicas = int(conf.get("replicas", 1))
+        launcher_role = _ROLE_LAUNCHER[role]
+        for i in range(replicas):
+            env = {
+                "REPLICA_INDEX": i,
+                "REPLICA_SIZE": replicas,
+                "PERSIA_COORDINATOR_ADDR": f"{coord_host}:{coord_port}",
+                "PERSIA_NUM_PS": n_ps,
+                **conf.get("env", {}),
+            }
+            command = ["python", "-m", "persia_tpu.launcher", launcher_role]
+            if role == "embeddingWorker":
+                command += ["--embedding-config",
+                            spec["embeddingConfigPath"],
+                            "--num-ps", str(n_ps)]
+                if spec.get("globalConfigPath"):
+                    command += ["--global-config", spec["globalConfigPath"]]
+            elif role == "embeddingParameterServer":
+                command += ["--port", str(conf.get("port", 8887))]
+                if spec.get("globalConfigPath"):
+                    command += ["--global-config", spec["globalConfigPath"]]
+            elif conf.get("entry"):
+                command += [conf["entry"]]
+            manifests.append(_pod(job, image, role, i, replicas, command,
+                                  env, conf))
+    return manifests
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="persia-tpu-k8s")
+    p.add_argument("action", choices=["gen"])
+    p.add_argument("job_yaml")
+    args = p.parse_args(argv)
+    spec = load_yaml(args.job_yaml)
+    yaml.safe_dump_all(gen_manifests(spec), sys.stdout, sort_keys=False)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,70 @@
+"""persia-tpu-launcher: role entrypoint CLI (reference: persia/launcher.py).
+
+Subcommands launch one process of each role with env-var fallbacks so k8s
+manifests stay declarative:
+
+    python -m persia_tpu.launcher coordinator --port 23333
+    python -m persia_tpu.launcher data-loader [script.py]   (PERSIA_DATALOADER_ENTRY)
+    python -m persia_tpu.launcher nn-worker [script.py]     (PERSIA_NN_WORKER_ENTRY)
+    python -m persia_tpu.launcher embedding-worker --embedding-config ...
+    python -m persia_tpu.launcher embedding-parameter-server ...
+
+Unlike the reference there is no torch.distributed.launch wrapping for
+nn-workers: multi-chip scale-out is an in-process jax Mesh (single
+controller per host), so one nn-worker process per TPU host suffices.
+"""
+
+import argparse
+import os
+import sys
+
+from persia_tpu.logger import get_default_logger
+from persia_tpu.utils import run_command
+
+_logger = get_default_logger("persia_tpu.launcher")
+
+
+def _run_script(entry_env: str, argv):
+    script = argv[0] if argv else os.environ.get(entry_env)
+    if not script:
+        raise SystemExit(
+            f"no script given and {entry_env} not set"
+        )
+    cmd = [sys.executable, script, *argv[1:]]
+    _logger.info("launching %s", " ".join(cmd))
+    proc = run_command(cmd)
+    raise SystemExit(proc.wait())
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    p = argparse.ArgumentParser(prog="persia-tpu-launcher")
+    p.add_argument("role", choices=[
+        "coordinator", "data-loader", "nn-worker", "embedding-worker",
+        "embedding-parameter-server",
+    ])
+    args, rest = p.parse_known_args(argv)
+
+    if args.role == "coordinator":
+        from persia_tpu.service import coordinator
+
+        sys.argv = ["coordinator", *rest]
+        coordinator.main()
+    elif args.role == "embedding-worker":
+        from persia_tpu.service import worker_service
+
+        sys.argv = ["worker_service", *rest]
+        worker_service.main()
+    elif args.role == "embedding-parameter-server":
+        from persia_tpu.service import ps_service
+
+        sys.argv = ["ps_service", *rest]
+        ps_service.main()
+    elif args.role == "data-loader":
+        _run_script("PERSIA_DATALOADER_ENTRY", rest)
+    elif args.role == "nn-worker":
+        _run_script("PERSIA_NN_WORKER_ENTRY", rest)
+
+
+if __name__ == "__main__":
+    main()

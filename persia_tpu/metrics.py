@@ -1,0 +1,190 @@
+"""Metrics: counters/gauges/histograms with Prometheus text exposition.
+
+Re-design of rust/persia-metrics/src/lib.rs (PersiaMetricsManager over the
+prometheus crate with a push-gateway thread): a dependency-free registry
+with the same metric surface. ``push_loop`` PUTs the text exposition to a
+Prometheus push gateway (PERSIA_METRICS_GATEWAY_ADDR) at a fixed
+interval; in-process consumers can scrape ``render()`` directly.
+"""
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from persia_tpu.env import get_metrics_gateway_addr
+from persia_tpu.logger import get_default_logger
+
+_logger = get_default_logger(__name__)
+
+
+class Counter:
+    def __init__(self):
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, by: float = 1.0):
+        with self._lock:
+            self._value += by
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Gauge:
+    def __init__(self):
+        self._value = 0.0
+
+    def set(self, v: float):
+        self._value = v
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Histogram:
+    """Fixed-bucket histogram (Prometheus cumulative convention)."""
+
+    DEFAULT_BUCKETS = (
+        0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+        0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+    )
+
+    def __init__(self, buckets=DEFAULT_BUCKETS):
+        self.buckets = tuple(buckets)
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._total = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float):
+        with self._lock:
+            self._sum += v
+            self._total += 1
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    def timer(self):
+        return _Timer(self)
+
+
+class _Timer:
+    def __init__(self, hist: Histogram):
+        self.hist = hist
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.hist.observe(time.perf_counter() - self._t0)
+        return False
+
+
+class MetricsRegistry:
+    """Named metrics with optional labels, shared process-wide."""
+
+    def __init__(self, const_labels: Optional[Dict[str, str]] = None):
+        self.const_labels = const_labels or {}
+        self._metrics: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], object] = {}
+        self._kinds: Dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, kind: str, name: str, labels: Optional[Dict[str, str]],
+             factory):
+        key = (name, tuple(sorted((labels or {}).items())))
+        with self._lock:
+            existing = self._kinds.setdefault(name, kind)
+            if existing != kind:
+                raise ValueError(
+                    f"metric {name!r} already registered as {existing}"
+                )
+            if key not in self._metrics:
+                self._metrics[key] = factory()
+            return self._metrics[key]
+
+    def counter(self, name: str, labels: Optional[Dict[str, str]] = None) -> Counter:
+        return self._get("counter", name, labels, Counter)
+
+    def gauge(self, name: str, labels: Optional[Dict[str, str]] = None) -> Gauge:
+        return self._get("gauge", name, labels, Gauge)
+
+    def histogram(self, name: str, labels: Optional[Dict[str, str]] = None) -> Histogram:
+        return self._get("histogram", name, labels, Histogram)
+
+    def render(self) -> str:
+        """Prometheus text exposition format."""
+        lines: List[str] = []
+        with self._lock:
+            items = sorted(self._metrics.items())
+            kinds = dict(self._kinds)
+        for (name, labels), metric in items:
+            all_labels = {**self.const_labels, **dict(labels)}
+            kind = kinds[name]
+            if kind == "histogram":
+                assert isinstance(metric, Histogram)
+                cumulative = 0
+                for b, c in zip(metric.buckets, metric._counts):
+                    cumulative += c
+                    lines.append(
+                        f"{name}_bucket{_fmt({**all_labels, 'le': repr(b)})}"
+                        f" {cumulative}"
+                    )
+                cumulative += metric._counts[-1]
+                lines.append(
+                    f"{name}_bucket{_fmt({**all_labels, 'le': '+Inf'})}"
+                    f" {cumulative}"
+                )
+                lines.append(f"{name}_sum{_fmt(all_labels)} {metric._sum}")
+                lines.append(f"{name}_count{_fmt(all_labels)} {metric._total}")
+            else:
+                lines.append(f"{name}{_fmt(all_labels)} {metric.value}")
+        return "\n".join(lines) + "\n"
+
+    def push_loop(self, job: str, interval_sec: float = 10.0,
+                  gateway_addr: Optional[str] = None) -> threading.Thread:
+        """Background pusher to a Prometheus push gateway
+        (reference lib.rs:96-144)."""
+        addr = gateway_addr or get_metrics_gateway_addr()
+        if addr is None:
+            raise ValueError("no metrics gateway address configured")
+        url = f"http://{addr}/metrics/job/{job}"
+
+        def run():
+            import urllib.request
+
+            while True:
+                time.sleep(interval_sec)
+                try:
+                    req = urllib.request.Request(
+                        url, data=self.render().encode(), method="PUT")
+                    urllib.request.urlopen(req, timeout=5)
+                except Exception as e:
+                    _logger.debug("metrics push failed: %s", e)
+
+        t = threading.Thread(target=run, daemon=True, name="metrics-pusher")
+        t.start()
+        return t
+
+
+def _fmt(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+_default: Optional[MetricsRegistry] = None
+_default_lock = threading.Lock()
+
+
+def default_registry() -> MetricsRegistry:
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = MetricsRegistry()
+        return _default

@@ -32,8 +32,10 @@ _logger = get_default_logger(__name__)
 
 
 class PsService:
-    def __init__(self, holder, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, holder, host: str = "127.0.0.1", port: int = 0,
+                 inc_dumper=None):
         self.holder = holder
+        self.inc_dumper = inc_dumper
         self.server = RpcServer(host, port)
         self.status = "Idle"  # Idle | Dumping | Loading | Failed (model mgr)
         self._status_lock = threading.Lock()
@@ -81,6 +83,8 @@ class PsService:
     def _update_gradients(self, payload: bytes) -> bytes:
         meta, (signs, grads) = unpack_arrays(payload)
         self.holder.update_gradients(signs, grads, meta["dim"])
+        if self.inc_dumper is not None:
+            self.inc_dumper.commit(signs)
         return b""
 
     def _len(self, payload: bytes) -> bytes:
@@ -245,7 +249,24 @@ def main():
     gc = GlobalConfig.load(args.global_config) if args.global_config else GlobalConfig()
     holder = make_holder(gc.parameter_server.capacity,
                          gc.parameter_server.num_hashmap_internal_shards)
-    service = PsService(holder, args.host, args.port)
+    inc_dumper = None
+    if gc.parameter_server.enable_incremental_update:
+        from persia_tpu.config import JobType
+        from persia_tpu.inc_update import (
+            IncrementalUpdateDumper,
+            IncrementalUpdateLoader,
+        )
+
+        if gc.common.job_type == JobType.INFER:
+            IncrementalUpdateLoader(
+                holder, gc.parameter_server.incremental_dir).start()
+        else:
+            inc_dumper = IncrementalUpdateDumper(
+                holder, gc.parameter_server.incremental_dir,
+                buffer_size=gc.parameter_server.incremental_buffer_size,
+                replica_index=args.replica_index,
+            )
+    service = PsService(holder, args.host, args.port, inc_dumper=inc_dumper)
     if args.initial_checkpoint:
         holder.load_file(args.initial_checkpoint)
         _logger.info("loaded initial checkpoint from %s",
