@@ -40,14 +40,51 @@ def _replica_path(dirpath: str, i: int) -> str:
     return os.path.join(dirpath, f"replica_{i}.psd")
 
 
+class _StagedDir:
+    """Local staging for hdfs:// checkpoint directories (the storage
+    dispatch the reference gets from persia-storage's PersiaPath). Local
+    paths pass through untouched."""
+
+    def __init__(self, dirpath: str):
+        import tempfile
+
+        from persia_tpu.storage import PersiaPath
+
+        self._PersiaPath = PersiaPath
+        self.remote = dirpath if dirpath.startswith("hdfs://") else None
+        if self.remote:
+            self._tmp = tempfile.TemporaryDirectory(prefix="persia_ckpt_")
+            self.local = self._tmp.name
+        else:
+            self.local = dirpath
+
+    def upload(self):
+        if not self.remote:
+            return
+        self._PersiaPath(self.remote).makedirs()
+        for name in os.listdir(self.local):
+            with open(os.path.join(self.local, name), "rb") as f:
+                self._PersiaPath(f"{self.remote}/{name}").write_bytes(f.read())
+
+    def download(self):
+        if not self.remote:
+            return
+        for remote_file in self._PersiaPath(self.remote).listdir():
+            name = remote_file.rsplit("/", 1)[-1]
+            data = self._PersiaPath(remote_file).read_bytes()
+            with open(os.path.join(self.local, name), "wb") as f:
+                f.write(data)
+
+
 def dump_sharded(ps_clients: Sequence, dirpath: str):
     """Fan out a dump to every PS replica, then write the done marker."""
-    os.makedirs(dirpath, exist_ok=True)
-    marker = os.path.join(dirpath, DONE_MARKER)
+    staged = _StagedDir(dirpath)
+    os.makedirs(staged.local, exist_ok=True)
+    marker = os.path.join(staged.local, DONE_MARKER)
     if os.path.exists(marker):
         os.remove(marker)
     for i, client in enumerate(ps_clients):
-        client.dump_file(_replica_path(dirpath, i))
+        client.dump_file(_replica_path(staged.local, i))
     wait_for_idle(ps_clients)
     with open(marker, "w") as f:
         json.dump(
@@ -55,16 +92,18 @@ def dump_sharded(ps_clients: Sequence, dirpath: str):
              "datetime": time.strftime("%Y-%m-%dT%H:%M:%S")},
             f,
         )
+    staged.upload()
 
 
 def read_done_marker(dirpath: str) -> dict:
-    marker = os.path.join(dirpath, DONE_MARKER)
-    if not os.path.exists(marker):
+    from persia_tpu.storage import PersiaPath
+
+    marker = PersiaPath(os.path.join(dirpath, DONE_MARKER))
+    if not marker.exists():
         raise FileNotFoundError(
             f"{dirpath} has no {DONE_MARKER}; incomplete or missing dump"
         )
-    with open(marker) as f:
-        return json.load(f)
+    return json.loads(marker.read_bytes())
 
 
 def wait_for_idle(ps_clients: Sequence, timeout: float = 600.0):
@@ -104,6 +143,9 @@ def load_sharded(ps_clients: Sequence, dirpath: str,
     """Load a dump, resharding if the PS count changed."""
     replica_size = replica_size or len(ps_clients)
     info = read_done_marker(dirpath)
+    staged = _StagedDir(dirpath)
+    staged.download()
+    dirpath = staged.local
     num_shards = info["num_shards"]
     if num_shards == len(ps_clients):
         for i, client in enumerate(ps_clients):

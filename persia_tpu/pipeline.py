@@ -30,6 +30,13 @@ from typing import Any, Dict, Iterator, List, Optional
 
 from persia_tpu.data.batch import PersiaBatch
 from persia_tpu.logger import get_default_logger
+from persia_tpu.tracing import (
+    StageTimer,
+    heartbeat,
+    start_deadlock_detection,
+    work_finished,
+    work_started,
+)
 
 _logger = get_default_logger(__name__)
 
@@ -64,6 +71,7 @@ class BackwardEngine:
         self._pending = 0
         self._pending_cv = threading.Condition()
         self._errors: List[BaseException] = []
+        self._timer_hist = StageTimer("backward_client_time_cost_sec").hist
         self._threads = [
             threading.Thread(target=self._run, daemon=True,
                              name=f"backward-worker-{i}")
@@ -77,6 +85,7 @@ class BackwardEngine:
             raise self._errors[0]
         with self._pending_cv:
             self._pending += 1
+        work_started()
         self._q.put((ref_id, grads))
 
     def _run(self):
@@ -86,12 +95,15 @@ class BackwardEngine:
                 return
             ref_id, grads = item
             try:
-                self.worker.update_gradients(ref_id, grads,
-                                             loss_scale=self.loss_scale)
+                with self._timer_hist.timer():
+                    self.worker.update_gradients(ref_id, grads,
+                                                 loss_scale=self.loss_scale)
+                heartbeat()
             except BaseException as e:  # propagate to the training thread
                 _logger.error("backward update failed: %s", e)
                 self._errors.append(e)
             finally:
+                work_finished()
                 if self.staleness_sem is not None:
                     self.staleness_sem.release()
                 with self._pending_cv:
@@ -137,6 +149,8 @@ class ForwardEngine:
         self.backward = BackwardEngine(
             self.worker, staleness_sem=self.staleness_sem
         )
+        self._forward_hist = StageTimer("forward_client_time_cost_sec").hist
+        start_deadlock_detection()
 
     def run(self, batches: Iterator[PersiaBatch],
             timeout_ms: int = 600_000) -> Iterator[LookedUpBatch]:
@@ -171,28 +185,34 @@ class ForwardEngine:
                     out_q.put(_SENTINEL)
                     return
                 seq, batch = item
+                work_started()
                 try:
-                    rref = getattr(batch, "remote_ref", None)
-                    if rref is not None:
-                        # ID features already live in a worker's forward
-                        # buffer (sent by a remote data-loader)
-                        ref_id = rref if batch.requires_grad else None
-                        lookup = self.worker.lookup(
-                            rref, training=batch.requires_grad
-                        )
-                    elif batch.requires_grad:
-                        ref_id = self.worker.put_batch(batch.id_type_features)
-                        lookup = self.worker.lookup(ref_id, training=True)
-                    else:
-                        ref_id = None
-                        lookup = self.worker.lookup_direct(
-                            batch.id_type_features, training=False
-                        )
+                    with self._forward_hist.timer():
+                        rref = getattr(batch, "remote_ref", None)
+                        if rref is not None:
+                            # ID features already live in a worker's forward
+                            # buffer (sent by a remote data-loader)
+                            ref_id = rref if batch.requires_grad else None
+                            lookup = self.worker.lookup(
+                                rref, training=batch.requires_grad
+                            )
+                        elif batch.requires_grad:
+                            ref_id = self.worker.put_batch(
+                                batch.id_type_features)
+                            lookup = self.worker.lookup(ref_id, training=True)
+                        else:
+                            ref_id = None
+                            lookup = self.worker.lookup_direct(
+                                batch.id_type_features, training=False
+                            )
+                    heartbeat()
                     out_q.put((seq, LookedUpBatch(batch, lookup, ref_id, self)))
                 except BaseException as e:
                     errors.append(e)
                     out_q.put(_SENTINEL)
                     return
+                finally:
+                    work_finished()
 
         threads = [threading.Thread(target=feeder, daemon=True,
                                     name="forward-feeder")]

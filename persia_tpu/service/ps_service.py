@@ -245,6 +245,9 @@ def main():
     p.add_argument("--global-config", default=None)
     p.add_argument("--initial-checkpoint", default=None)
     args = p.parse_args()
+    from persia_tpu.tracing import start_deadlock_detection
+
+    start_deadlock_detection()
 
     gc = GlobalConfig.load(args.global_config) if args.global_config else GlobalConfig()
     holder = make_holder(gc.parameter_server.capacity,

@@ -215,7 +215,13 @@ def main():
                    default=int(os.environ.get("PERSIA_NUM_PS", 1)))
     p.add_argument("--ps-addrs", default=None,
                    help="comma-separated fixed PS addresses (Infer mode)")
+    p.add_argument("--enable-monitor", action="store_true",
+                   default=os.environ.get("PERSIA_ENABLE_MONITOR") == "1",
+                   help="estimate distinct ids per feature (HLL gauge)")
     args = p.parse_args()
+    from persia_tpu.tracing import start_deadlock_detection
+
+    start_deadlock_detection()
 
     schema = EmbeddingSchema.load(args.embedding_config)
     gc = GlobalConfig.load(args.global_config) if args.global_config else GlobalConfig()
@@ -229,6 +235,7 @@ def main():
         schema, ps_clients,
         forward_buffer_size=gc.embedding_worker.forward_buffer_size,
         buffered_data_expired_sec=gc.embedding_worker.buffered_data_expired_sec,
+        enable_monitor=args.enable_monitor,
     )
     service = WorkerService(worker, args.host, args.port)
     _logger.info("embedding worker %d/%d listening on %s (%d PS)",

@@ -1,0 +1,30 @@
+"""Inference-time service discovery (reference: persia/service.py).
+
+Resolves embedding-worker addresses for InferCtx from either the
+``EMBEDDING_WORKER_SERVICE`` env (host:port[,host:port...] — the
+reference's contract) or a live coordinator.
+"""
+
+import os
+from typing import List, Optional
+
+
+def get_embedding_worker_services(
+    coordinator_addr: Optional[str] = None,
+) -> List[str]:
+    env = os.environ.get("EMBEDDING_WORKER_SERVICE")
+    if env:
+        return [a.strip() for a in env.split(",") if a.strip()]
+    if coordinator_addr is None:
+        coordinator_addr = os.environ.get("PERSIA_COORDINATOR_ADDR")
+    if coordinator_addr:
+        from persia_tpu.service.coordinator import (
+            ROLE_WORKER,
+            CoordinatorClient,
+        )
+
+        return CoordinatorClient(coordinator_addr).list(ROLE_WORKER)
+    raise RuntimeError(
+        "set EMBEDDING_WORKER_SERVICE or PERSIA_COORDINATOR_ADDR to locate "
+        "embedding workers"
+    )

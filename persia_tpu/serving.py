@@ -1,0 +1,86 @@
+"""Online inference serving.
+
+The reference serves through TorchServe: a PersiaHandler holds an
+InferCtx, deserializes PersiaBatch bytes, does a direct embedding lookup
+and a forward pass (examples/src/adult-income/serve_handler.py +
+persia/ctx.py:1077-1133). Here the equivalent is a self-contained
+:class:`InferenceServer` on the framework RPC: ``predict`` takes
+PersiaBatch bytes (the same PTB2 wire clients already produce) and
+returns the model outputs; embedding workers are resolved via
+:mod:`persia_tpu.service_discovery`.
+
+Typical wiring::
+
+    server = InferenceServer(model, state, schema, worker_addrs, port=8501)
+    server.serve_forever()
+
+    client = InferenceClient("host:8501")
+    preds = client.predict(persia_batch)
+"""
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from persia_tpu.config import EmbeddingSchema
+from persia_tpu.ctx import InferCtx
+from persia_tpu.data.batch import PersiaBatch
+from persia_tpu.logger import get_default_logger
+from persia_tpu.rpc import RpcClient, RpcServer, pack_arrays, unpack_arrays
+
+_logger = get_default_logger(__name__)
+
+
+class InferenceServer:
+    def __init__(
+        self,
+        model,
+        state,
+        schema: EmbeddingSchema,
+        worker_addrs: Optional[Sequence[str]] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        from persia_tpu.service.worker_service import RemoteEmbeddingWorker
+        from persia_tpu.service_discovery import get_embedding_worker_services
+
+        addrs = list(worker_addrs) if worker_addrs else \
+            get_embedding_worker_services()
+        worker = RemoteEmbeddingWorker(addrs)
+        worker.schema = schema
+        self.ctx = InferCtx(model, state, schema, worker)
+        self.server = RpcServer(host, port)
+        self.server.register("predict", self._predict)
+        self.server.register("health", lambda p: b"ok")
+
+    @property
+    def addr(self) -> str:
+        return self.server.addr
+
+    def _predict(self, payload: bytes) -> bytes:
+        batch = PersiaBatch.from_bytes(payload)
+        pred, _labels = self.ctx.forward(batch)
+        return pack_arrays({}, [np.asarray(pred)])
+
+    def serve_background(self):
+        self.server.serve_background()
+
+    def serve_forever(self):
+        _logger.info("inference server listening on %s", self.addr)
+        self.server.serve_forever()
+
+
+class InferenceClient:
+    def __init__(self, addr: str):
+        self.client = RpcClient(addr)
+
+    def predict(self, batch: PersiaBatch) -> np.ndarray:
+        _, (pred,) = unpack_arrays(
+            self.client.call("predict", batch.to_bytes()))
+        return pred
+
+    def healthy(self) -> bool:
+        try:
+            return self.client.call("health") == b"ok"
+        except Exception:
+            return False

@@ -1,0 +1,99 @@
+"""Stage timing + stall/deadlock detection.
+
+Reference observability surface: per-stage Prometheus gauges
+(embedding_worker_service/mod.rs:83-100, persia-core/src/metrics.rs) and
+an opt-in deadlock detector thread (persia-common/src/utils.rs:22-48,
+enabled by PERSIA_DEADLOCK_DETECTION=1).
+
+Python has no parking_lot introspection, so the detector watches a
+process-wide heartbeat that the pipeline hot loops tick; if a full
+interval passes with no tick while work is marked in flight, every
+thread's stack is dumped to stderr — which is what you need to debug a
+stuck queue/semaphore cycle.
+"""
+
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Optional
+
+from persia_tpu.logger import get_default_logger
+from persia_tpu.metrics import default_registry
+
+_logger = get_default_logger(__name__)
+
+_beat = 0
+_inflight = 0
+_lock = threading.Lock()
+
+
+def heartbeat():
+    global _beat
+    _beat += 1  # benign race: any change counts as progress
+
+
+def work_started():
+    global _inflight
+    with _lock:
+        _inflight += 1
+
+
+def work_finished():
+    global _inflight
+    with _lock:
+        _inflight -= 1
+
+
+def dump_all_stacks(out=sys.stderr):
+    frames = sys._current_frames()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    print("==== persia_tpu thread dump ====", file=out)
+    for tid, frame in frames.items():
+        print(f"--- thread {names.get(tid, tid)} ---", file=out)
+        traceback.print_stack(frame, file=out)
+    out.flush()
+
+
+def start_deadlock_detection(interval_sec: float = 30.0) -> Optional[threading.Thread]:
+    """Start the stall watchdog (no-op unless PERSIA_DEADLOCK_DETECTION=1,
+    matching the reference's env gate)."""
+    if os.environ.get("PERSIA_DEADLOCK_DETECTION") != "1":
+        return None
+
+    def run():
+        last = _beat
+        while True:
+            time.sleep(interval_sec)
+            if _inflight > 0 and _beat == last:
+                _logger.error(
+                    "no pipeline progress for %.0fs with %d items in "
+                    "flight — dumping stacks", interval_sec, _inflight)
+                dump_all_stacks()
+            last = _beat
+
+    t = threading.Thread(target=run, daemon=True, name="deadlock-watchdog")
+    t.start()
+    return t
+
+
+class StageTimer:
+    """Histogram-backed context timer for pipeline stages.
+
+    Metric names follow the reference's gauge names
+    (lookup_preprocess_time_cost_sec, lookup_rpc_time_cost_sec,
+    lookup_postprocess_time_cost_sec, forward_client_time_cost_sec,
+    backward_client_time_cost_sec, ...).
+    """
+
+    def __init__(self, name: str):
+        self.hist = default_registry().histogram(name)
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.hist.observe(time.perf_counter() - self._t0)
+        return False

@@ -44,6 +44,7 @@ class EmbeddingWorker:
         ps_clients: Sequence,
         forward_buffer_size: int = 1000,
         buffered_data_expired_sec: int = 1800,
+        enable_monitor: bool = False,
     ):
         self.schema = schema
         self.ps_clients = list(ps_clients)
@@ -58,6 +59,16 @@ class EmbeddingWorker:
         self._forward_id_buffer: Dict[int, Tuple[list, float]] = {}
         self._post_forward_buffer: Dict[int, Tuple[list, float]] = {}
         self.staleness = 0
+        # distinct-id cardinality estimation (reference monitor.rs)
+        from persia_tpu.worker.monitor import DistinctIdMonitor
+
+        self.monitor = DistinctIdMonitor() if enable_monitor else None
+        from persia_tpu.metrics import default_registry
+
+        reg = default_registry()
+        self._t_preprocess = reg.histogram("lookup_preprocess_time_cost_sec")
+        self._t_rpc = reg.histogram("lookup_rpc_time_cost_sec")
+        self._t_postprocess = reg.histogram("lookup_postprocess_time_cost_sec")
 
     # --- control plane ---------------------------------------------------
 
@@ -139,16 +150,23 @@ class EmbeddingWorker:
         return ref_id, self.lookup(ref_id, training=True)
 
     def _lookup_feats(self, feats, training: bool) -> Dict[str, object]:
-        groups = mw.shard_split(feats, self.schema, self.replica_size)
-        results = [
-            self.ps_clients[g.shard].lookup(g.signs, g.dim, training)
-            for g in groups
-        ]
-        mats = mw.scatter_lookup_results(feats, self.schema, groups, results)
-        out = {}
-        for feat, mat in zip(feats, mats):
-            slot = self.schema.get_slot(feat.name)
-            out[feat.name] = mw.postprocess_feature(feat, slot, mat)
+        if self.monitor is not None:
+            for f in feats:
+                self.monitor.observe(f.name, f.distinct_signs)
+        with self._t_preprocess.timer():
+            groups = mw.shard_split(feats, self.schema, self.replica_size)
+        with self._t_rpc.timer():
+            results = [
+                self.ps_clients[g.shard].lookup(g.signs, g.dim, training)
+                for g in groups
+            ]
+        with self._t_postprocess.timer():
+            mats = mw.scatter_lookup_results(feats, self.schema, groups,
+                                             results)
+            out = {}
+            for feat, mat in zip(feats, mats):
+                slot = self.schema.get_slot(feat.name)
+                out[feat.name] = mw.postprocess_feature(feat, slot, mat)
         return out
 
     def update_gradients(

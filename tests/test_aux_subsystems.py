@@ -1,0 +1,89 @@
+"""Tests: HLL monitor, storage paths, tracing watchdog, service discovery,
+launcher CLI plumbing."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from persia_tpu.storage import PersiaPath
+from persia_tpu.worker.monitor import DistinctIdMonitor, HyperLogLog
+
+
+def test_hyperloglog_estimates_within_error():
+    hll = HyperLogLog(p=14)
+    rng = np.random.default_rng(0)
+    n = 100_000
+    hll.add_signs(rng.integers(0, 2**63, n, dtype=np.uint64))
+    est = hll.estimate()
+    assert abs(est - n) / n < 0.05  # HLL p=14 -> ~0.8% typical error
+
+
+def test_hyperloglog_small_range():
+    hll = HyperLogLog(p=14)
+    hll.add_signs(np.arange(1, 51, dtype=np.uint64))
+    assert abs(hll.estimate() - 50) < 5
+
+
+def test_distinct_id_monitor_gauge():
+    from persia_tpu.metrics import default_registry
+
+    mon = DistinctIdMonitor()
+    mon.observe("clicks", np.arange(1000, dtype=np.uint64))
+    mon.observe("clicks", np.arange(500, 1500, dtype=np.uint64))
+    est = mon.estimate("clicks")
+    assert abs(est - 1500) / 1500 < 0.1
+    assert "estimated_distinct_id" in default_registry().render()
+
+
+def test_persia_path_disk(tmp_path):
+    p = PersiaPath(str(tmp_path / "a" / "b.bin"))
+    assert not p.exists()
+    p.write_bytes(b"hello")
+    assert p.exists()
+    assert p.read_bytes() == b"hello"
+    d = PersiaPath(str(tmp_path / "a"))
+    assert str(tmp_path / "a" / "b.bin") in d.listdir()
+    p.remove()
+    assert not p.exists()
+
+
+def test_deadlock_watchdog_disabled_by_default():
+    from persia_tpu.tracing import start_deadlock_detection
+
+    os.environ.pop("PERSIA_DEADLOCK_DETECTION", None)
+    assert start_deadlock_detection() is None
+
+
+def test_dump_all_stacks_smoke(capsys):
+    import io
+
+    from persia_tpu.tracing import dump_all_stacks
+
+    buf = io.StringIO()
+    dump_all_stacks(out=buf)
+    assert "thread dump" in buf.getvalue()
+    assert "MainThread" in buf.getvalue()
+
+
+def test_service_discovery_env(monkeypatch):
+    from persia_tpu.service_discovery import get_embedding_worker_services
+
+    monkeypatch.setenv("EMBEDDING_WORKER_SERVICE", "h1:1, h2:2")
+    assert get_embedding_worker_services() == ["h1:1", "h2:2"]
+    monkeypatch.delenv("EMBEDDING_WORKER_SERVICE")
+    monkeypatch.delenv("PERSIA_COORDINATOR_ADDR", raising=False)
+    with pytest.raises(RuntimeError):
+        get_embedding_worker_services()
+
+
+def test_launcher_help_runs():
+    out = subprocess.run(
+        [sys.executable, "-m", "persia_tpu.launcher", "--help"],
+        capture_output=True, text=True,
+        env={**os.environ, "PYTHONPATH": os.getcwd()},
+    )
+    assert out.returncode == 0
+    assert "embedding-parameter-server" in out.stdout

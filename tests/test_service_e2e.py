@@ -149,3 +149,39 @@ def test_crash_detection():
         while time.monotonic() < deadline and not svc.crashed:
             time.sleep(0.2)
         assert svc.crashed
+
+
+def test_inference_server_end_to_end(cluster):
+    """PersiaBatch bytes -> InferenceServer -> predictions (the serving
+    path, reference serve_handler.py)."""
+    import jax
+
+    from persia_tpu.parallel.train import create_train_state
+    from persia_tpu.serving import InferenceClient, InferenceServer
+
+    schema = _schema()
+    model = DNN()
+    # build a state from one example batch's shapes
+    b = next(iter(batches(64, 64, seed=77, requires_grad=False)))
+    worker = cluster.remote_worker()
+    lookup = worker.lookup_direct(b.id_type_features, training=False)
+    from persia_tpu.ctx import EmbeddingCtx
+
+    ectx = EmbeddingCtx(model=model, schema=schema, worker=worker)
+    non_id, emb_inputs, _ = ectx.prepare_features(b, lookup)
+    state = create_train_state(model, optax.adam(1e-3), jax.random.key(0),
+                               non_id, emb_inputs)
+
+    server = InferenceServer(model, state, schema,
+                             worker_addrs=cluster.worker_addrs)
+    server.serve_background()
+    try:
+        client = InferenceClient(server.addr)
+        assert client.healthy()
+        preds = client.predict(b)
+        assert preds.shape == (64, 1)
+        assert np.isfinite(preds).all()
+        # deterministic across calls
+        np.testing.assert_array_equal(preds, client.predict(b))
+    finally:
+        server.server.stop()
