@@ -1,0 +1,67 @@
+"""Dense distributed options (reference: persia/distributed.py).
+
+The reference wraps torch DDP (`DDPOption`) or Bagua
+(`BaguaDistributedOption`) — process-group NCCL/Gloo allreduce with a
+NATS master rendezvous. On TPU all of that collapses into mesh
+configuration: XLA inserts the collectives, ICI is the fabric, and
+multi-host jobs use ``jax.distributed.initialize`` (the JAX coordination
+service plays the master-discovery role of nats.rs:22-100).
+
+``DistributedOption`` therefore describes a mesh, and
+``get_default_distributed_option`` mirrors the reference's helper
+(persia/distributed.py:413-428): pure data parallelism over every
+visible device.
+"""
+
+import os
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+from persia_tpu.logger import get_default_logger
+
+_logger = get_default_logger(__name__)
+
+
+@dataclass
+class DistributedOption:
+    """Mesh-shaped replacement for DDP/Bagua options.
+
+    Args:
+        mesh_shape: (data, model) device grid; None = all devices on the
+            data axis (the reference's DDP topology).
+        multihost: initialize ``jax.distributed`` from the standard env
+            (coordinator address/process id), for pods spanning hosts.
+        coordinator_address / num_processes / process_id: explicit
+            multihost rendezvous parameters; default to the JAX env vars.
+    """
+
+    mesh_shape: Optional[Tuple[int, int]] = None
+    multihost: bool = False
+    coordinator_address: Optional[str] = None
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
+
+    def initialize(self):
+        """Bring up multi-host JAX if requested; returns the Mesh."""
+        import jax
+
+        from persia_tpu.parallel.mesh import make_mesh
+
+        if self.multihost and jax.process_count() == 1:
+            kwargs = {}
+            if self.coordinator_address:
+                kwargs["coordinator_address"] = self.coordinator_address
+            if self.num_processes is not None:
+                kwargs["num_processes"] = self.num_processes
+            if self.process_id is not None:
+                kwargs["process_id"] = self.process_id
+            jax.distributed.initialize(**kwargs)
+            _logger.info("jax.distributed up: process %d/%d",
+                         jax.process_index(), jax.process_count())
+        return make_mesh(self.mesh_shape)
+
+
+def get_default_distributed_option() -> DistributedOption:
+    """Data parallelism over every visible chip — the reference default."""
+    multihost = os.environ.get("JAX_COORDINATOR_ADDRESS") is not None
+    return DistributedOption(mesh_shape=None, multihost=multihost)

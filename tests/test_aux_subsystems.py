@@ -87,3 +87,16 @@ def test_launcher_help_runs():
     )
     assert out.returncode == 0
     assert "embedding-parameter-server" in out.stdout
+
+
+def test_distributed_option_default_mesh():
+    from persia_tpu.distributed import (
+        DistributedOption,
+        get_default_distributed_option,
+    )
+
+    opt = get_default_distributed_option()
+    mesh = opt.initialize()
+    assert mesh.shape["data"] == 8  # all virtual devices on the data axis
+    mesh2 = DistributedOption(mesh_shape=(4, 2)).initialize()
+    assert mesh2.shape["model"] == 2
