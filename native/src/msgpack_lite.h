@@ -1,0 +1,340 @@
+// Minimal msgpack codec for the persia_tpu RPC envelope/payload subset
+// (persia_tpu/rpc.py uses msgpack for envelopes and small metadata maps;
+// bulk data travels as raw numpy buffers outside msgpack). Covers every
+// type msgpack-python emits for our messages: nil/bool/ints/floats/str/
+// bin/array/map.
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace persia {
+namespace msgpack {
+
+struct Value {
+  enum Kind { kNil, kBool, kInt, kUInt, kFloat, kStr, kBin, kArray, kMap };
+  Kind kind = kNil;
+  bool b = false;
+  int64_t i = 0;
+  uint64_t u = 0;
+  double f = 0.0;
+  std::string s;  // str and bin
+  std::vector<Value> arr;
+  std::vector<std::pair<std::string, Value>> map;
+
+  bool is_nil() const { return kind == kNil; }
+
+  int64_t as_int() const {
+    switch (kind) {
+      case kInt:
+        return i;
+      case kUInt:
+        return static_cast<int64_t>(u);
+      case kFloat:
+        return static_cast<int64_t>(f);
+      case kBool:
+        return b ? 1 : 0;
+      default:
+        throw std::runtime_error("msgpack: not an int");
+    }
+  }
+
+  uint64_t as_uint() const {
+    return kind == kUInt ? u : static_cast<uint64_t>(as_int());
+  }
+
+  double as_double() const {
+    switch (kind) {
+      case kFloat:
+        return f;
+      case kInt:
+        return static_cast<double>(i);
+      case kUInt:
+        return static_cast<double>(u);
+      default:
+        throw std::runtime_error("msgpack: not a number");
+    }
+  }
+
+  bool as_bool() const {
+    if (kind == kBool) return b;
+    return as_int() != 0;
+  }
+
+  const std::string& as_str() const {
+    if (kind != kStr && kind != kBin)
+      throw std::runtime_error("msgpack: not a string");
+    return s;
+  }
+
+  const Value* get(const std::string& key) const {
+    for (const auto& kv : map)
+      if (kv.first == key) return &kv.second;
+    return nullptr;
+  }
+
+  const Value& at(const std::string& key) const {
+    const Value* v = get(key);
+    if (!v) throw std::runtime_error("msgpack: missing key " + key);
+    return *v;
+  }
+};
+
+// ---- decoding -----------------------------------------------------------
+
+inline uint64_t read_be(const uint8_t* p, int n) {
+  uint64_t v = 0;
+  for (int i = 0; i < n; ++i) v = (v << 8) | p[i];
+  return v;
+}
+
+inline Value decode(const uint8_t* p, size_t len, size_t& pos);
+
+inline Value decode_seq(const uint8_t* p, size_t len, size_t& pos,
+                        size_t count, bool is_map) {
+  Value v;
+  if (is_map) {
+    v.kind = Value::kMap;
+    for (size_t k = 0; k < count; ++k) {
+      Value key = decode(p, len, pos);
+      Value val = decode(p, len, pos);
+      v.map.emplace_back(key.as_str(), std::move(val));
+    }
+  } else {
+    v.kind = Value::kArray;
+    for (size_t k = 0; k < count; ++k) v.arr.push_back(decode(p, len, pos));
+  }
+  return v;
+}
+
+inline Value decode(const uint8_t* p, size_t len, size_t& pos) {
+  if (pos >= len) throw std::runtime_error("msgpack: truncated");
+  uint8_t tag = p[pos++];
+  Value v;
+  auto need = [&](size_t n) {
+    if (pos + n > len) throw std::runtime_error("msgpack: truncated");
+  };
+  auto take_str = [&](size_t n, Value::Kind kind) {
+    need(n);
+    v.kind = kind;
+    v.s.assign(reinterpret_cast<const char*>(p + pos), n);
+    pos += n;
+  };
+  if (tag <= 0x7f) {
+    v.kind = Value::kUInt;
+    v.u = tag;
+  } else if (tag >= 0xe0) {
+    v.kind = Value::kInt;
+    v.i = static_cast<int8_t>(tag);
+  } else if (tag >= 0x80 && tag <= 0x8f) {
+    return decode_seq(p, len, pos, tag & 0x0f, true);
+  } else if (tag >= 0x90 && tag <= 0x9f) {
+    return decode_seq(p, len, pos, tag & 0x0f, false);
+  } else if (tag >= 0xa0 && tag <= 0xbf) {
+    take_str(tag & 0x1f, Value::kStr);
+  } else {
+    switch (tag) {
+      case 0xc0:
+        v.kind = Value::kNil;
+        break;
+      case 0xc2:
+        v.kind = Value::kBool;
+        v.b = false;
+        break;
+      case 0xc3:
+        v.kind = Value::kBool;
+        v.b = true;
+        break;
+      case 0xc4:
+      case 0xc5:
+      case 0xc6: {
+        int n = 1 << (tag - 0xc4);
+        need(n);
+        size_t sz = read_be(p + pos, n);
+        pos += n;
+        take_str(sz, Value::kBin);
+        break;
+      }
+      case 0xca: {
+        need(4);
+        uint32_t bits = static_cast<uint32_t>(read_be(p + pos, 4));
+        float fv;
+        std::memcpy(&fv, &bits, 4);
+        v.kind = Value::kFloat;
+        v.f = fv;
+        pos += 4;
+        break;
+      }
+      case 0xcb: {
+        need(8);
+        uint64_t bits = read_be(p + pos, 8);
+        std::memcpy(&v.f, &bits, 8);
+        v.kind = Value::kFloat;
+        pos += 8;
+        break;
+      }
+      case 0xcc:
+      case 0xcd:
+      case 0xce:
+      case 0xcf: {
+        int n = 1 << (tag - 0xcc);
+        need(n);
+        v.kind = Value::kUInt;
+        v.u = read_be(p + pos, n);
+        pos += n;
+        break;
+      }
+      case 0xd0: {
+        need(1);
+        v.kind = Value::kInt;
+        v.i = static_cast<int8_t>(p[pos]);
+        pos += 1;
+        break;
+      }
+      case 0xd1: {
+        need(2);
+        v.kind = Value::kInt;
+        v.i = static_cast<int16_t>(read_be(p + pos, 2));
+        pos += 2;
+        break;
+      }
+      case 0xd2: {
+        need(4);
+        v.kind = Value::kInt;
+        v.i = static_cast<int32_t>(read_be(p + pos, 4));
+        pos += 4;
+        break;
+      }
+      case 0xd3: {
+        need(8);
+        v.kind = Value::kInt;
+        v.i = static_cast<int64_t>(read_be(p + pos, 8));
+        pos += 8;
+        break;
+      }
+      case 0xd9:
+      case 0xda:
+      case 0xdb: {
+        int n = 1 << (tag - 0xd9);
+        need(n);
+        size_t sz = read_be(p + pos, n);
+        pos += n;
+        take_str(sz, Value::kStr);
+        break;
+      }
+      case 0xdc:
+      case 0xdd: {
+        int n = tag == 0xdc ? 2 : 4;
+        need(n);
+        size_t count = read_be(p + pos, n);
+        pos += n;
+        return decode_seq(p, len, pos, count, false);
+      }
+      case 0xde:
+      case 0xdf: {
+        int n = tag == 0xde ? 2 : 4;
+        need(n);
+        size_t count = read_be(p + pos, n);
+        pos += n;
+        return decode_seq(p, len, pos, count, true);
+      }
+      default:
+        throw std::runtime_error("msgpack: unsupported tag");
+    }
+  }
+  return v;
+}
+
+inline Value decode_all(const std::string& buf) {
+  size_t pos = 0;
+  return decode(reinterpret_cast<const uint8_t*>(buf.data()), buf.size(), pos);
+}
+
+// ---- encoding -----------------------------------------------------------
+
+inline void write_be(std::string& out, uint64_t v, int n) {
+  for (int i = n - 1; i >= 0; --i)
+    out.push_back(static_cast<char>((v >> (8 * i)) & 0xff));
+}
+
+inline void encode_uint(std::string& out, uint64_t v) {
+  if (v <= 0x7f) {
+    out.push_back(static_cast<char>(v));
+  } else if (v <= 0xff) {
+    out.push_back(static_cast<char>(0xcc));
+    write_be(out, v, 1);
+  } else if (v <= 0xffff) {
+    out.push_back(static_cast<char>(0xcd));
+    write_be(out, v, 2);
+  } else if (v <= 0xffffffffULL) {
+    out.push_back(static_cast<char>(0xce));
+    write_be(out, v, 4);
+  } else {
+    out.push_back(static_cast<char>(0xcf));
+    write_be(out, v, 8);
+  }
+}
+
+inline void encode_int(std::string& out, int64_t v) {
+  if (v >= 0) {
+    encode_uint(out, static_cast<uint64_t>(v));
+  } else if (v >= -32) {
+    out.push_back(static_cast<char>(v));
+  } else {
+    out.push_back(static_cast<char>(0xd3));
+    write_be(out, static_cast<uint64_t>(v), 8);
+  }
+}
+
+inline void encode_str(std::string& out, const std::string& s) {
+  if (s.size() <= 31) {
+    out.push_back(static_cast<char>(0xa0 | s.size()));
+  } else if (s.size() <= 0xff) {
+    out.push_back(static_cast<char>(0xd9));
+    write_be(out, s.size(), 1);
+  } else {
+    out.push_back(static_cast<char>(0xda));
+    write_be(out, s.size(), 2);
+  }
+  out += s;
+}
+
+inline void encode_double(std::string& out, double d) {
+  out.push_back(static_cast<char>(0xcb));
+  uint64_t bits;
+  std::memcpy(&bits, &d, 8);
+  write_be(out, bits, 8);
+}
+
+inline void encode_bool(std::string& out, bool b) {
+  out.push_back(static_cast<char>(b ? 0xc3 : 0xc2));
+}
+
+inline void encode_nil(std::string& out) {
+  out.push_back(static_cast<char>(0xc0));
+}
+
+inline void encode_array_header(std::string& out, size_t n) {
+  if (n <= 15) {
+    out.push_back(static_cast<char>(0x90 | n));
+  } else {
+    out.push_back(static_cast<char>(0xdc));
+    write_be(out, n, 2);
+  }
+}
+
+inline void encode_map_header(std::string& out, size_t n) {
+  if (n <= 15) {
+    out.push_back(static_cast<char>(0x80 | n));
+  } else {
+    out.push_back(static_cast<char>(0xde));
+    write_be(out, n, 2);
+  }
+}
+
+}  // namespace msgpack
+}  // namespace persia

@@ -1,0 +1,231 @@
+// Frame IO for the persia_tpu RPC protocol (persia_tpu/rpc.py is the
+// format's source of truth):
+//   u32 frame_len | u8 flags | u16 env_len | env | payload
+// env = msgpack [method, payload_len] (request) / [status, ..., len]
+// (response); flags bit 0 = zstd-compressed payload.
+#pragma once
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+#include <zstd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <stdexcept>
+#include <string>
+
+#include "msgpack_lite.h"
+
+namespace persia {
+namespace net {
+
+constexpr uint8_t kFlagCompressed = 1;
+constexpr size_t kCompressThreshold = 1 << 16;
+
+inline void write_all(int fd, const char* data, size_t len) {
+  while (len) {
+    ssize_t n = ::send(fd, data, len, MSG_NOSIGNAL);
+    if (n <= 0) throw std::runtime_error("socket write failed");
+    data += n;
+    len -= static_cast<size_t>(n);
+  }
+}
+
+inline bool read_all(int fd, char* data, size_t len) {
+  while (len) {
+    ssize_t n = ::recv(fd, data, len, 0);
+    if (n <= 0) return false;
+    data += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+struct Message {
+  msgpack::Value env;
+  std::string payload;
+};
+
+// Returns false on clean EOF.
+inline bool recv_msg(int fd, Message* out) {
+  uint8_t head[7];
+  if (!read_all(fd, reinterpret_cast<char*>(head), 7)) return false;
+  uint32_t frame_len;
+  uint16_t env_len;
+  std::memcpy(&frame_len, head, 4);  // little-endian host assumed (x86/ARM)
+  uint8_t flags = head[4];
+  std::memcpy(&env_len, head + 5, 2);
+  if (frame_len < 3u + env_len) throw std::runtime_error("bad frame");
+  std::string body(frame_len - 3, '\0');
+  if (!read_all(fd, body.data(), body.size()))
+    throw std::runtime_error("truncated frame");
+  size_t pos = 0;
+  out->env = msgpack::decode(reinterpret_cast<const uint8_t*>(body.data()),
+                             env_len, pos);
+  out->payload = body.substr(env_len);
+  if (flags & kFlagCompressed) {
+    unsigned long long raw =
+        ZSTD_getFrameContentSize(out->payload.data(), out->payload.size());
+    if (raw == ZSTD_CONTENTSIZE_ERROR || raw == ZSTD_CONTENTSIZE_UNKNOWN)
+      throw std::runtime_error("bad zstd payload");
+    std::string plain(raw, '\0');
+    size_t got = ZSTD_decompress(plain.data(), plain.size(),
+                                 out->payload.data(), out->payload.size());
+    if (ZSTD_isError(got)) throw std::runtime_error("zstd decompress failed");
+    plain.resize(got);
+    out->payload = std::move(plain);
+  }
+  return true;
+}
+
+inline void send_msg(int fd, const std::string& env_body,
+                     const std::string& payload_in, bool allow_compress) {
+  std::string compressed;
+  const std::string* payload = &payload_in;
+  uint8_t flags = 0;
+  if (allow_compress && payload_in.size() > kCompressThreshold) {
+    compressed.resize(ZSTD_compressBound(payload_in.size()));
+    size_t n = ZSTD_compress(compressed.data(), compressed.size(),
+                             payload_in.data(), payload_in.size(), 3);
+    if (!ZSTD_isError(n) && n < payload_in.size()) {
+      compressed.resize(n);
+      payload = &compressed;
+      flags = kFlagCompressed;
+    }
+  }
+  uint32_t frame_len =
+      static_cast<uint32_t>(3 + env_body.size() + payload->size());
+  uint16_t env_len = static_cast<uint16_t>(env_body.size());
+  std::string head(7, '\0');
+  std::memcpy(head.data(), &frame_len, 4);
+  head[4] = static_cast<char>(flags);
+  std::memcpy(head.data() + 5, &env_len, 2);
+  write_all(fd, head.data(), head.size());
+  write_all(fd, env_body.data(), env_body.size());
+  write_all(fd, payload->data(), payload->size());
+}
+
+inline void send_ok(int fd, const std::string& payload) {
+  std::string env;
+  msgpack::encode_array_header(env, 2);
+  msgpack::encode_str(env, "ok");
+  msgpack::encode_uint(env, payload.size());
+  send_msg(fd, env, payload, true);
+}
+
+inline void send_err(int fd, const std::string& message) {
+  std::string env;
+  msgpack::encode_array_header(env, 3);
+  msgpack::encode_str(env, "err");
+  msgpack::encode_str(env, message);
+  msgpack::encode_uint(env, 0);
+  send_msg(fd, env, "", false);
+}
+
+// Client-side call (used for coordinator registration).
+inline std::string rpc_call(int fd, const std::string& method,
+                            const std::string& payload) {
+  std::string env;
+  msgpack::encode_array_header(env, 2);
+  msgpack::encode_str(env, method);
+  msgpack::encode_uint(env, payload.size());
+  send_msg(fd, env, payload, true);
+  Message resp;
+  if (!recv_msg(fd, &resp)) throw std::runtime_error("connection closed");
+  if (resp.env.arr.empty() || resp.env.arr[0].as_str() != "ok")
+    throw std::runtime_error(
+        "rpc error: " +
+        (resp.env.arr.size() > 1 ? resp.env.arr[1].as_str() : "?"));
+  return resp.payload;
+}
+
+inline int dial(const std::string& host, int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) throw std::runtime_error("socket() failed");
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1)
+    throw std::runtime_error("bad address " + host);
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0)
+    throw std::runtime_error("connect failed to " + host);
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+// ---- pack_arrays / unpack_arrays (rpc.py layout) ------------------------
+// u32 head_len | msgpack {"m": meta, "a": [[dtype, [shape...]], ...]} | bufs
+
+struct ArrayRef {
+  std::string dtype;
+  std::vector<int64_t> shape;
+  const char* data;
+  size_t nbytes;
+};
+
+inline size_t dtype_size(const std::string& dt) {
+  if (dt == "float32" || dt == "int32" || dt == "uint32") return 4;
+  if (dt == "float64" || dt == "int64" || dt == "uint64") return 8;
+  if (dt == "uint16" || dt == "int16" || dt == "bfloat16") return 2;
+  if (dt == "uint8" || dt == "int8" || dt == "bool") return 1;
+  throw std::runtime_error("unsupported dtype " + dt);
+}
+
+inline void unpack_arrays(const std::string& payload, msgpack::Value* meta,
+                          std::vector<ArrayRef>* arrays) {
+  if (payload.size() < 4) throw std::runtime_error("short payload");
+  uint32_t head_len;
+  std::memcpy(&head_len, payload.data(), 4);
+  size_t pos = 0;
+  msgpack::Value head = msgpack::decode(
+      reinterpret_cast<const uint8_t*>(payload.data() + 4), head_len, pos);
+  *meta = head.at("m");
+  const msgpack::Value& heads = head.at("a");
+  size_t offset = 4 + head_len;
+  for (const auto& h : heads.arr) {
+    ArrayRef ref;
+    ref.dtype = h.arr[0].as_str();
+    size_t count = 1;
+    for (const auto& d : h.arr[1].arr) {
+      ref.shape.push_back(d.as_int());
+      count *= static_cast<size_t>(d.as_int());
+    }
+    ref.nbytes = count * dtype_size(ref.dtype);
+    if (offset + ref.nbytes > payload.size())
+      throw std::runtime_error("array payload overrun");
+    ref.data = payload.data() + offset;
+    offset += ref.nbytes;
+    arrays->push_back(std::move(ref));
+  }
+}
+
+// Pack a single f32 matrix result (the PS lookup response shape).
+inline std::string pack_f32_array(const float* data, int64_t rows,
+                                  int64_t cols) {
+  std::string head;
+  msgpack::encode_map_header(head, 2);
+  msgpack::encode_str(head, "m");
+  msgpack::encode_map_header(head, 0);
+  msgpack::encode_str(head, "a");
+  msgpack::encode_array_header(head, 1);
+  msgpack::encode_array_header(head, 2);
+  msgpack::encode_str(head, "float32");
+  msgpack::encode_array_header(head, 2);
+  msgpack::encode_int(head, rows);
+  msgpack::encode_int(head, cols);
+  std::string out;
+  uint32_t head_len = static_cast<uint32_t>(head.size());
+  out.resize(4);
+  std::memcpy(out.data(), &head_len, 4);
+  out += head;
+  out.append(reinterpret_cast<const char*>(data),
+             sizeof(float) * static_cast<size_t>(rows * cols));
+  return out;
+}
+
+}  // namespace net
+}  // namespace persia

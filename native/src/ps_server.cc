@@ -1,0 +1,404 @@
+// persia-embedding-ps: native parameter-server service binary.
+//
+// The C++ twin of persia_tpu/service/ps_service.py (reference:
+// src/bin/persia-embedding-parameter-server.rs + the RPC surface of
+// embedding_parameter_service/mod.rs:491-593): speaks the framework RPC
+// protocol directly over TCP (thread per connection), serves the sharded
+// LRU store in-process — no Python in the lookup/update path at all —
+// and registers itself with the coordinator.
+//
+// Usage: persia-embedding-ps --port 0 --capacity 1000000000
+//        --num-shards 100 --replica-index 0 [--coordinator host:port]
+#include <getopt.h>
+
+#include <atomic>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "net.h"
+#include "store.h"
+
+using persia::InitParams;
+using persia::Store;
+namespace mp = persia::msgpack;
+namespace net = persia::net;
+
+namespace {
+
+std::atomic<bool> g_running{true};
+
+int init_method_code(const std::string& name) {
+  if (name == "bounded_uniform") return persia::kBoundedUniform;
+  if (name == "bounded_gamma") return persia::kBoundedGamma;
+  if (name == "bounded_poisson") return persia::kBoundedPoisson;
+  if (name == "normal") return persia::kNormal;
+  if (name == "truncated_normal") return persia::kTruncatedNormal;
+  if (name == "zero") return persia::kZero;
+  throw std::runtime_error("unknown init method " + name);
+}
+
+// Serialize an optimizer config map to the OptimizerConfig::parse wire
+// string (mirrors persia_tpu/ps/native.py optimizer_config_to_wire).
+std::string optimizer_wire(const mp::Value& cfg, uint32_t prefix_bit) {
+  const std::string& kind = cfg.at("type").as_str();
+  auto num = [&](const char* key, double dflt) {
+    const mp::Value* v = cfg.get(key);
+    return v ? v->as_double() : dflt;
+  };
+  std::ostringstream os;
+  if (kind == "sgd") {
+    os << "sgd " << num("lr", 0.01) << " " << num("wd", 0.0);
+  } else if (kind == "adagrad") {
+    const mp::Value* shared = cfg.get("vectorwise_shared");
+    os << "adagrad " << num("lr", 1e-2) << " " << num("wd", 0.0) << " "
+       << num("g_square_momentum", 1.0) << " " << num("initialization", 1e-2)
+       << " " << num("eps", 1e-10) << " "
+       << ((shared && shared->as_bool()) ? 1 : 0);
+  } else if (kind == "adam") {
+    os << "adam " << num("lr", 1e-3) << " " << num("beta1", 0.9) << " "
+       << num("beta2", 0.999) << " " << num("eps", 1e-8) << " " << prefix_bit;
+  } else {
+    throw std::runtime_error("unknown optimizer " + kind);
+  }
+  return os.str();
+}
+
+class PsServer {
+ public:
+  PsServer(uint64_t capacity, uint32_t num_shards)
+      : store_(capacity, num_shards) {}
+
+  std::string dispatch(const std::string& method, const std::string& payload) {
+    if (method == "configure") return do_configure(payload);
+    if (method == "register_optimizer") return do_register_optimizer(payload);
+    if (method == "lookup") return do_lookup(payload);
+    if (method == "update_gradients") return do_update(payload);
+    if (method == "len") return do_len();
+    if (method == "get_entry") return do_get_entry(payload);
+    if (method == "set_entry") return do_set_entry(payload);
+    if (method == "clear") {
+      store_.clear();
+      return "";
+    }
+    if (method == "dump") return do_dump(payload);
+    if (method == "load") return do_load(payload);
+    if (method == "status") return do_status();
+    if (method == "ready_for_serving") return do_ready();
+    throw std::runtime_error("no such method " + method);
+  }
+
+ private:
+  std::string do_configure(const std::string& payload) {
+    mp::Value req = mp::decode_all(payload);
+    InitParams p;
+    const mp::Value& ip = req.at("init_params");
+    auto opt = [&](const char* key, double dflt) {
+      const mp::Value* v = ip.get(key);
+      return v ? v->as_double() : dflt;
+    };
+    p.lower = opt("lower", -0.01);
+    p.upper = opt("upper", 0.01);
+    p.mean = opt("mean", 0.0);
+    p.stddev = opt("standard_deviation", 0.01);
+    p.shape = opt("shape", 1.0);
+    p.scale = opt("scale", 1.0);
+    p.lambda = opt("lambda", 1.0);
+    store_.configure(
+        init_method_code(req.at("init_method").as_str()), p,
+        static_cast<float>(req.at("admit_probability").as_double()),
+        static_cast<float>(req.at("weight_bound").as_double()),
+        req.at("enable_weight_bound").as_bool());
+    return "";
+  }
+
+  std::string do_register_optimizer(const std::string& payload) {
+    mp::Value req = mp::decode_all(payload);
+    uint32_t prefix_bit = static_cast<uint32_t>(
+        req.at("feature_index_prefix_bit").as_int());
+    if (!store_.register_optimizer(
+            optimizer_wire(req.at("config"), prefix_bit)))
+      throw std::runtime_error("bad optimizer config");
+    return "";
+  }
+
+  std::string do_lookup(const std::string& payload) {
+    mp::Value meta;
+    std::vector<net::ArrayRef> arrays;
+    net::unpack_arrays(payload, &meta, &arrays);
+    uint32_t dim = static_cast<uint32_t>(meta.at("dim").as_int());
+    bool training = meta.at("training").as_bool();
+    const net::ArrayRef& signs = arrays.at(0);
+    uint64_t n = signs.nbytes / 8;
+    std::vector<float> out(n * dim);
+    if (store_.lookup(reinterpret_cast<const uint64_t*>(signs.data), n, dim,
+                      training, out.data()) != 0)
+      throw std::runtime_error("store not configured / no optimizer");
+    return net::pack_f32_array(out.data(), static_cast<int64_t>(n), dim);
+  }
+
+  std::string do_update(const std::string& payload) {
+    mp::Value meta;
+    std::vector<net::ArrayRef> arrays;
+    net::unpack_arrays(payload, &meta, &arrays);
+    uint32_t dim = static_cast<uint32_t>(meta.at("dim").as_int());
+    const net::ArrayRef& signs = arrays.at(0);
+    const net::ArrayRef& grads = arrays.at(1);
+    if (store_.update(reinterpret_cast<const uint64_t*>(signs.data),
+                      signs.nbytes / 8, dim,
+                      reinterpret_cast<const float*>(grads.data)) != 0)
+      throw std::runtime_error("optimizer not registered");
+    return "";
+  }
+
+  std::string do_len() {
+    std::string out;
+    mp::encode_map_header(out, 1);
+    mp::encode_str(out, "len");
+    mp::encode_uint(out, store_.size());
+    return out;
+  }
+
+  std::string do_get_entry(const std::string& payload) {
+    mp::Value req = mp::decode_all(payload);
+    uint64_t sign = req.at("sign").as_uint();
+    uint32_t dim = 0;
+    int64_t len = store_.get_entry(sign, nullptr, 0, &dim);
+    std::string head;
+    if (len < 0) {
+      mp::encode_map_header(head, 2);
+      mp::encode_str(head, "m");
+      mp::encode_map_header(head, 2);
+      mp::encode_str(head, "found");
+      mp::encode_bool(head, false);
+      mp::encode_str(head, "dim");
+      mp::encode_uint(head, 0);
+      mp::encode_str(head, "a");
+      mp::encode_array_header(head, 0);
+      std::string out(4, '\0');
+      uint32_t hl = static_cast<uint32_t>(head.size());
+      std::memcpy(out.data(), &hl, 4);
+      return out + head;
+    }
+    std::vector<float> vec(static_cast<size_t>(len));
+    store_.get_entry(sign, vec.data(), static_cast<uint32_t>(len), &dim);
+    mp::encode_map_header(head, 2);
+    mp::encode_str(head, "m");
+    mp::encode_map_header(head, 2);
+    mp::encode_str(head, "found");
+    mp::encode_bool(head, true);
+    mp::encode_str(head, "dim");
+    mp::encode_uint(head, dim);
+    mp::encode_str(head, "a");
+    mp::encode_array_header(head, 1);
+    mp::encode_array_header(head, 2);
+    mp::encode_str(head, "float32");
+    mp::encode_array_header(head, 1);
+    mp::encode_int(head, len);
+    std::string out(4, '\0');
+    uint32_t hl = static_cast<uint32_t>(head.size());
+    std::memcpy(out.data(), &hl, 4);
+    out += head;
+    out.append(reinterpret_cast<const char*>(vec.data()),
+               sizeof(float) * vec.size());
+    return out;
+  }
+
+  std::string do_set_entry(const std::string& payload) {
+    mp::Value meta;
+    std::vector<net::ArrayRef> arrays;
+    net::unpack_arrays(payload, &meta, &arrays);
+    const net::ArrayRef& vec = arrays.at(0);
+    store_.set_entry(meta.at("sign").as_uint(),
+                     static_cast<uint32_t>(meta.at("dim").as_int()),
+                     reinterpret_cast<const float*>(vec.data),
+                     static_cast<uint32_t>(vec.nbytes / 4));
+    return "";
+  }
+
+  std::string do_dump(const std::string& payload) {
+    mp::Value req = mp::decode_all(payload);
+    set_status("Dumping");
+    bool ok = store_.dump_file(req.at("path").as_str().c_str());
+    set_status(ok ? "Idle" : "Failed: dump error");
+    if (!ok) throw std::runtime_error("dump failed");
+    return "";
+  }
+
+  std::string do_load(const std::string& payload) {
+    mp::Value req = mp::decode_all(payload);
+    const mp::Value* clear = req.get("clear");
+    set_status("Loading");
+    bool ok = store_.load_file(req.at("path").as_str().c_str(),
+                               clear == nullptr || clear->as_bool());
+    set_status(ok ? "Idle" : "Failed: load error");
+    if (!ok) throw std::runtime_error("load failed");
+    return "";
+  }
+
+  std::string do_status() {
+    std::string out;
+    mp::encode_map_header(out, 1);
+    mp::encode_str(out, "status");
+    std::lock_guard<std::mutex> lk(status_mu_);
+    mp::encode_str(out, status_);
+    return out;
+  }
+
+  std::string do_ready() {
+    std::string out;
+    mp::encode_map_header(out, 1);
+    mp::encode_str(out, "ready");
+    std::lock_guard<std::mutex> lk(status_mu_);
+    mp::encode_bool(out, store_.has_optimizer() && status_ == "Idle");
+    return out;
+  }
+
+  void set_status(const std::string& s) {
+    std::lock_guard<std::mutex> lk(status_mu_);
+    status_ = s;
+  }
+
+  Store store_;
+  std::string status_ = "Idle";
+  std::mutex status_mu_;
+};
+
+void serve_conn(PsServer* server, int fd) {
+  net::Message msg;
+  for (;;) {
+    try {
+      if (!net::recv_msg(fd, &msg)) break;
+    } catch (const std::exception&) {
+      break;
+    }
+    const std::string method = msg.env.arr.at(0).as_str();
+    if (method == "__shutdown__") {
+      net::send_ok(fd, "");
+      g_running = false;
+      // exit the whole process like RpcServer.stop + shutdown_cb
+      std::exit(0);
+    }
+    try {
+      std::string result = server->dispatch(method, msg.payload);
+      net::send_ok(fd, result);
+    } catch (const std::exception& e) {
+      try {
+        net::send_err(fd, std::string(typeid(e).name()) + ": " + e.what());
+      } catch (const std::exception&) {
+        break;
+      }
+    }
+  }
+  ::close(fd);
+}
+
+void register_with_coordinator(const std::string& coordinator,
+                               const std::string& my_addr, int replica_index) {
+  size_t colon = coordinator.rfind(':');
+  int fd = net::dial(coordinator.substr(0, colon),
+                     std::atoi(coordinator.c_str() + colon + 1));
+  std::string payload;
+  mp::encode_map_header(payload, 3);
+  mp::encode_str(payload, "role");
+  mp::encode_str(payload, "embedding-parameter-server");
+  mp::encode_str(payload, "replica_index");
+  mp::encode_int(payload, replica_index);
+  mp::encode_str(payload, "addr");
+  mp::encode_str(payload, my_addr);
+  net::rpc_call(fd, "register", payload);
+  ::close(fd);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string host = "127.0.0.1";
+  int port = 0;
+  uint64_t capacity = 1000000000ULL;
+  uint32_t num_shards = 100;
+  int replica_index = 0;
+  std::string coordinator;
+  if (const char* env = std::getenv("REPLICA_INDEX"))
+    replica_index = std::atoi(env);
+  if (const char* env = std::getenv("PERSIA_COORDINATOR_ADDR"))
+    coordinator = env;
+
+  static option longopts[] = {
+      {"host", required_argument, nullptr, 'h'},
+      {"port", required_argument, nullptr, 'p'},
+      {"capacity", required_argument, nullptr, 'c'},
+      {"num-shards", required_argument, nullptr, 's'},
+      {"replica-index", required_argument, nullptr, 'r'},
+      {"coordinator", required_argument, nullptr, 'o'},
+      {nullptr, 0, nullptr, 0},
+  };
+  int opt;
+  while ((opt = getopt_long(argc, argv, "", longopts, nullptr)) != -1) {
+    switch (opt) {
+      case 'h':
+        host = optarg;
+        break;
+      case 'p':
+        port = std::atoi(optarg);
+        break;
+      case 'c':
+        capacity = std::strtoull(optarg, nullptr, 10);
+        break;
+      case 's':
+        num_shards = static_cast<uint32_t>(std::atoi(optarg));
+        break;
+      case 'r':
+        replica_index = std::atoi(optarg);
+        break;
+      case 'o':
+        coordinator = optarg;
+        break;
+      default:
+        std::fprintf(stderr, "unknown option\n");
+        return 2;
+    }
+  }
+
+  int listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  ::setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  ::inet_pton(AF_INET, host.c_str(), &addr.sin_addr);
+  if (::bind(listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) !=
+      0) {
+    std::perror("bind");
+    return 1;
+  }
+  ::listen(listen_fd, 128);
+  socklen_t alen = sizeof(addr);
+  ::getsockname(listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  std::string my_addr = host + ":" + std::to_string(ntohs(addr.sin_port));
+  std::fprintf(stderr, "persia-embedding-ps %d listening on %s\n",
+               replica_index, my_addr.c_str());
+
+  PsServer server(capacity, num_shards);
+  if (!coordinator.empty()) {
+    try {
+      register_with_coordinator(coordinator, my_addr, replica_index);
+    } catch (const std::exception& e) {
+      std::fprintf(stderr, "coordinator registration failed: %s\n", e.what());
+      return 1;
+    }
+  }
+
+  while (g_running) {
+    int conn = ::accept(listen_fd, nullptr, nullptr);
+    if (conn < 0) continue;
+    ::setsockopt(conn, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    std::thread(serve_conn, &server, conn).detach();
+  }
+  return 0;
+}

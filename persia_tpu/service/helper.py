@@ -72,10 +72,16 @@ class ServiceCtx:
         global_config_path: Optional[str] = None,
         env: Optional[dict] = None,
         startup_timeout: float = 120.0,
+        native_ps: bool = False,
+        ps_capacity: int = 1_000_000_000,
+        ps_num_shards: int = 16,
     ):
         self.schema = schema
         self.n_workers = n_workers
         self.n_ps = n_ps
+        self.native_ps = native_ps
+        self.ps_capacity = ps_capacity
+        self.ps_num_shards = ps_num_shards
         self.global_config_path = global_config_path
         self.extra_env = env or {}
         self.startup_timeout = startup_timeout
@@ -90,6 +96,11 @@ class ServiceCtx:
 
     def _spawn(self, args: List[str], name: str, replica_index: int,
                replica_size: int) -> subprocess.Popen:
+        return self._spawn_raw([sys.executable, *args], name, replica_index,
+                               replica_size)
+
+    def _spawn_raw(self, cmd: List[str], name: str, replica_index: int,
+                   replica_size: int) -> subprocess.Popen:
         env = dict(os.environ)
         env["PYTHONPATH"] = _REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
         env["REPLICA_INDEX"] = str(replica_index)
@@ -97,7 +108,7 @@ class ServiceCtx:
         if self.coordinator_addr:
             env["PERSIA_COORDINATOR_ADDR"] = self.coordinator_addr
         env.update({k: str(v) for k, v in self.extra_env.items()})
-        proc = subprocess.Popen([sys.executable, *args], env=env)
+        proc = subprocess.Popen(cmd, env=env)
         proc._persia_name = name  # type: ignore[attr-defined]
         self.procs.append(proc)
         return proc
@@ -121,6 +132,18 @@ class ServiceCtx:
             time.sleep(0.05)
 
         for i in range(self.n_ps):
+            if self.native_ps:
+                from persia_tpu.utils import resolve_binary_path
+
+                binary = resolve_binary_path("persia-embedding-ps")
+                self._spawn_raw(
+                    [binary, "--replica-index", str(i),
+                     "--capacity", str(self.ps_capacity),
+                     "--num-shards", str(self.ps_num_shards),
+                     "--coordinator", self.coordinator_addr],
+                    f"ps-{i}", i, self.n_ps,
+                )
+                continue
             args = ["-m", "persia_tpu.service.ps_service",
                     "--replica-index", str(i),
                     "--replica-size", str(self.n_ps),

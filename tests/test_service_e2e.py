@@ -185,3 +185,31 @@ def test_inference_server_end_to_end(cluster):
         np.testing.assert_array_equal(preds, client.predict(b))
     finally:
         server.server.stop()
+
+
+def test_native_ps_cluster_end_to_end():
+    """Full cluster with the C++ persia-embedding-ps binary as the PS tier."""
+    with ServiceCtx(_schema(), n_workers=1, n_ps=2, native_ps=True,
+                    ps_capacity=100_000, ps_num_shards=4) as svc:
+        w = svc.remote_worker()
+        w.configure_parameter_servers(
+            "bounded_uniform", {"lower": -0.1, "upper": 0.1}, 1.0, 10.0)
+        w.register_optimizer({"type": "adagrad", "lr": 0.01})
+        ctx = TrainCtx(
+            model=DNN(),
+            dense_optimizer=optax.adam(1e-3),
+            embedding_optimizer=Adagrad(lr=1e-2),
+            schema=_schema(),
+            worker=w,
+            embedding_config=EmbeddingConfig(),
+        )
+        losses = []
+        with ctx:
+            for b in batches(6 * 128, 128, seed=41):
+                loss, _ = ctx.train_step(b)
+                losses.append(float(loss))
+        assert np.isfinite(losses).all() and len(losses) == 6
+        from persia_tpu.service.ps_service import PsClient
+
+        total = sum(len(PsClient(a)) for a in svc.ps_addrs)
+        assert total > 0
