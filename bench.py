@@ -67,11 +67,15 @@ def make_batches(num, batch_size, ids_per_slot=1, seed=0):
     return out
 
 
-def bench_hybrid(batch_size, steps, warmup, n_ps=2):
+def bench_hybrid(batch_size, steps, warmup, n_ps=2, staleness=8):
+    """Full PERSIA path with the async pipeline: PS lookups and gradient
+    returns overlap the jitted device step, bounded by the staleness
+    semaphore (the reference's headline configuration)."""
     import optax
 
     from persia_tpu.config import EmbeddingSchema, uniform_slots
     from persia_tpu.ctx import TrainCtx
+    from persia_tpu.data.dataloader import DataLoader, IterableDataset
     from persia_tpu.embedding import EmbeddingConfig
     from persia_tpu.embedding.optim import Adagrad
     from persia_tpu.models import DLRM
@@ -94,17 +98,27 @@ def bench_hybrid(batch_size, steps, warmup, n_ps=2):
         embedding_config=EmbeddingConfig(),
     )
     batches = make_batches(warmup + steps, batch_size)
-    with ctx:
-        for b in batches[:warmup]:
-            loss, _ = ctx.train_step(b)
-        import jax
+    import jax
 
-        jax.block_until_ready(loss)
-        t0 = time.perf_counter()
-        for b in batches[warmup:]:
-            loss, _ = ctx.train_step(b)
+    with ctx:
+        loader = DataLoader(
+            IterableDataset(iter(batches)),
+            num_workers=4,
+            embedding_staleness=staleness,
+            forward_buffer_size=staleness,
+        )
+        elapsed = None
+        done = 0
+        t0 = None
+        for lb in loader:
+            loss, _ = ctx.train_step(lb)
+            done += 1
+            if done == warmup:
+                jax.block_until_ready(loss)
+                t0 = time.perf_counter()
         jax.block_until_ready(loss)
         elapsed = time.perf_counter() - t0
+        loader._engine.flush()
     return steps * batch_size / elapsed
 
 

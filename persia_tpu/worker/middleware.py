@@ -67,6 +67,33 @@ class DedupedFeature:
             return self.num_distinct
         return int(self.raw_row_of_distinct.max()) + 1 if len(self.raw_row_of_distinct) else 0
 
+    @property
+    def distinct_order(self) -> np.ndarray:
+        """Element permutation sorting elem_distinct (cached); used for
+        segment-summed gradient aggregation."""
+        if getattr(self, "_distinct_order", None) is None:
+            self._distinct_order = np.argsort(self.elem_distinct,
+                                              kind="stable")
+        return self._distinct_order
+
+
+def _segment_sum(values: np.ndarray, segment_ids_sorted: np.ndarray,
+                 num_segments: int) -> np.ndarray:
+    """Sum rows of `values` grouped by nondecreasing segment ids.
+
+    np.add.reduceat over contiguous runs — roughly an order of magnitude
+    faster than np.add.at's scattered atomics on big batches.
+    """
+    out = np.zeros((num_segments, values.shape[1]), dtype=values.dtype)
+    if len(segment_ids_sorted) == 0:
+        return out
+    run_starts = np.nonzero(
+        np.diff(segment_ids_sorted, prepend=segment_ids_sorted[0] - 1)
+    )[0]
+    sums = np.add.reduceat(values, run_starts, axis=0)
+    out[segment_ids_sorted[run_starts]] = sums
+    return out
+
 
 def dedup_feature(feature: IDTypeFeature) -> DedupedFeature:
     """CSR feature -> distinct signs + element back-pointers."""
@@ -226,8 +253,8 @@ def postprocess_feature(
     bs = feat.batch_size
     dim = slot.dim
     if slot.embedding_summation:
-        out = np.zeros((bs, dim), dtype=np.float32)
-        np.add.at(out, feat.elem_sample, emb[feat.elem_distinct])
+        # elem_sample is nondecreasing (CSR order), so a segment sum works
+        out = _segment_sum(emb[feat.elem_distinct], feat.elem_sample, bs)
         if slot.sqrt_scaling:
             n = np.maximum(feat.sample_num_signs, 1).astype(np.float32)
             out *= (1.0 / np.sqrt(n))[:, None]
@@ -271,12 +298,15 @@ def aggregate_gradients(
         grad = np.nan_to_num(grad, nan=0.0, posinf=0.0, neginf=0.0)
     if loss_scale != 1.0:
         grad = grad * (1.0 / loss_scale)
-    out = np.zeros((feat.num_distinct, dim), dtype=np.float32)
     if slot.embedding_summation:
         if slot.sqrt_scaling:
             n = np.maximum(feat.sample_num_signs, 1).astype(np.float32)
             grad = grad * (1.0 / np.sqrt(n))[:, None]
-        np.add.at(out, feat.elem_distinct, grad[feat.elem_sample])
+        order = feat.distinct_order
+        out = _segment_sum(
+            grad[feat.elem_sample[order]], feat.elem_distinct[order],
+            feat.num_distinct,
+        )
     else:
         rows = (
             feat.raw_row_of_distinct
