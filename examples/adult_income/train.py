@@ -36,14 +36,26 @@ logger = get_default_logger("adult_income")
 EMBEDDING_DIM = 8
 
 
-def build_ctx(n_ps: int = 2, seed: int = 42) -> TrainCtx:
+def build_ctx(n_ps: int = 2, seed: int = 42,
+              config_dir: str = None) -> TrainCtx:
     setup_seed(seed)
-    schema = EmbeddingSchema(
-        slots_config=uniform_slots(
-            [f"slot_{s}" for s in range(NUM_SLOTS)], dim=EMBEDDING_DIM
+    if config_dir:
+        from persia_tpu.config import GlobalConfig
+
+        schema = EmbeddingSchema.load(f"{config_dir}/embedding_config.yml")
+        gc = GlobalConfig.load(f"{config_dir}/global_config.yml")
+        holders = [
+            make_holder(gc.parameter_server.capacity,
+                        gc.parameter_server.num_hashmap_internal_shards)
+            for _ in range(n_ps)
+        ]
+    else:
+        schema = EmbeddingSchema(
+            slots_config=uniform_slots(
+                [f"slot_{s}" for s in range(NUM_SLOTS)], dim=EMBEDDING_DIM
+            )
         )
-    )
-    holders = [make_holder(1_000_000, 8) for _ in range(n_ps)]
+        holders = [make_holder(1_000_000, 8) for _ in range(n_ps)]
     worker = EmbeddingWorker(schema, holders)
     return TrainCtx(
         model=DNN(sparse_mlp_output_size=128),
