@@ -16,6 +16,7 @@ from persia_tpu.models.dcn import DCNv2
 from persia_tpu.models.deepfm import DeepFM
 from persia_tpu.models.dlrm import DLRM
 from persia_tpu.models.dnn import DNN
+from persia_tpu.models.seq import SequenceSelfAttention, SequenceTower
 
 __all__ = [
     "MLP",
@@ -23,6 +24,8 @@ __all__ = [
     "DLRM",
     "DCNv2",
     "DeepFM",
+    "SequenceTower",
+    "SequenceSelfAttention",
     "flatten_embeddings",
     "gather_raw_embedding",
     "stack_field_embeddings",

@@ -1,0 +1,96 @@
+"""Sequence tower: self-attention over user-history (raw) slots.
+
+Recommendation models increasingly attend over long user-behavior
+sequences (DIN/SASRec-style). The reference can only bag-sum its raw
+slots; here raw slots become true sequences: gather → multi-head
+self-attention → masked mean pool, with the attention core switchable to
+ring attention over a mesh axis for histories too long for one chip
+(persia_tpu/parallel/ring_attention.py).
+"""
+
+from typing import Any, Optional, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from persia_tpu.models.common import MLP, gather_raw_embedding
+
+
+class SequenceSelfAttention(nn.Module):
+    num_heads: int = 2
+    compute_dtype: Any = jnp.bfloat16
+    mesh: Optional[Any] = None
+    seq_axis: str = "model"
+    causal: bool = False
+
+    @nn.compact
+    def __call__(self, x, mask):
+        """x: (bs, t, d); mask: (bs, t) bool -> (bs, t, d)."""
+        from persia_tpu.parallel.ring_attention import (
+            reference_attention,
+            ring_self_attention,
+        )
+
+        bs, t, d = x.shape
+        dh = max(1, d // self.num_heads)
+        dt = self.compute_dtype
+        q = nn.Dense(self.num_heads * dh, dtype=dt)(x.astype(dt))
+        k = nn.Dense(self.num_heads * dh, dtype=dt)(x.astype(dt))
+        v = nn.Dense(self.num_heads * dh, dtype=dt)(x.astype(dt))
+
+        def heads(y):  # (bs, t, h*dh) -> (bs, h, t, dh)
+            return y.reshape(bs, t, self.num_heads, dh).transpose(0, 2, 1, 3)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        # masked positions contribute ~nothing: zero their keys/values and
+        # rely on the zero rows being uniform noise floor under softmax
+        km = mask[:, None, :, None]
+        k = jnp.where(km, k, jnp.asarray(-1e4, k.dtype))
+        v = jnp.where(km, v, 0)
+        if self.mesh is not None and self.mesh.shape[self.seq_axis] > 1:
+            out = ring_self_attention(
+                q.astype(jnp.float32), k.astype(jnp.float32),
+                v.astype(jnp.float32),
+                self.mesh, seq_axis=self.seq_axis, causal=self.causal)
+        else:
+            out = reference_attention(
+                q.astype(jnp.float32), k.astype(jnp.float32),
+                v.astype(jnp.float32), causal=self.causal)
+        out = out.transpose(0, 2, 1, 3).reshape(bs, t, self.num_heads * dh)
+        return nn.Dense(d, dtype=dt)(out.astype(dt))
+
+
+class SequenceTower(nn.Module):
+    """Dense tower with attention-pooled sequence slots.
+
+    Raw (sequence) slots go through self-attention + masked mean pooling;
+    summed slots and dense features concatenate as usual; MLP head.
+    """
+
+    mlp: Sequence[int] = (256, 128)
+    num_heads: int = 2
+    compute_dtype: Any = jnp.bfloat16
+    mesh: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, non_id_tensors, embedding_tensors, train: bool = False):
+        dt = self.compute_dtype
+        parts = [t.astype(dt) for t in non_id_tensors]
+        for e in embedding_tensors:
+            if isinstance(e, (tuple, list)):
+                emb, index = e
+                x, mask = gather_raw_embedding(emb, index)
+                attended = SequenceSelfAttention(
+                    num_heads=self.num_heads, compute_dtype=dt,
+                    mesh=self.mesh,
+                )(x, mask)
+                denom = jnp.maximum(
+                    mask.sum(axis=1, keepdims=True), 1).astype(dt)
+                pooled = (attended * mask[..., None].astype(dt)).sum(axis=1)
+                parts.append(pooled / denom)
+            else:
+                parts.append(e.astype(dt))
+        x = jnp.concatenate(parts, axis=1)
+        x = MLP(self.mlp, compute_dtype=dt)(x, train)
+        out = nn.Dense(1, dtype=dt)(x)
+        return nn.sigmoid(out.astype(jnp.float32))

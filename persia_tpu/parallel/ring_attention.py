@@ -1,0 +1,110 @@
+"""Ring attention: context parallelism for long sequences.
+
+The reference has no long-context machinery (SURVEY.md §5 — its
+"sequences" are bags of IDs), but sequence towers over long user
+histories are a first-class need here. This implements blockwise ring
+attention (Liu et al.'s ring attention formulation): the sequence axis is
+sharded over a mesh axis; each step combines the local query block with
+the currently-held K/V block using the online-softmax (flash) update,
+then rotates K/V around the ring with ``lax.ppermute`` — compute on the
+current block overlaps the ICI transfer of the next, and no shard ever
+materializes the full sequence.
+
+Use inside ``shard_map`` (see :func:`ring_self_attention`), or directly
+under ``jit`` on one device where it degenerates to single-block flash
+attention.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def reference_attention(q, k, v, causal: bool = False):
+    """O(T^2)-memory reference: softmax(q kᵀ / sqrt(d)) v.
+
+    q, k, v: (B, H, T, Dh)."""
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        t = q.shape[2]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def ring_attention(q, k, v, axis_name: Optional[str] = None,
+                   causal: bool = False):
+    """Blockwise attention over a ring-sharded sequence axis.
+
+    q, k, v: (B, H, T_local, Dh) — this shard's sequence block. With
+    ``axis_name=None`` (or axis size 1) this is plain flash attention on
+    the local block.
+    """
+    if axis_name is not None:
+        axis_size = lax.psum(1, axis_name)
+        my_idx = lax.axis_index(axis_name)
+    else:
+        axis_size = 1
+        my_idx = 0
+    b, h, t_q, dh = q.shape
+    t_k = k.shape[2]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(dh, jnp.float32))
+    q32 = q.astype(jnp.float32)
+
+    q_pos = my_idx * t_q + lax.iota(jnp.int32, t_q)  # global query positions
+
+    def step(carry, i):
+        o, m, l, k_blk, v_blk = carry
+        # the block currently held originated on shard (my_idx - i) % size
+        src = (my_idx - i) % axis_size
+        s = jnp.einsum("bhqd,bhkd->bhqk", q32,
+                       k_blk.astype(jnp.float32)) * scale
+        if causal:
+            k_pos = src * t_k + lax.iota(jnp.int32, t_k)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask[None, None], s, -jnp.inf)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        # rows with no visible keys yet keep m=-inf; guard the exp
+        safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - safe_m[..., None])
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        correction = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
+        l = l * correction + p.sum(axis=-1)
+        o = o * correction[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32))
+        if axis_name is not None and axis_size > 1:
+            perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+            k_blk = lax.ppermute(k_blk, axis_name, perm)
+            v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return (o, m_new, l, k_blk, v_blk), None
+
+    o0 = jnp.zeros((b, h, t_q, dh), jnp.float32)
+    m0 = jnp.full((b, h, t_q), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, t_q), jnp.float32)
+    (o, m, l, _, _), _ = lax.scan(
+        step, (o0, m0, l0, k, v), jnp.arange(axis_size))
+    l = jnp.maximum(l, 1e-20)
+    return (o / l[..., None]).astype(q.dtype)
+
+
+def ring_self_attention(q, k, v, mesh: Mesh, seq_axis: str = "model",
+                        causal: bool = False):
+    """shard_map wrapper: q/k/v (B, H, T, Dh) with T sharded on
+    ``seq_axis``; returns attention output with the same sharding."""
+    from jax.experimental.shard_map import shard_map
+
+    spec = P(None, None, seq_axis, None)
+    fn = shard_map(
+        functools.partial(ring_attention, axis_name=seq_axis, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_rep=False,
+    )
+    return fn(q, k, v)

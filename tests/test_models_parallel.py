@@ -118,3 +118,21 @@ def test_device_embedding_collection_sharded_table():
         g = jax.jit(jax.grad(loss_fn))(params, ids)
     assert g["bag_a"]["table"].shape == (64, 8)
     assert float(jnp.abs(g["bag_a"]["table"]).sum()) > 0
+
+
+def test_sequence_tower_trains():
+    from persia_tpu.models import SequenceTower
+
+    model = SequenceTower()
+    non_id, emb_inputs, label = _inputs()
+    opt = optax.adam(1e-2)
+    state = create_train_state(model, opt, jax.random.key(2), non_id, emb_inputs)
+    step = make_train_step(model, opt)
+    ev, ei = split_embedding_inputs(emb_inputs)
+    losses = []
+    for _ in range(10):
+        state, loss, emb_grads, pred = step(state, non_id, ev, ei, label)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    # raw-slot gradient flows through attention
+    assert float(jnp.abs(emb_grads[3]).sum()) > 0
