@@ -17,6 +17,7 @@ from persia_tpu.models.deepfm import DeepFM
 from persia_tpu.models.dlrm import DLRM
 from persia_tpu.models.dnn import DNN
 from persia_tpu.models.seq import SequenceSelfAttention, SequenceTower
+from persia_tpu.models.wide_deep import WideAndDeep
 
 __all__ = [
     "MLP",
@@ -25,6 +26,7 @@ __all__ = [
     "DCNv2",
     "DeepFM",
     "SequenceTower",
+    "WideAndDeep",
     "SequenceSelfAttention",
     "flatten_embeddings",
     "gather_raw_embedding",

@@ -1,0 +1,29 @@
+"""Wide & Deep: linear (wide) memorization + MLP (deep) generalization.
+
+Rounds out the dense-tower family alongside DNN/DLRM/DCN-v2/DeepFM. The
+wide part is a single linear layer over all features; the deep part an
+MLP; outputs sum into one logit.
+"""
+
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from persia_tpu.models.common import MLP, flatten_embeddings
+
+
+class WideAndDeep(nn.Module):
+    deep_mlp: Sequence[int] = (256, 128, 64)
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, non_id_tensors, embedding_tensors, train: bool = False):
+        dt = self.compute_dtype
+        parts = [t.astype(dt) for t in non_id_tensors]
+        parts.append(flatten_embeddings(embedding_tensors).astype(dt))
+        x = jnp.concatenate(parts, axis=1)
+        wide = nn.Dense(1, dtype=dt, name="wide")(x)
+        deep = MLP(self.deep_mlp, compute_dtype=dt)(x, train)
+        deep = nn.Dense(1, dtype=dt, name="deep_head")(deep)
+        return nn.sigmoid((wide + deep).astype(jnp.float32))

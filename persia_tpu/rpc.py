@@ -188,13 +188,24 @@ class RpcServer:
 
 
 class RpcClient:
-    """Blocking client with one pooled connection per thread."""
+    """Blocking client with one pooled connection per thread.
 
-    def __init__(self, addr: str, timeout: float = 60.0):
+    Transient connection failures retry with backoff (the reference's
+    forward workers block on wait_for_serving until servers recover,
+    forward.rs:708-715; here the recovery wait lives in the client so
+    every caller gets it). Application-level errors (RpcError) never
+    retry. At-least-once semantics: a request may be re-sent if the
+    connection died after the server processed it.
+    """
+
+    def __init__(self, addr: str, timeout: float = 60.0,
+                 max_retries: int = 5, retry_backoff: float = 0.2):
         self.addr = addr
         host, port = addr.rsplit(":", 1)
         self._target = (host, int(port))
         self.timeout = timeout
+        self.max_retries = max_retries
+        self.retry_backoff = retry_backoff
         self._local = threading.local()
 
     def _conn(self) -> socket.socket:
@@ -206,16 +217,21 @@ class RpcClient:
         return conn
 
     def call(self, method: str, payload: bytes = b"") -> bytes:
-        try:
-            conn = self._conn()
-            _send_msg(conn, [method], payload, True)
-            env, result = _recv_msg(conn)
-        except (ConnectionError, OSError):
-            # one reconnect attempt (server may have restarted)
-            self._local.conn = None
-            conn = self._conn()
-            _send_msg(conn, [method], payload, True)
-            env, result = _recv_msg(conn)
+        import time
+
+        delay = self.retry_backoff
+        for attempt in range(self.max_retries + 1):
+            try:
+                conn = self._conn()
+                _send_msg(conn, [method], payload, True)
+                env, result = _recv_msg(conn)
+                break
+            except (ConnectionError, OSError):
+                self._local.conn = None
+                if attempt == self.max_retries:
+                    raise
+                time.sleep(delay)
+                delay = min(delay * 2, 5.0)
         if env[0] != "ok":
             raise RpcError(f"{self.addr} {method}: {env[1]}")
         return result

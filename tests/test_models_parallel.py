@@ -6,7 +6,7 @@ import numpy as np
 import optax
 import pytest
 
-from persia_tpu.models import DCNv2, DeepFM, DLRM, DNN
+from persia_tpu.models import DCNv2, DeepFM, DLRM, DNN, WideAndDeep
 from persia_tpu.parallel import (
     DeviceEmbeddingCollection,
     batch_sharding,
@@ -34,7 +34,7 @@ def _inputs():
     return [dense], embs + [raw], label
 
 
-@pytest.mark.parametrize("model_cls", [DNN, DLRM, DCNv2, DeepFM])
+@pytest.mark.parametrize("model_cls", [DNN, DLRM, DCNv2, DeepFM, WideAndDeep])
 def test_train_step_decreases_loss(model_cls):
     kw = {"embedding_dim": 8} if model_cls is DLRM else {}
     model = model_cls(**kw)

@@ -137,3 +137,35 @@ def test_native_update_missing_sign_counts():
     cc.update_gradients(np.array([1, 999], dtype=np.uint64),
                         np.ones((2, 4), np.float32), 4)
     assert cc.gradient_id_miss_count == 1
+
+
+def test_native_adagrad_reference_golden():
+    """The reference optimizer goldens (optim.rs:309-446) replayed through
+    the C++ store: seed an entry with the golden initial embedding, apply
+    the three golden gradient steps, compare the final entry."""
+    from tests.test_sparse_optim import DIM, GRADS, INIT_EMB
+
+    cc = NativeEmbeddingHolder(capacity=100, num_internal_shards=1)
+    cc.configure("zero", {})
+    cc.register_optimizer({
+        "type": "adagrad", "lr": 0.01, "wd": 0.0, "g_square_momentum": 1.0,
+        "initialization": 0.01, "eps": 1e-10, "vectorwise_shared": False,
+    })
+    sign = 42
+    vec = np.zeros(DIM * 2, np.float32)
+    vec[:DIM] = INIT_EMB
+    vec[DIM:] = 0.01  # adagrad state init
+    cc.set_entry(sign, DIM, vec)
+    for g in GRADS:
+        cc.update_gradients(np.array([sign], np.uint64),
+                            np.array([g], np.float32), DIM)
+    got = cc.get_entry(sign)[1]
+    expected = np.array([
+        0.6598564, -0.036559787, 0.04014046, 0.34159237, -0.053671654,
+        0.6320387, 0.1387946, 0.6141905, 0.47925496, -0.06816861, 0.7330182,
+        0.81526995,
+        0.6283042, 1.9333843, 1.1247585, 1.496624, 1.2661879, 0.7348535,
+        0.021523468, 1.1812702, 1.7385421, 1.073696, 0.13055718, 0.6626925,
+    ], np.float32)
+    np.testing.assert_allclose(got[:DIM], expected[:DIM], rtol=0, atol=5e-4)
+    np.testing.assert_allclose(got[DIM:], expected[DIM:], rtol=1e-6)
