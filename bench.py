@@ -165,7 +165,19 @@ def main():
     p.add_argument("--warmup", type=int, default=5)
     p.add_argument("--smoke", action="store_true",
                    help="tiny shapes, 3 steps — correctness only")
+    p.add_argument("--max-seconds", type=int, default=1200,
+                   help="hard watchdog: a wedged accelerator claim hangs "
+                        "inside PJRT client creation; abort with a "
+                        "diagnostic instead of hanging the harness")
     args = p.parse_args()
+
+    # Watchdog thread + hard exit: a Python signal handler would never run
+    # while the main thread is wedged inside PJRT client creation (native
+    # code), which is exactly the failure this guards against.
+    import faulthandler
+
+    log(f"bench: watchdog armed at {args.max_seconds}s")
+    faulthandler.dump_traceback_later(args.max_seconds, exit=True)
     if args.smoke:
         args.batch_size, args.steps, args.warmup = 256, 3, 1
 
