@@ -213,3 +213,71 @@ def test_native_ps_cluster_end_to_end():
 
         total = sum(len(PsClient(a)) for a in svc.ps_addrs)
         assert total > 0
+
+
+def test_incremental_update_through_services(tmp_path):
+    """Train-side PS emits delta packets (global config), infer-side holder
+    hot-loads them — the online-serving sync loop at cluster level."""
+    import yaml
+
+    from persia_tpu.inc_update import IncrementalUpdateLoader
+    from persia_tpu.ps.store import EmbeddingHolder
+    from persia_tpu.service.ps_service import PsClient
+
+    gc_path = tmp_path / "global.yml"
+    inc_dir = tmp_path / "inc"
+    yaml.safe_dump({
+        "common_config": {"job_type": "Train"},
+        "embedding_parameter_server_config": {
+            "capacity": 100000,
+            "num_hashmap_internal_shards": 2,
+            "enable_incremental_update": True,
+            "incremental_buffer_size": 10,
+            "incremental_dir": str(inc_dir),
+        },
+    }, gc_path.open("w"))
+    with ServiceCtx(_schema(), n_workers=1, n_ps=1,
+                    global_config_path=str(gc_path)) as svc:
+        ps = PsClient(svc.ps_addrs[0])
+        ps.configure("bounded_uniform", {"lower": -0.1, "upper": 0.1})
+        ps.register_optimizer({"type": "sgd", "lr": 0.1})
+        signs = np.arange(1, 40, dtype=np.uint64)
+        ps.lookup(signs, 4, True)
+        ps.update_gradients(signs, np.ones((39, 4), np.float32), 4)
+        expected = {int(s): ps.get_entry(int(s))[1] for s in signs[:5]}
+
+    infer_holder = EmbeddingHolder(1000, 2)
+    loaded = IncrementalUpdateLoader(infer_holder, str(inc_dir)).scan_once()
+    assert loaded >= 39
+    for s, vec in expected.items():
+        np.testing.assert_array_equal(infer_holder.get_entry(s)[1], vec)
+
+
+def test_dataflow_backpressure_retries():
+    """A full forward buffer must stall the data-loader (with backoff),
+    not drop batches (reference ForwardBufferFull contract). Verified
+    against a synthetic worker that reports fullness twice."""
+    receiver = DataflowReceiver()
+    try:
+        from persia_tpu.rpc import RpcError
+        from persia_tpu.service.dataflow import DataflowClient
+
+        class FullThenOkWorker:
+            def __init__(self):
+                self.calls = 0
+
+            def put_batch(self, feats):
+                self.calls += 1
+                if self.calls < 3:
+                    raise RpcError("x ForwardBufferFull y")
+                return ("w", 7)
+
+        w = FullThenOkWorker()
+        client = DataflowClient(w, [receiver.addr])
+        b = next(iter(batches(32, 32, seed=1)))
+        client.send(b)
+        assert w.calls == 3
+        got = receiver.get(timeout=10)
+        assert got.remote_ref == ("w", 7)
+    finally:
+        receiver.close()
