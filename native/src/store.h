@@ -31,56 +31,182 @@ struct Entry {
   std::vector<float> vec;  // [emb | opt state]
 };
 
-// LRU map: hashmap + recency list (least-recent at front).
+// LRU map: open-addressing flat hash table + array-backed doubly-linked
+// recency list (least-recent at head). The reference reached the same
+// conclusion (persia-embedding-holder's hashmap + ArrayLinkedList):
+// node-based std::list/unordered_map cost ~4 dependent cache misses per
+// lookup; a flat table + index links cost ~2.
 class EvictionMap {
+  static constexpr uint32_t kNil = 0xFFFFFFFFu;
+
+  struct Node {
+    uint64_t sign;
+    uint32_t prev;
+    uint32_t next;
+    Entry entry;
+  };
+
  public:
-  explicit EvictionMap(uint64_t capacity) : capacity_(capacity) {}
+  explicit EvictionMap(uint64_t capacity) : capacity_(capacity) {
+    rehash(1024);
+  }
 
   Entry* get(uint64_t sign) {
-    auto it = map_.find(sign);
-    return it == map_.end() ? nullptr : &*it->second;
+    uint32_t node = find(sign);
+    return node == kNil ? nullptr : &nodes_[node].entry;
   }
 
   Entry* get_refresh(uint64_t sign) {
-    auto it = map_.find(sign);
-    if (it == map_.end()) return nullptr;
-    list_.splice(list_.end(), list_, it->second);
-    return &*it->second;
+    uint32_t node = find(sign);
+    if (node == kNil) return nullptr;
+    detach(node);
+    push_back(node);
+    return &nodes_[node].entry;
   }
 
   // Returns true if an older entry was evicted.
   bool insert(uint64_t sign, uint32_t dim, std::vector<float> vec) {
-    auto it = map_.find(sign);
-    if (it != map_.end()) {
-      list_.erase(it->second);
-      map_.erase(it);
+    uint32_t node = find(sign);
+    if (node != kNil) {
+      nodes_[node].entry.dim = dim;
+      nodes_[node].entry.vec = std::move(vec);
+      detach(node);
+      push_back(node);
+      return false;
     }
-    list_.push_back(Entry{sign, dim, std::move(vec)});
-    map_[sign] = std::prev(list_.end());
-    if (list_.size() > capacity_) {
-      map_.erase(list_.front().sign);
-      list_.pop_front();
+    node = alloc_node();
+    Node& nd = nodes_[node];
+    nd.sign = sign;
+    nd.entry.sign = sign;
+    nd.entry.dim = dim;
+    nd.entry.vec = std::move(vec);
+    push_back(node);
+    table_insert(sign, node);
+    ++size_;
+    if (size_ > capacity_) {
+      uint32_t victim = head_;
+      table_erase(nodes_[victim].sign);
+      detach(victim);
+      nodes_[victim].entry.vec = std::vector<float>();
+      free_.push_back(victim);
+      --size_;
       return true;
     }
     return false;
   }
 
   void clear() {
-    map_.clear();
-    list_.clear();
+    table_.assign(table_.size(), {0, kNil});
+    nodes_.clear();
+    free_.clear();
+    head_ = tail_ = kNil;
+    size_ = 0;
   }
 
-  uint64_t size() const { return list_.size(); }
+  uint64_t size() const { return size_; }
 
   template <typename F>
   void for_each_lru(F&& f) const {
-    for (const auto& e : list_) f(e);
+    for (uint32_t n = head_; n != kNil; n = nodes_[n].next)
+      f(nodes_[n].entry);
   }
 
  private:
   uint64_t capacity_;
-  std::list<Entry> list_;
-  std::unordered_map<uint64_t, std::list<Entry>::iterator> map_;
+  std::vector<Node> nodes_;
+  std::vector<uint32_t> free_;
+  uint32_t head_ = kNil;
+  uint32_t tail_ = kNil;
+  uint64_t size_ = 0;
+  // (sign, node) slots; node == kNil means empty. Power-of-two size,
+  // linear probing, backward-shift deletion (no tombstones).
+  std::vector<std::pair<uint64_t, uint32_t>> table_;
+  uint64_t mask_ = 0;
+
+  uint64_t ideal(uint64_t sign) const { return splitmix_mix(sign) & mask_; }
+
+  uint32_t find(uint64_t sign) const {
+    uint64_t i = ideal(sign);
+    for (;;) {
+      const auto& slot = table_[i];
+      if (slot.second == kNil) return kNil;
+      if (slot.first == sign) return slot.second;
+      i = (i + 1) & mask_;
+    }
+  }
+
+  void table_insert(uint64_t sign, uint32_t node) {
+    if ((size_ + 1) * 10 > table_.size() * 7) rehash(table_.size() * 2);
+    uint64_t i = ideal(sign);
+    while (table_[i].second != kNil) i = (i + 1) & mask_;
+    table_[i] = {sign, node};
+  }
+
+  void table_erase(uint64_t sign) {
+    uint64_t i = ideal(sign);
+    while (table_[i].first != sign || table_[i].second == kNil) {
+      if (table_[i].second == kNil) return;  // not present
+      i = (i + 1) & mask_;
+    }
+    // backward-shift deletion keeps probe chains intact
+    uint64_t hole = i;
+    uint64_t j = (i + 1) & mask_;
+    while (table_[j].second != kNil) {
+      uint64_t h = ideal(table_[j].first);
+      // can slot j's entry legally move into the hole?
+      if (((j - h) & mask_) >= ((j - hole) & mask_)) {
+        table_[hole] = table_[j];
+        hole = j;
+      }
+      j = (j + 1) & mask_;
+    }
+    table_[hole] = {0, kNil};
+  }
+
+  void rehash(uint64_t new_size) {
+    std::vector<std::pair<uint64_t, uint32_t>> old = std::move(table_);
+    table_.assign(new_size, {0, kNil});
+    mask_ = new_size - 1;
+    for (const auto& slot : old) {
+      if (slot.second == kNil) continue;
+      uint64_t i = ideal(slot.first);
+      while (table_[i].second != kNil) i = (i + 1) & mask_;
+      table_[i] = slot;
+    }
+  }
+
+  uint32_t alloc_node() {
+    if (!free_.empty()) {
+      uint32_t n = free_.back();
+      free_.pop_back();
+      return n;
+    }
+    nodes_.push_back(Node{});
+    return static_cast<uint32_t>(nodes_.size() - 1);
+  }
+
+  void detach(uint32_t n) {
+    Node& nd = nodes_[n];
+    if (nd.prev != kNil)
+      nodes_[nd.prev].next = nd.next;
+    else
+      head_ = nd.next;
+    if (nd.next != kNil)
+      nodes_[nd.next].prev = nd.prev;
+    else
+      tail_ = nd.prev;
+  }
+
+  void push_back(uint32_t n) {
+    Node& nd = nodes_[n];
+    nd.prev = tail_;
+    nd.next = kNil;
+    if (tail_ != kNil)
+      nodes_[tail_].next = n;
+    else
+      head_ = n;
+    tail_ = n;
+  }
 };
 
 class Store {

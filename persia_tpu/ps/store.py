@@ -157,8 +157,13 @@ class EmbeddingHolder:
             if not self.configured:
                 raise RuntimeError("parameter server not configured")
         shard_ids = internal_shard_of(signs, self.num_internal_shards)
-        miss_positions: List[int] = []
-        mismatch_positions: List[int] = []
+        # Precompute admission + init material for ALL signs (vectorized);
+        # insertion happens sequentially per sign so intra-batch eviction
+        # and duplicate signs behave exactly like the sequential
+        # reference/native path.
+        space = self.optimizer.require_space(dim) if training else 0
+        if training:
+            admitted = admit_mask(signs, self.admit_probability)
         for shard_idx in np.unique(shard_ids):
             sel = np.nonzero(shard_ids == shard_idx)[0]
             shard = self._shards[shard_idx]
@@ -172,40 +177,23 @@ class EmbeddingHolder:
                         out[pos] = entry[1][:dim]
                     elif not training:
                         self.index_miss_count += 1
-                    elif entry is not None:
-                        # dim mismatch: re-initialize unconditionally
-                        # (reference mod.rs:213-228)
-                        mismatch_positions.append(pos)
+                    elif entry is None and not admitted[pos]:
+                        self.index_miss_count += 1
                     else:
-                        miss_positions.append(pos)
-        if training and (miss_positions or mismatch_positions):
-            self._admit_and_init(
-                signs, dim, np.array(miss_positions, dtype=np.int64),
-                np.array(mismatch_positions, dtype=np.int64), out, shard_ids,
-            )
+                        # admitted miss, or dim mismatch (reinitialized
+                        # unconditionally, reference mod.rs:213-228)
+                        vec = np.zeros(dim + space, dtype=np.float32)
+                        vec[:dim] = initialize_entries(
+                            signs[pos : pos + 1], dim, self.init_method,
+                            self.init_params,
+                        )[0]
+                        if space:
+                            self.optimizer.state_initialization(
+                                vec[None, :], dim)
+                        out[pos] = vec[:dim]
+                        shard.insert(sign, dim, vec)
+                        self.index_miss_count += 1
         return out
-
-    def _admit_and_init(self, signs, dim, miss_positions, forced_positions,
-                        out, shard_ids):
-        admitted = admit_mask(signs[miss_positions], self.admit_probability)
-        self.index_miss_count += int(admitted.sum())
-        adm_positions = np.concatenate(
-            [miss_positions[admitted], forced_positions]
-        ).astype(np.int64)
-        if len(adm_positions) == 0:
-            return
-        adm_signs = signs[adm_positions]
-        embs = initialize_entries(adm_signs, dim, self.init_method, self.init_params)
-        space = self.optimizer.require_space(dim)
-        vecs = np.zeros((len(adm_signs), dim + space), dtype=np.float32)
-        vecs[:, :dim] = embs
-        if space:
-            self.optimizer.state_initialization(vecs, dim)
-        out[adm_positions] = embs
-        for i, pos in enumerate(adm_positions):
-            shard_idx = shard_ids[pos]
-            with self._locks[shard_idx]:
-                self._shards[shard_idx].insert(int(signs[pos]), dim, vecs[i])
 
     def update_gradients(self, signs: np.ndarray, grads: np.ndarray, dim: int):
         """Batched optimizer step for ``signs`` with grads (n, dim)."""
@@ -217,9 +205,12 @@ class EmbeddingHolder:
             return
         batch_state = self.optimizer.batch_level_state(signs)
         shard_ids = internal_shard_of(signs, self.num_internal_shards)
-        # gather present entries into a matrix, vector-update, scatter back
         space = self.optimizer.require_space(dim)
         width = dim + space
+        # Duplicate signs must apply sequentially (each step sees the
+        # previous one's result, like the reference); a batched
+        # gather/update/scatter would drop all but the last duplicate.
+        has_dups = len(np.unique(signs)) != len(signs)
         found_pos: List[int] = []
         found_entries: List[np.ndarray] = []
         for shard_idx in np.unique(shard_ids):
@@ -232,15 +223,24 @@ class EmbeddingHolder:
                     # different optimizer's state layout
                     if entry is not None and entry[0] == dim and \
                             len(entry[1]) == width:
-                        found_pos.append(pos)
-                        found_entries.append(entry[1])
+                        if has_dups:
+                            st = (batch_state[pos : pos + 1]
+                                  if batch_state is not None else None)
+                            row = entry[1][None, :]
+                            self.optimizer.update(
+                                row, grads[pos : pos + 1], dim, st)
+                            if self.enable_weight_bound:
+                                apply_weight_bound(row[:, :dim],
+                                                   self.weight_bound)
+                            entry[1][:] = row[0]
+                        else:
+                            found_pos.append(pos)
+                            found_entries.append(entry[1])
                     else:
                         self.gradient_id_miss_count += 1
         if not found_pos:
             return
-        order = np.argsort(found_pos)  # keep batch order for Adam state rows
-        found_pos = [found_pos[i] for i in order]
-        found_entries = [found_entries[i] for i in order]
+        # fast path (no duplicates): one batched optimizer call
         mat = np.stack(found_entries).astype(np.float32, copy=False)
         assert mat.shape[1] == width
         sub_state = batch_state[np.array(found_pos)] if batch_state is not None else None

@@ -169,3 +169,31 @@ def test_native_adagrad_reference_golden():
     ], np.float32)
     np.testing.assert_allclose(got[:DIM], expected[:DIM], rtol=0, atol=5e-4)
     np.testing.assert_allclose(got[DIM:], expected[DIM:], rtol=1e-6)
+
+
+def test_stress_parity_under_eviction_and_duplicates():
+    """300 random batches with duplicate signs and constant eviction
+    pressure: both backends must stay value-identical (sequential
+    duplicate updates, interleaved init/eviction)."""
+    rng = np.random.default_rng(7)
+    py = EmbeddingHolder(capacity=64, num_internal_shards=2)
+    cc = NativeEmbeddingHolder(capacity=64, num_internal_shards=2)
+    for h in (py, cc):
+        h.configure("bounded_uniform", {"lower": -0.1, "upper": 0.1})
+        h.register_optimizer({"type": "sgd", "lr": 0.1})
+    for step in range(100):
+        n = int(rng.integers(1, 40))
+        signs = rng.integers(0, 200, n, dtype=np.uint64)
+        a = py.lookup(signs, 4, True)
+        b = cc.lookup(signs, 4, True)
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=1e-6,
+                                   err_msg=f"step {step}")
+        g = rng.normal(size=(n, 4)).astype(np.float32)
+        py.update_gradients(signs, g, 4)
+        cc.update_gradients(signs, g.copy(), 4)
+        assert len(py) == len(cc)
+    for s in range(200):
+        pe, ce = py.get_entry(s), cc.get_entry(s)
+        assert (pe is None) == (ce is None)
+        if pe is not None:
+            np.testing.assert_allclose(pe[1], ce[1], rtol=2e-4, atol=1e-6)
