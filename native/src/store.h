@@ -36,6 +36,15 @@ struct Entry {
 // conclusion (persia-embedding-holder's hashmap + ArrayLinkedList):
 // node-based std::list/unordered_map cost ~4 dependent cache misses per
 // lookup; a flat table + index links cost ~2.
+//
+// POINTER STABILITY: Entry* returned by get()/get_refresh() is
+// invalidated by ANY subsequent insert() (the node arena may reallocate,
+// and eviction recycles node slots). Use the pointer immediately; never
+// hold it across an insert.
+//
+// CAPACITY: node indices are uint32 with 0xFFFFFFFF reserved, so one
+// map holds at most ~4.29e9 entries; the Store clamps per-shard capacity
+// accordingly (raise num_internal_shards to go past ~4e9 per shard).
 class EvictionMap {
   static constexpr uint32_t kNil = 0xFFFFFFFFu;
 
@@ -215,6 +224,14 @@ class Store {
       : num_shards_(num_shards == 0 ? 1 : num_shards) {
     uint64_t per_shard = capacity / num_shards_;
     if (per_shard == 0) per_shard = 1;
+    // uint32 node indices (0xFFFFFFFF = nil sentinel) bound one map
+    if (per_shard > 0xFFFFFFFEull) {
+      std::fprintf(stderr,
+                   "persia store: clamping per-shard capacity %llu to "
+                   "2^32-2; raise num_internal_shards for more\n",
+                   static_cast<unsigned long long>(per_shard));
+      per_shard = 0xFFFFFFFEull;
+    }
     for (uint32_t i = 0; i < num_shards_; ++i) {
       shards_.emplace_back(new EvictionMap(per_shard));
       locks_.emplace_back(new std::mutex());

@@ -142,9 +142,10 @@ class EmbeddingHolder:
     def lookup(self, signs: np.ndarray, dim: int, training: bool) -> np.ndarray:
         """Batched lookup of ``len(signs)`` embeddings of width ``dim``.
 
-        Returns an (n, dim) float32 matrix. Signs within the batch should be
-        distinct (the worker dedups before calling); duplicate signs still
-        work but pay the miss-path twice.
+        Returns an (n, dim) float32 matrix. Signs within the batch are
+        normally distinct (the worker dedups before calling); duplicates
+        are handled sequentially — the first occurrence initializes, later
+        ones hit the fresh entry.
         """
         signs = np.ascontiguousarray(signs, dtype=np.uint64)
         n = len(signs)
@@ -157,13 +158,19 @@ class EmbeddingHolder:
             if not self.configured:
                 raise RuntimeError("parameter server not configured")
         shard_ids = internal_shard_of(signs, self.num_internal_shards)
-        # Precompute admission + init material for ALL signs (vectorized);
-        # insertion happens sequentially per sign so intra-batch eviction
-        # and duplicate signs behave exactly like the sequential
-        # reference/native path.
-        space = self.optimizer.require_space(dim) if training else 0
+        # Precompute admission + the full init matrix for ALL signs
+        # (vectorized, deterministic per sign — hits just ignore their
+        # row); insertion then happens sequentially per sign so
+        # intra-batch eviction and duplicate signs behave exactly like
+        # the sequential reference/native path.
         if training:
+            space = self.optimizer.require_space(dim)
             admitted = admit_mask(signs, self.admit_probability)
+            init_vecs = np.zeros((n, dim + space), dtype=np.float32)
+            init_vecs[:, :dim] = initialize_entries(
+                signs, dim, self.init_method, self.init_params)
+            if space:
+                self.optimizer.state_initialization(init_vecs, dim)
         for shard_idx in np.unique(shard_ids):
             sel = np.nonzero(shard_ids == shard_idx)[0]
             shard = self._shards[shard_idx]
@@ -182,14 +189,7 @@ class EmbeddingHolder:
                     else:
                         # admitted miss, or dim mismatch (reinitialized
                         # unconditionally, reference mod.rs:213-228)
-                        vec = np.zeros(dim + space, dtype=np.float32)
-                        vec[:dim] = initialize_entries(
-                            signs[pos : pos + 1], dim, self.init_method,
-                            self.init_params,
-                        )[0]
-                        if space:
-                            self.optimizer.state_initialization(
-                                vec[None, :], dim)
+                        vec = init_vecs[pos].copy()
                         out[pos] = vec[:dim]
                         shard.insert(sign, dim, vec)
                         self.index_miss_count += 1
@@ -211,12 +211,15 @@ class EmbeddingHolder:
         # previous one's result, like the reference); a batched
         # gather/update/scatter would drop all but the last duplicate.
         has_dups = len(np.unique(signs)) != len(signs)
-        found_pos: List[int] = []
-        found_entries: List[np.ndarray] = []
         for shard_idx in np.unique(shard_ids):
             sel = np.nonzero(shard_ids == shard_idx)[0]
             shard = self._shards[shard_idx]
+            # the whole gather/update/write-back runs under this shard's
+            # lock — mutating stored buffers after releasing it races with
+            # concurrent eviction + re-admission of the same sign
             with self._locks[shard_idx]:
+                found_pos: List[int] = []
+                found_entries: List[np.ndarray] = []
                 for pos in sel:
                     entry = shard.get(int(signs[pos]))
                     # width check also skips entries created under a
@@ -238,17 +241,21 @@ class EmbeddingHolder:
                             found_entries.append(entry[1])
                     else:
                         self.gradient_id_miss_count += 1
-        if not found_pos:
-            return
-        # fast path (no duplicates): one batched optimizer call
-        mat = np.stack(found_entries).astype(np.float32, copy=False)
-        assert mat.shape[1] == width
-        sub_state = batch_state[np.array(found_pos)] if batch_state is not None else None
-        self.optimizer.update(mat, grads[np.array(found_pos)], dim, sub_state)
-        if self.enable_weight_bound:
-            apply_weight_bound(mat[:, :dim], self.weight_bound)
-        for row, vec in zip(mat, found_entries):
-            vec[:] = row  # write back in place (vec is the stored buffer)
+                if not found_pos:
+                    continue
+                # fast path (no duplicates): one batched optimizer call
+                mat = np.stack(found_entries).astype(np.float32, copy=False)
+                assert mat.shape[1] == width
+                sub_state = (
+                    batch_state[np.array(found_pos)]
+                    if batch_state is not None else None
+                )
+                self.optimizer.update(mat, grads[np.array(found_pos)], dim,
+                                      sub_state)
+                if self.enable_weight_bound:
+                    apply_weight_bound(mat[:, :dim], self.weight_bound)
+                for row, vec in zip(mat, found_entries):
+                    vec[:] = row  # write back (vec is the stored buffer)
 
     # --- debug / checkpoint --------------------------------------------
 
