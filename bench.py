@@ -157,9 +157,43 @@ def bench_device(batch_size, steps, warmup):
     return steps * batch_size / elapsed
 
 
+def bench_wire(batch_size, steps):
+    """Serialization microbench (analogue of the reference's
+    persia-common-benchmark criterion suite): PTB2 batch round trip +
+    array framing throughput."""
+    from persia_tpu.rpc import pack_arrays, unpack_arrays
+
+    batches = make_batches(4, batch_size)
+    blobs = [b.to_bytes() for b in batches]
+    total_bytes = sum(len(x) for x in blobs)
+    from persia_tpu.data.batch import PersiaBatch
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        for b in batches:
+            b.to_bytes()
+    ser = steps * total_bytes / (time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        for blob in blobs:
+            PersiaBatch.from_bytes(blob)
+    de = steps * total_bytes / (time.perf_counter() - t0)
+    arrays = [np.random.default_rng(0).normal(
+        size=(batch_size, DIM)).astype(np.float32) for _ in range(NUM_SLOTS)]
+    packed = pack_arrays({"x": 1}, arrays)
+    t0 = time.perf_counter()
+    for _ in range(steps * 4):
+        unpack_arrays(pack_arrays({"x": 1}, arrays))
+    frame = steps * 4 * len(packed) / (time.perf_counter() - t0)
+    log(f"wire: serialize {ser/1e9:.2f} GB/s deserialize {de/1e9:.2f} GB/s "
+        f"array-framing {frame/1e9:.2f} GB/s")
+    return ser / 1e9
+
+
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--mode", choices=["hybrid", "device"], default="hybrid")
+    p.add_argument("--mode", choices=["hybrid", "device", "wire"],
+                   default="hybrid")
     p.add_argument("--batch-size", type=int, default=4096)
     p.add_argument("--steps", type=int, default=30)
     p.add_argument("--warmup", type=int, default=5)
@@ -186,6 +220,13 @@ def main():
     if args.mode == "hybrid":
         sps = bench_hybrid(args.batch_size, args.steps, args.warmup)
         metric = "dlrm_hybrid_samples_per_sec_chip"
+    elif args.mode == "wire":
+        gbps = bench_wire(args.batch_size, max(args.steps, 5))
+        print(json.dumps({
+            "metric": "ptb2_serialize_gb_per_sec", "value": round(gbps, 3),
+            "unit": "GB/sec", "vs_baseline": 1.0,
+        }))
+        return
     else:
         sps = bench_device(args.batch_size, args.steps, args.warmup)
         metric = "dlrm_device_samples_per_sec_chip"

@@ -22,4 +22,28 @@ A ground-up re-design of the capabilities of PersiaML/PERSIA
 
 from persia_tpu.version import __version__
 
-__all__ = ["__version__"]
+# Core user API at the package root (reference exposes the equivalents
+# under persia.*). Heavy deps (jax) load lazily via these imports'
+# modules only when first used.
+from persia_tpu.config import EmbeddingSchema, GlobalConfig, uniform_slots
+from persia_tpu.data.batch import (
+    IDTypeFeature,
+    IDTypeFeatureWithSingleID,
+    Label,
+    NonIDTypeFeature,
+    PersiaBatch,
+)
+from persia_tpu.embedding import EmbeddingConfig
+
+__all__ = [
+    "__version__",
+    "EmbeddingSchema",
+    "GlobalConfig",
+    "uniform_slots",
+    "PersiaBatch",
+    "IDTypeFeature",
+    "IDTypeFeatureWithSingleID",
+    "NonIDTypeFeature",
+    "Label",
+    "EmbeddingConfig",
+]

@@ -281,3 +281,52 @@ def test_dataflow_backpressure_retries():
         assert got.remote_ref == ("w", 7)
     finally:
         receiver.close()
+
+
+def test_ps_infer_boot_with_initial_checkpoint(tmp_path):
+    """Infer-mode PS boots with --initial-checkpoint loaded
+    (reference: bin/persia-embedding-parameter-server.rs:108-116)."""
+    import subprocess
+    import sys as _sys
+    import time as _time
+
+    from persia_tpu.ps.store import EmbeddingHolder
+    from persia_tpu.service.ps_service import PsClient
+    from persia_tpu.utils import find_free_port
+
+    # build a checkpoint file
+    h = EmbeddingHolder(1000, 2)
+    h.configure("bounded_uniform", {"lower": -0.1, "upper": 0.1})
+    h.register_optimizer({"type": "sgd", "lr": 0.1})
+    signs = np.arange(1, 20, dtype=np.uint64)
+    expected = h.lookup(signs, 4, True)
+    ckpt = tmp_path / "initial.psd"
+    h.dump_file(str(ckpt))
+
+    port = find_free_port()
+    import os as _os
+
+    proc = subprocess.Popen(
+        [_sys.executable, "-m", "persia_tpu.service.ps_service",
+         "--port", str(port), "--initial-checkpoint", str(ckpt)],
+        env={**_os.environ,
+             "PYTHONPATH": str(Path(__file__).resolve().parent.parent)},
+    )
+    try:
+        ps = PsClient(f"127.0.0.1:{port}")
+        deadline = _time.monotonic() + 60
+        while _time.monotonic() < deadline:
+            try:
+                if len(ps) == 19:
+                    break
+            except Exception:
+                pass
+            _time.sleep(0.2)
+        assert len(ps) == 19
+        # eval lookups serve checkpointed values without an optimizer
+        out = ps.lookup(signs, 4, False)
+        np.testing.assert_array_equal(out, expected)
+        ps.shutdown()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
