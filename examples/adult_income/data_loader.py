@@ -1,0 +1,55 @@
+"""Data-loader role entry (reference: examples/src/adult-income/data_loader.py).
+
+Run under the launcher with a coordinator + workers + trainers up:
+
+    PERSIA_COORDINATOR_ADDR=... python -m persia_tpu.launcher data-loader \
+        examples/adult_income/data_loader.py --samples 51200
+"""
+
+import argparse
+import sys
+
+sys.path.insert(0, __file__.rsplit("/examples/", 1)[0])
+sys.path.insert(0, __file__.rsplit("/data_loader.py", 1)[0])
+
+from persia_tpu.ctx import DataCtx
+from persia_tpu.env import get_coordinator_addr
+from persia_tpu.logger import get_default_logger
+from persia_tpu.service.coordinator import (
+    ROLE_TRAINER,
+    ROLE_WORKER,
+    CoordinatorClient,
+)
+from persia_tpu.service.dataflow import DataflowClient
+from persia_tpu.service.worker_service import RemoteEmbeddingWorker
+
+from data_generator import batches
+
+logger = get_default_logger("data_loader")
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--samples", type=int, default=51200)
+    p.add_argument("--batch-size", type=int, default=512)
+    p.add_argument("--seed", type=int, default=1)
+    p.add_argument("--num-workers", type=int, default=1)
+    p.add_argument("--num-trainers", type=int, default=1)
+    args = p.parse_args()
+
+    coord = CoordinatorClient(get_coordinator_addr())
+    worker = RemoteEmbeddingWorker(
+        coord.wait_members(ROLE_WORKER, args.num_workers, timeout=300))
+    trainers = coord.wait_members(ROLE_TRAINER, args.num_trainers,
+                                  timeout=300)
+    logger.info("dataflow to %d workers, %d trainers", args.num_workers,
+                len(trainers))
+    with DataCtx(DataflowClient(worker, trainers)) as ctx:
+        for batch in batches(args.samples, args.batch_size, seed=args.seed):
+            ctx.send_data(batch)
+        ctx.dataflow.send_eos()
+    logger.info("sent %d samples; eos", args.samples)
+
+
+if __name__ == "__main__":
+    main()

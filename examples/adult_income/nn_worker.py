@@ -1,0 +1,83 @@
+"""nn-worker (trainer) role entry
+(reference: examples/src/adult-income/train.py run under the launcher).
+
+Registers a dataflow receiver with the coordinator, streams batches from
+remote data-loaders, trains the DNN through remote embedding workers:
+
+    PERSIA_COORDINATOR_ADDR=... RANK=0 WORLD_SIZE=1 \
+        python -m persia_tpu.launcher nn-worker examples/adult_income/nn_worker.py
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, __file__.rsplit("/examples/", 1)[0])
+sys.path.insert(0, __file__.rsplit("/nn_worker.py", 1)[0])
+
+if os.environ.get("PERSIA_FORCE_JAX_PLATFORM"):
+    import jax
+
+    jax.config.update("jax_platforms",
+                      os.environ["PERSIA_FORCE_JAX_PLATFORM"])
+
+import optax
+
+from persia_tpu.config import EmbeddingSchema, uniform_slots
+from persia_tpu.ctx import TrainCtx
+from persia_tpu.data.dataloader import DataLoader, StreamingDataset
+from persia_tpu.embedding import EmbeddingConfig
+from persia_tpu.embedding.optim import Adagrad
+from persia_tpu.env import get_coordinator_addr, get_rank
+from persia_tpu.logger import get_default_logger
+from persia_tpu.models import DNN
+from persia_tpu.service.coordinator import (
+    ROLE_TRAINER,
+    ROLE_WORKER,
+    CoordinatorClient,
+)
+from persia_tpu.service.dataflow import DataflowReceiver
+from persia_tpu.service.worker_service import RemoteEmbeddingWorker
+
+from data_generator import NUM_SLOTS
+
+logger = get_default_logger("nn_worker")
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--num-workers", type=int, default=1)
+    p.add_argument("--embedding-staleness", type=int, default=8)
+    args = p.parse_args()
+
+    rank = get_rank()
+    coord = CoordinatorClient(get_coordinator_addr())
+    worker = RemoteEmbeddingWorker(
+        coord.wait_members(ROLE_WORKER, args.num_workers, timeout=300))
+    receiver = DataflowReceiver()
+    coord.register(ROLE_TRAINER, rank, receiver.addr)
+
+    schema = EmbeddingSchema(
+        slots_config=uniform_slots(
+            [f"slot_{s}" for s in range(NUM_SLOTS)], dim=8))
+    ctx = TrainCtx(
+        model=DNN(),
+        dense_optimizer=optax.adam(1e-3),
+        embedding_optimizer=Adagrad(lr=1e-2),
+        schema=schema,
+        worker=worker,
+        embedding_config=EmbeddingConfig(emb_initialization=(-0.05, 0.05)),
+    )
+    loader = DataLoader(StreamingDataset(receiver),
+                        embedding_staleness=args.embedding_staleness)
+    with ctx:
+        for i, batch in enumerate(loader):
+            loss, _ = ctx.train_step(batch)
+            if i % 50 == 0:
+                logger.info("step %d loss %.4f", i, float(loss))
+    logger.info("stream ended after %d steps", i + 1)
+    receiver.close()
+
+
+if __name__ == "__main__":
+    main()

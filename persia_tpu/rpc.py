@@ -26,8 +26,22 @@ import numpy as np
 try:
     import zstandard
 
-    _ZSTD_C = zstandard.ZstdCompressor(level=3)
-    _ZSTD_D = zstandard.ZstdDecompressor()
+    # ZstdCompressor/ZstdDecompressor objects are NOT safe for concurrent
+    # use from multiple threads (observed as wire corruption under the
+    # pipelined trainer); keep one pair per thread.
+    _zstd_local = threading.local()
+
+    def _zstd_c() -> "zstandard.ZstdCompressor":
+        c = getattr(_zstd_local, "c", None)
+        if c is None:
+            c = _zstd_local.c = zstandard.ZstdCompressor(level=3)
+        return c
+
+    def _zstd_d() -> "zstandard.ZstdDecompressor":
+        d = getattr(_zstd_local, "d", None)
+        if d is None:
+            d = _zstd_local.d = zstandard.ZstdDecompressor()
+        return d
 except ImportError:  # pragma: no cover
     zstandard = None
 
@@ -82,7 +96,7 @@ def _send_msg(sock: socket.socket, envelope: list, payload: bytes,
               compress: bool):
     flags = 0
     if compress and zstandard is not None and len(payload) > COMPRESS_THRESHOLD:
-        payload = _ZSTD_C.compress(payload)
+        payload = _zstd_c().compress(payload)
         flags |= _FLAG_COMPRESSED
     env = msgpack.packb(envelope + [len(payload)], use_bin_type=True)
     # frame_len counts everything after the u32: flags+env_len fields (3
@@ -101,7 +115,7 @@ def _recv_msg(sock: socket.socket) -> Tuple[list, bytes]:
     if flags & _FLAG_COMPRESSED:
         if zstandard is None:  # pragma: no cover
             raise RpcError("compressed payload but zstandard unavailable")
-        payload = _ZSTD_D.decompress(payload)
+        payload = _zstd_d().decompress(payload)
     return env, payload
 
 

@@ -68,3 +68,15 @@ def test_optimizer_apply_requires_ctx():
 
     with pytest.raises(RuntimeError):
         Adagrad(lr=0.1).apply()
+
+
+def test_build_ctx_from_config_dir():
+    from pathlib import Path
+
+    cfg = str(Path(__file__).resolve().parent.parent / "examples"
+              / "adult_income" / "config")
+    ctx = adult_income.build_ctx(config_dir=cfg)
+    with ctx:
+        for b in batches(2 * 64, 64, seed=2):
+            loss, _ = ctx.train_step(b)
+    assert ctx.schema.slots_config["slot_0"].index_prefix != 0
