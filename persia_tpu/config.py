@@ -245,6 +245,9 @@ class EmbeddingParameterServerConfig:
 
     capacity: int = 1_000_000_000
     num_hashmap_internal_shards: int = 100
+    # accepted for config-file compatibility with the reference; the
+    # full-amount streaming manager is not implemented (full dumps go
+    # through checkpoint.dump_sharded instead)
     full_amount_manager_buffer_size: int = 1000
     enable_incremental_update: bool = False
     incremental_buffer_size: int = 5_000_000
