@@ -122,7 +122,7 @@ def bench_hybrid(batch_size, steps, warmup, n_ps=2, staleness=8):
     return steps * batch_size / elapsed
 
 
-def bench_device(batch_size, steps, warmup):
+def bench_device(batch_size, steps, warmup, vocab=1 << 20):
     import jax
     import optax
 
@@ -137,7 +137,7 @@ def bench_device(batch_size, steps, warmup):
 
     devices = jax.devices()
     mesh = make_mesh((len(devices), 1), devices=devices)
-    specs = criteo_like_specs(num_slots=NUM_SLOTS, vocab=1 << 20, dim=DIM)
+    specs = criteo_like_specs(num_slots=NUM_SLOTS, vocab=vocab, dim=DIM)
     model = DeviceModeModel(slot_specs=specs, tower=DLRM(embedding_dim=DIM))
     non_id, ids, label = synthetic_device_batch(batch_size, NUM_DENSE, specs)
     opt = optax.adagrad(0.02)
@@ -228,7 +228,8 @@ def main():
         }))
         return
     else:
-        sps = bench_device(args.batch_size, args.steps, args.warmup)
+        sps = bench_device(args.batch_size, args.steps, args.warmup,
+                           vocab=(1 << 12) if args.smoke else (1 << 20))
         metric = "dlrm_device_samples_per_sec_chip"
     log(f"bench: done in {time.perf_counter() - t0:.1f}s -> {sps:,.0f} samples/s")
     print(json.dumps({
