@@ -22,6 +22,41 @@ def setup_seed(seed: int):
     os.environ["PYTHONHASHSEED"] = str(seed)
 
 
+def force_cpu_platform(n_devices: int = 8) -> None:
+    """Force JAX onto a virtual ``n_devices``-device CPU platform.
+
+    Must run before the JAX backend initializes. Env vars alone are not
+    enough when a platform plugin re-pins ``jax.config`` via sitecustomize,
+    so this also updates the config; raises loudly if the backend was
+    already initialized with fewer devices (at that point the flags are
+    dead letters). Shared by tests/conftest.py, dryrun_multichip, and any
+    multi-process CPU-cluster harness.
+    """
+    import re
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flag = "--xla_force_host_platform_device_count"
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(re.escape(flag) + r"=(\d+)", flags)
+    if m is None:
+        os.environ["XLA_FLAGS"] = (flags + f" {flag}={n_devices}").strip()
+    elif int(m.group(1)) < n_devices:
+        os.environ["XLA_FLAGS"] = flags.replace(
+            m.group(0), f"{flag}={n_devices}")
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    have = len(jax.devices("cpu"))
+    if have < n_devices:
+        raise RuntimeError(
+            f"virtual CPU mesh has {have} device(s), need {n_devices}: the "
+            "JAX backend initialized before force_cpu_platform() could set "
+            f"XLA_FLAGS; export JAX_PLATFORMS=cpu XLA_FLAGS={flag}="
+            f"{n_devices} (or call this earlier), before any jax device use"
+        )
+
+
 def load_yaml(path: str) -> Any:
     if not os.path.exists(path):
         raise FileNotFoundError(f"yaml file not found: {path}")

@@ -2,22 +2,6 @@
 multi-chip sharding logic is exercised without TPU hardware
 (SURVEY.md §4: cluster-in-a-box testing pattern)."""
 
-import os
-
-# Hard-override: the surrounding environment may point JAX at the real TPU
-# (JAX_PLATFORMS=axon, set again in jax.config by the platform plugin's
-# sitecustomize), but tests always run on the virtual 8-device CPU mesh.
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
-import jax
-
-jax.config.update("jax_platforms", "cpu")
-
 import pathlib
 import subprocess
 import sys
@@ -26,6 +10,13 @@ import pytest
 
 REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO_ROOT))
+
+# Hard-override: the surrounding environment may point JAX at the real TPU
+# (JAX_PLATFORMS=axon, set again in jax.config by the platform plugin's
+# sitecustomize), but tests always run on the virtual 8-device CPU mesh.
+from persia_tpu.utils import force_cpu_platform  # noqa: E402
+
+force_cpu_platform(8)
 
 
 @pytest.fixture(scope="session")
