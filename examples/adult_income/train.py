@@ -10,11 +10,19 @@ tests/test_service_e2e.py via persia_tpu.service.helper.
 """
 
 import argparse
+import os
 import sys
 
 import numpy as np
 
 sys.path.insert(0, __file__.rsplit("/examples/", 1)[0])
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # honor an explicit CPU request even when a platform plugin's
+    # sitecustomize re-pins jax.config to an accelerator
+    from persia_tpu.utils import force_cpu_platform
+
+    force_cpu_platform(1)
 
 import optax
 

@@ -436,7 +436,11 @@ class Store {
     if (!f) return false;
     bool ok = std::fwrite("PSD1", 1, 4, f) == 4;
     uint32_t version = 1;
-    uint64_t count = size();
+    // Placeholder count now, real count after the locked iteration: an
+    // unlocked size() snapshot can disagree with the records actually
+    // written when lookups/updates insert or evict mid-dump, making the
+    // file unloadable (header is patched via fseek at the end).
+    uint64_t count = 0;
     ok = ok && std::fwrite(&version, 4, 1, f) == 1;
     ok = ok && std::fwrite(&count, 8, 1, f) == 1;
     for (uint32_t i = 0; ok && i < num_shards_; ++i) {
@@ -447,8 +451,11 @@ class Store {
         ok = ok && std::fwrite(&e.dim, 4, 1, f) == 1;
         ok = ok && std::fwrite(&len, 4, 1, f) == 1;
         ok = ok && std::fwrite(e.vec.data(), sizeof(float), len, f) == len;
+        if (ok) ++count;
       });
     }
+    ok = ok && std::fseek(f, 8, SEEK_SET) == 0 &&
+         std::fwrite(&count, 8, 1, f) == 1;
     std::fclose(f);
     return ok;
   }

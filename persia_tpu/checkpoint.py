@@ -138,10 +138,10 @@ def iter_psd_entries(path: str):
             yield sign, dim, vec
 
 
-def load_sharded(ps_clients: Sequence, dirpath: str,
-                 replica_size: Optional[int] = None):
-    """Load a dump, resharding if the PS count changed."""
-    replica_size = replica_size or len(ps_clients)
+def load_sharded(ps_clients: Sequence, dirpath: str):
+    """Load a dump, resharding if the PS count changed; entries are always
+    routed by ``farmhash64(sign) % len(ps_clients)`` (the worker's shard
+    function)."""
     info = read_done_marker(dirpath)
     staged = _StagedDir(dirpath)
     staged.download()
@@ -186,7 +186,10 @@ def _install(ps_clients, signs, entries):
 
 
 def dump_checkpoint(ctx, dst_dir: str, with_dense: bool = True):
-    """Full job checkpoint (reference: persia/ctx.py:471-495, 1007-1034)."""
+    """Full job checkpoint (reference: persia/ctx.py:471-495, 1007-1034).
+
+    The sparse path is async by design; ``worker.dump`` quiesces the
+    backward engines registered on that worker before snapshotting."""
     os.makedirs(dst_dir, exist_ok=True)
     ctx.worker.dump(dst_dir)
     if with_dense and getattr(ctx, "state", None) is not None:

@@ -14,8 +14,10 @@ TPU-first deviations from the reference:
 
 - ``sample_fixed_size`` is mandatory for non-summed ("raw") slots: XLA
   needs static shapes, so raw slots always produce a dense
-  ``(batch, sample_fixed_size)`` index tensor with ``-1`` padding plus a
-  mask, instead of variable-length per-sample lists.
+  ``(batch, sample_fixed_size)`` int32 index tensor into a fixed-capacity
+  embedding tensor whose row 0 is all-zeros; index 0 means padding (mask
+  = index != 0), instead of variable-length per-sample lists. Samples
+  with more than ``sample_fixed_size`` ids are truncated.
 - The wire dtype for embeddings defaults to **bf16** (TPU-native) rather
   than the reference's f16 (persia-common/src/lib.rs:85-113).
 """

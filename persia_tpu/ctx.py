@@ -296,14 +296,16 @@ class TrainCtx(EmbeddingCtx):
         self.state, loss, flat_grads, pred = self._train_step(
             self.state, non_id, flat_emb, emb_indices, label
         )
-        per_slot = unpack_embedding_grads(flat_grads, self._emb_shapes)
-        grads = {
-            f.name: g for f, g in zip(batch.id_type_features, per_slot)
-        }
+        names = [f.name for f in batch.id_type_features]
         if engine is not None:
-            engine.backward.submit(ref_id, grads)
+            # the device->host gradient fetch happens in a backward worker
+            # thread, not here — on a slow host link a synchronous fetch
+            # would serialize every step on the d2h transfer
+            engine.backward.submit_packed(
+                ref_id, flat_grads, self._emb_shapes, names)
         else:
-            self.worker.update_gradients(ref_id, grads)
+            per_slot = unpack_embedding_grads(flat_grads, self._emb_shapes)
+            self.worker.update_gradients(ref_id, dict(zip(names, per_slot)))
         return loss, pred
 
     def _apply_model(self, non_id, emb_inputs):

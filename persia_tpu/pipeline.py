@@ -25,8 +25,9 @@ import heapq
 import itertools
 import queue
 import threading
+import weakref
 from dataclasses import dataclass, field
-from typing import Any, Dict, Iterator, List, Optional
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
 from persia_tpu.data.batch import PersiaBatch
 from persia_tpu.logger import get_default_logger
@@ -58,6 +59,23 @@ class LookedUpBatch:
         return self.batch.requires_grad
 
 
+@dataclass
+class _PackedGrads:
+    """A still-on-device packed gradient array awaiting d2h + unpack."""
+
+    flat: Any  # device array (one wire-dtype blob)
+    shapes: Sequence[Tuple[int, ...]]
+    names: Sequence[str]
+
+
+def flush_backward_engines(worker, timeout: Optional[float] = None):
+    """Flush every BackwardEngine feeding ``worker`` (quiesce in-flight
+    async gradient updates — required before a checkpoint dump so the
+    sparse snapshot is consistent)."""
+    for engine in list(getattr(worker, "_backward_engines", ())):
+        engine.flush(timeout=timeout)
+
+
 class BackwardEngine:
     """Async gradient return path (reference backward.rs:233-354)."""
 
@@ -72,6 +90,11 @@ class BackwardEngine:
         self._pending_cv = threading.Condition()
         self._errors: List[BaseException] = []
         self._timer_hist = StageTimer("backward_client_time_cost_sec").hist
+        # register on the worker so checkpoint dumps can quiesce us
+        engines = getattr(worker, "_backward_engines", None)
+        if engines is None:
+            engines = worker._backward_engines = weakref.WeakSet()
+        engines.add(self)
         self._threads = [
             threading.Thread(target=self._run, daemon=True,
                              name=f"backward-worker-{i}")
@@ -88,7 +111,19 @@ class BackwardEngine:
         work_started()
         self._q.put((ref_id, grads))
 
+    def submit_packed(self, ref_id: int, flat_grads,
+                      shapes: Sequence[Tuple[int, ...]],
+                      names: Sequence[str]):
+        """Queue a packed gradient array WITHOUT forcing the device->host
+        transfer: the fetch + unpack happen in a backward worker thread
+        (the reference does its d2h in backward_to_cpu_worker,
+        backward.rs:233-302), keeping the slow link off the training
+        thread."""
+        self.submit(ref_id, _PackedGrads(flat_grads, shapes, names))
+
     def _run(self):
+        import numpy as np
+
         while True:
             item = self._q.get()
             if item is _SENTINEL:
@@ -96,6 +131,14 @@ class BackwardEngine:
             ref_id, grads = item
             try:
                 with self._timer_hist.timer():
+                    if isinstance(grads, _PackedGrads):
+                        from persia_tpu.parallel.train import (
+                            unpack_embedding_grads,
+                        )
+
+                        per_slot = unpack_embedding_grads(
+                            np.asarray(grads.flat), grads.shapes)
+                        grads = dict(zip(grads.names, per_slot))
                     self.worker.update_gradients(ref_id, grads,
                                                  loss_scale=self.loss_scale)
                 heartbeat()

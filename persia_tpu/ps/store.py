@@ -284,14 +284,22 @@ class EmbeddingHolder:
     # --- serialization (PSD1, shared with native/src/store.h) -----------
 
     def dump_bytes(self) -> bytes:
-        """Serialize all entries (LRU order per shard) to the PSD1 layout."""
-        out = [DUMP_MAGIC, struct.pack("<IQ", 1, len(self))]
+        """Serialize all entries (LRU order per shard) to the PSD1 layout.
+
+        The header count is derived from the records actually serialized
+        (each shard under its own lock) — never from an unlocked size
+        snapshot, which concurrent inserts/evictions could invalidate and
+        leave the checkpoint unloadable."""
+        chunks = []
+        count = 0
         for lock, shard in zip(self._locks, self._shards):
             with lock:
                 for sign, (dim, vec) in shard.items_in_lru_order():
-                    out.append(struct.pack("<QII", sign, dim, len(vec)))
-                    out.append(np.ascontiguousarray(vec, dtype=np.float32).tobytes())
-        return b"".join(out)
+                    chunks.append(struct.pack("<QII", sign, dim, len(vec)))
+                    chunks.append(
+                        np.ascontiguousarray(vec, dtype=np.float32).tobytes())
+                    count += 1
+        return b"".join([DUMP_MAGIC, struct.pack("<IQ", 1, count)] + chunks)
 
     def load_bytes(self, buf: bytes, clear: bool = True):
         view = memoryview(buf)

@@ -222,28 +222,53 @@ class RpcClient:
         self.retry_backoff = retry_backoff
         self._local = threading.local()
 
-    def _conn(self) -> socket.socket:
-        conn = getattr(self._local, "conn", None)
-        if conn is None:
-            conn = socket.create_connection(self._target, timeout=self.timeout)
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._local.conn = conn
+    def _dial(self) -> socket.socket:
+        conn = socket.create_connection(self._target, timeout=self.timeout)
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return conn
 
-    def call(self, method: str, payload: bytes = b"") -> bytes:
+    def call(self, method: str, payload: bytes = b"",
+             no_retry: bool = False) -> bytes:
+        """``no_retry=True`` is for non-idempotent methods (gradient
+        updates, forward-buffer ingestion): a connection that dies after
+        the server may have processed the request must surface as an
+        error rather than silently re-sending (at-least-once would
+        double-apply the update or leak an orphaned forward-buffer
+        entry). Provably-safe retries still happen even with no_retry:
+        connect() failures (the request never left this host) and a
+        single fresh-dial retry after a *reused* pooled socket fails (the
+        overwhelmingly common cause is the peer having closed the idle
+        connection, in which case the send never reached the
+        application). Only a failure on a freshly-dialed connection is
+        genuinely ambiguous and honors no_retry."""
         import time
 
         delay = self.retry_backoff
-        for attempt in range(self.max_retries + 1):
+        attempts_left = self.max_retries
+        while True:
+            conn = getattr(self._local, "conn", None)
+            fresh = conn is None
+            if fresh:
+                try:
+                    conn = self._local.conn = self._dial()
+                except (ConnectionError, OSError):
+                    if attempts_left <= 0:
+                        raise
+                    attempts_left -= 1
+                    time.sleep(delay)
+                    delay = min(delay * 2, 5.0)
+                    continue
             try:
-                conn = self._conn()
                 _send_msg(conn, [method], payload, True)
                 env, result = _recv_msg(conn)
                 break
             except (ConnectionError, OSError):
                 self._local.conn = None
-                if attempt == self.max_retries:
+                if not fresh:
+                    continue  # stale pooled socket: redial once, no sleep
+                if no_retry or attempts_left <= 0:
                     raise
+                attempts_left -= 1
                 time.sleep(delay)
                 delay = min(delay * 2, 5.0)
         if env[0] != "ok":

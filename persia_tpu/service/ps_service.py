@@ -191,7 +191,9 @@ class PsClient:
             np.ascontiguousarray(signs, np.uint64),
             np.ascontiguousarray(grads, np.float32),
         ])
-        self.client.call("update_gradients", payload)
+        # non-idempotent: a retry after connection death could apply the
+        # optimizer step twice
+        self.client.call("update_gradients", payload, no_retry=True)
 
     def __len__(self) -> int:
         return msgpack.unpackb(self.client.call("len"), raw=False)["len"]

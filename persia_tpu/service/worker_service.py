@@ -136,8 +136,11 @@ class RemoteEmbeddingWorker:
 
     def put_batch(self, id_type_features) -> tuple:
         addr = self._next_addr()
+        # non-idempotent: a blind retry could leave an orphaned
+        # forward-buffer entry on the worker (expired only much later)
         resp = self._clients[addr].call(
-            "forward_batched", ser.pack_id_features(id_type_features))
+            "forward_batched", ser.pack_id_features(id_type_features),
+            no_retry=True)
         return (addr, msgpack.unpackb(resp, raw=False)["ref_id"])
 
     def lookup(self, ref, training: bool = True) -> Dict[str, object]:
@@ -160,8 +163,10 @@ class RemoteEmbeddingWorker:
     def update_gradients(self, ref, grads: Dict[str, np.ndarray],
                          loss_scale: float = 1.0):
         client = self._client_for(ref)
+        # non-idempotent: a retry would double-apply the gradients
         client.call("update_gradients", ser.pack_gradients(
-            grads, {"ref_id": ref[1], "loss_scale": loss_scale}))
+            grads, {"ref_id": ref[1], "loss_scale": loss_scale}),
+            no_retry=True)
 
     # --- control plane ---------------------------------------------------
 
@@ -187,6 +192,11 @@ class RemoteEmbeddingWorker:
         )
 
     def dump(self, path: str):
+        from persia_tpu.pipeline import flush_backward_engines
+
+        # quiesce in-flight async gradient updates registered on THIS
+        # (trainer-side) object before the remote dump snapshots the PS
+        flush_backward_engines(self)
         # first worker fans out to every PS (reference rpc.rs:118-121)
         self._clients[self.addrs[0]].call_msg("dump", path=path)
 

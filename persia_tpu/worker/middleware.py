@@ -158,6 +158,29 @@ def apply_index_prefix(feat: DedupedFeature, slot: SlotConfig,
     return feat
 
 
+def truncate_to_sample_fixed_size(
+    feature: IDTypeFeature, sfs: int
+) -> IDTypeFeature:
+    """Keep only the first ``sfs`` ids of each sample (CSR rebuild).
+
+    Raw (non-summed) slots emit a static (batch*sfs + 1, dim) tensor, so
+    per-sample id counts MUST be bounded by sfs before dedup — otherwise
+    the distinct count can exceed the capacity and the scatter overflows
+    (the reference truncates at sample_fixed_size too, mod.rs:594-617)."""
+    offsets = feature.offsets.astype(np.int64, copy=False)
+    counts = np.diff(offsets)
+    if len(counts) == 0 or int(counts.max()) <= sfs:
+        return feature
+    nnz = int(offsets[-1])
+    elem_col = (np.arange(nnz, dtype=np.int64)
+                - np.repeat(offsets[:-1], counts))
+    keep = elem_col < sfs
+    new_offsets = np.zeros(len(counts) + 1, dtype=np.uint32)
+    np.cumsum(np.minimum(counts, sfs), out=new_offsets[1:])
+    return IDTypeFeature.from_csr(
+        feature.name, new_offsets, feature.signs[keep])
+
+
 def preprocess_batch(
     id_type_features: List[IDTypeFeature], schema: EmbeddingSchema
 ) -> List[DedupedFeature]:
@@ -166,6 +189,8 @@ def preprocess_batch(
     feats = []
     for f in id_type_features:
         slot = schema.get_slot(f.name)
+        if not slot.embedding_summation:
+            f = truncate_to_sample_fixed_size(f, slot.sample_fixed_size)
         df = dedup_feature(f)
         hs = slot.hash_stack_config
         df = apply_hashstack(df, hs.hash_stack_rounds, hs.embedding_size)

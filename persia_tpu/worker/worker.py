@@ -19,6 +19,7 @@ mirrors mod.rs:991-1029.
 
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -53,6 +54,18 @@ class EmbeddingWorker:
             raise ValueError("EmbeddingWorker needs at least one PS client")
         self.forward_buffer_size = forward_buffer_size
         self.buffered_data_expired_sec = buffered_data_expired_sec
+        # Concurrent fan-out to the PS replicas (the reference joins all
+        # per-shard RPC futures, mod.rs:448-484): with N remote replicas
+        # over DCN a serial loop costs N x the lookup latency. Each RPC
+        # client pools one connection per calling thread, so concurrent
+        # calls to the same replica are safe.
+        self._fanout = (
+            ThreadPoolExecutor(
+                max_workers=min(2 * self.replica_size, 32),
+                thread_name_prefix="ps-fanout",
+            )
+            if self.replica_size > 1 else None
+        )
         self._lock = threading.Lock()
         self._next_ref_id = 1
         # ref_id -> (feats, enter_time)
@@ -156,10 +169,17 @@ class EmbeddingWorker:
         with self._t_preprocess.timer():
             groups = mw.shard_split(feats, self.schema, self.replica_size)
         with self._t_rpc.timer():
-            results = [
-                self.ps_clients[g.shard].lookup(g.signs, g.dim, training)
-                for g in groups
-            ]
+            if self._fanout is None or len(groups) <= 1:
+                results = [
+                    self.ps_clients[g.shard].lookup(g.signs, g.dim, training)
+                    for g in groups
+                ]
+            else:
+                results = list(self._fanout.map(
+                    lambda g: self.ps_clients[g.shard].lookup(
+                        g.signs, g.dim, training),
+                    groups,
+                ))
         with self._t_postprocess.timer():
             mats = mw.scatter_lookup_results(feats, self.schema, groups,
                                              results)
@@ -190,19 +210,31 @@ class EmbeddingWorker:
             per_feature.append(
                 mw.aggregate_gradients(feat, slot, grads[feat.name], loss_scale)
             )
-        for shard, dim, signs, g in mw.shard_gradients(
+        shard_groups = mw.shard_gradients(
             feats, self.schema, per_feature, self.replica_size
-        ):
-            self.ps_clients[shard].update_gradients(signs, g, dim)
+        )
+        if self._fanout is None or len(shard_groups) <= 1:
+            for shard, dim, signs, g in shard_groups:
+                self.ps_clients[shard].update_gradients(signs, g, dim)
+        else:
+            futures = [
+                self._fanout.submit(
+                    self.ps_clients[shard].update_gradients, signs, g, dim)
+                for shard, dim, signs, g in shard_groups
+            ]
+            for f in futures:
+                f.result()
 
     # --- checkpoint fan-out ----------------------------------------------
 
     def dump(self, dirpath: str):
         from persia_tpu.checkpoint import dump_sharded
+        from persia_tpu.pipeline import flush_backward_engines
 
+        flush_backward_engines(self)
         dump_sharded(self.ps_clients, dirpath)
 
     def load(self, dirpath: str):
         from persia_tpu.checkpoint import load_sharded
 
-        load_sharded(self.ps_clients, dirpath, self.replica_size)
+        load_sharded(self.ps_clients, dirpath)

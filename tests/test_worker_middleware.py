@@ -118,22 +118,46 @@ def test_raw_postprocess_static_shape_and_grads():
     feats = preprocess_batch([_feature("f", [[5, 7, 5, 9], [7]])], schema)
     f = feats[0]
     slot = schema.get_slot("f")
-    emb = np.arange(6, dtype=np.float32).reshape(3, 2)  # distinct 5,7,9
+    # the 4th id (9) is truncated by sample_fixed_size=3 BEFORE dedup, so
+    # it is never looked up on the PS: distinct = {5, 7}
+    assert f.num_distinct == 2
+    emb = np.arange(4, dtype=np.float32).reshape(2, 2)  # distinct 5,7
     out = postprocess_feature(f, slot, emb)
     assert isinstance(out, RawEmbedding)
     assert out.embeddings.shape == (2 * 3 + 1, 2)
     np.testing.assert_array_equal(out.embeddings[0], [0, 0])
-    np.testing.assert_array_equal(out.embeddings[1:4], emb)
-    # sample 0: [5,7,5] (4th id 9 truncated by sample_fixed_size=3)
+    np.testing.assert_array_equal(out.embeddings[1:3], emb)
+    # sample 0: [5,7,5]
     np.testing.assert_array_equal(out.index[0], [1, 2, 1])
     np.testing.assert_array_equal(out.index[1], [2, 0, 0])
     np.testing.assert_array_equal(out.sample_id_num, [3, 1])
-    # gradient: rows 1..3 flow back to distinct signs
+    # gradient: rows 1..2 flow back to distinct signs
     g = np.zeros((7, 2), dtype=np.float32)
     g[1] = [1, 1]
     g[2] = [2, 2]
     per_sign = aggregate_gradients(f, slot, g)
-    np.testing.assert_array_equal(per_sign, [[1, 1], [2, 2], [0, 0]])
+    np.testing.assert_array_equal(per_sign, [[1, 1], [2, 2]])
+
+
+def test_raw_slot_overflowing_sample_fixed_size_is_truncated():
+    """A sample with far more distinct ids than sample_fixed_size must not
+    overflow the static (batch*sfs + 1, dim) capacity (previously raised
+    IndexError inside np.add.at)."""
+    schema = _simple_schema(summation=False, sfs=2)
+    many = list(range(100, 112))  # 12 distinct ids, sfs=2
+    feats = preprocess_batch([_feature("f", [many, [7]])], schema)
+    f = feats[0]
+    slot = schema.get_slot("f")
+    # only the first sfs ids per sample survive: {100, 101, 7}
+    assert f.num_distinct == 3
+    assert f.num_distinct <= 2 * 2  # bounded by batch * sfs
+    emb = np.arange(6, dtype=np.float32).reshape(3, 2)
+    out = postprocess_feature(f, slot, emb)
+    assert out.embeddings.shape == (2 * 2 + 1, 2)
+    np.testing.assert_array_equal(out.sample_id_num, [2, 1])
+    g = np.zeros((5, 2), dtype=np.float32)
+    per_sign = aggregate_gradients(f, slot, g)
+    assert per_sign.shape == (3, 2)
 
 
 def test_nan_filter_and_loss_scale():
