@@ -4,6 +4,7 @@
 #include <cstring>
 
 #include "hashrng.h"
+#include "mw_kernels.h"
 #include "store.h"
 
 using persia::InitParams;
@@ -95,6 +96,43 @@ void ptps_init_entry(uint64_t sign, uint32_t dim, int method,
   p.scale = params[5];
   p.lambda = params[6];
   persia::init_entry(sign, dim, method, p, out);
+}
+
+// Middleware kernels (persia_tpu/worker/mw_native.py).
+
+int64_t ptmw_dedup(const uint64_t* signs, int64_t nnz, uint64_t* distinct_out,
+                   int32_t* inverse_out) {
+  return persia::mw_dedup(signs, nnz, distinct_out, inverse_out);
+}
+
+void ptmw_sum_post(const float* emb, const int32_t* elem_distinct,
+                   const int32_t* counts, int32_t bs, int32_t dim,
+                   const float* scale, float* out) {
+  persia::mw_sum_post(emb, elem_distinct, counts, bs, dim, scale, out);
+}
+
+void ptmw_sum_grad(const float* grad, const int32_t* elem_sample,
+                   const int32_t* elem_distinct, int64_t nnz, int64_t d,
+                   int32_t dim, float inv_ls, const float* scale,
+                   float* out) {
+  persia::mw_sum_grad(grad, elem_sample, elem_distinct, nnz, d, dim, inv_ls,
+                      scale, out);
+}
+
+void ptmw_gather_rows(const float* src, const int32_t* idx, int64_t m,
+                      int32_t dim, float filter_scale, int filter,
+                      float* dst) {
+  persia::mw_gather_rows(src, idx, m, dim, filter_scale, filter != 0, dst);
+}
+
+void ptmw_scatter_rows(float* dst, const int32_t* idx, int64_t m, int32_t dim,
+                       const float* src) {
+  persia::mw_scatter_rows(dst, idx, m, dim, src);
+}
+
+void ptmw_scatter_add_rows(float* dst, const int32_t* idx, int64_t m,
+                           int32_t dim, const float* src) {
+  persia::mw_scatter_add_rows(dst, idx, m, dim, src);
 }
 
 }  // extern "C"

@@ -1,0 +1,195 @@
+// Embedding-worker middleware kernels: the hot per-batch transforms
+// behind persia_tpu/worker/middleware.py, fused into single C passes.
+//
+// The reference runs these in Rust inside the embedding worker
+// (embedding_worker_service/mod.rs:341-872: dedup via FeatureBatch::new,
+// SIMD summation postprocess, per-sign gradient accumulation). Here the
+// orchestration stays in Python (numpy) and only the O(nnz*dim) loops
+// cross into C++; every kernel is bit-identical to its numpy twin
+// (tests/test_native_parity.py) because summation order is preserved:
+// numpy's stable argsort + reduceat sums contributions in natural
+// element order within a segment, exactly like these sequential loops.
+#pragma once
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+#include "hashrng.h"  // splitmix_mix
+
+namespace persia {
+
+// Dedup nnz uint64 signs into sorted distinct values + inverse indices
+// (numpy twin: np.unique(signs, return_inverse=True)). Open-addressing
+// hash set + sort of the distinct values only (d << nnz typically).
+// Returns the distinct count d; distinct_out needs capacity nnz.
+inline int64_t mw_dedup(const uint64_t* signs, int64_t nnz,
+                        uint64_t* distinct_out, int32_t* inverse_out) {
+  if (nnz == 0) return 0;
+  uint64_t table_size = 64;
+  while (table_size < static_cast<uint64_t>(nnz) * 2) table_size <<= 1;
+  const uint64_t mask = table_size - 1;
+  // slot: index into distinct_out, -1 = empty
+  std::vector<int32_t> table(table_size, -1);
+  // first pass: collect distinct (unsorted), remember each element's slot
+  std::vector<int32_t> elem_slot(nnz);
+  int64_t d = 0;
+  for (int64_t i = 0; i < nnz; ++i) {
+    uint64_t s = signs[i];
+    uint64_t h = splitmix_mix(s) & mask;
+    for (;;) {
+      int32_t slot = table[h];
+      if (slot < 0) {
+        table[h] = static_cast<int32_t>(d);
+        distinct_out[d] = s;
+        elem_slot[i] = static_cast<int32_t>(d);
+        ++d;
+        break;
+      }
+      if (distinct_out[slot] == s) {
+        elem_slot[i] = slot;
+        break;
+      }
+      h = (h + 1) & mask;
+    }
+  }
+  // sort distinct, build rank mapping old-slot -> sorted position;
+  // (sign, slot) pair array keeps the sort cache-local, and an LSD radix
+  // beats comparison sort once d is a few thousand
+  std::vector<std::pair<uint64_t, int32_t>> pairs(d);
+  for (int64_t i = 0; i < d; ++i)
+    pairs[i] = {distinct_out[i], static_cast<int32_t>(i)};
+  if (d > 1024) {
+    // LSD radix; passes whose byte is constant across all keys (common:
+    // small vocabularies, zero high bytes) skip their scatter entirely
+    uint64_t ones = 0, zeros = ~0ull;
+    for (int64_t i = 0; i < d; ++i) {
+      ones |= pairs[i].first;
+      zeros &= pairs[i].first;
+    }
+    const uint64_t varying = ones ^ zeros;  // bits that differ somewhere
+    std::vector<std::pair<uint64_t, int32_t>> tmp(d);
+    for (int shift = 0; shift < 64; shift += 8) {
+      if (((varying >> shift) & 0xFF) == 0) continue;
+      int32_t counts[257] = {0};
+      for (int64_t i = 0; i < d; ++i)
+        ++counts[((pairs[i].first >> shift) & 0xFF) + 1];
+      for (int b = 0; b < 256; ++b) counts[b + 1] += counts[b];
+      for (int64_t i = 0; i < d; ++i)
+        tmp[counts[(pairs[i].first >> shift) & 0xFF]++] = pairs[i];
+      std::swap(pairs, tmp);
+    }
+  } else {
+    std::sort(pairs.begin(), pairs.end());
+  }
+  std::vector<int32_t> rank(d);
+  for (int64_t i = 0; i < d; ++i) {
+    distinct_out[i] = pairs[i].first;
+    rank[pairs[i].second] = static_cast<int32_t>(i);
+  }
+  for (int64_t i = 0; i < nnz; ++i) inverse_out[i] = rank[elem_slot[i]];
+  return d;
+}
+
+// Summed-slot postprocess (numpy twin: _segment_sum(emb[elem_distinct],
+// elem_sample) with optional per-sample scale): CSR order means elements
+// of sample s are contiguous, counts[s] each.
+//   emb:    (d, dim)  looked-up distinct embeddings
+//   counts: (bs,)     per-sample element counts
+//   scale:  (bs,) or null (1/sqrt(n) scaling applied AFTER the sum,
+//           matching numpy's `out *= scale[:, None]`)
+//   out:    (bs, dim)
+inline void mw_sum_post(const float* emb, const int32_t* elem_distinct,
+                        const int32_t* counts, int32_t bs, int32_t dim,
+                        const float* scale, float* out) {
+  int64_t e = 0;
+  for (int32_t s = 0; s < bs; ++s) {
+    float* dst = out + static_cast<int64_t>(s) * dim;
+    std::memset(dst, 0, sizeof(float) * dim);
+    for (int32_t k = 0; k < counts[s]; ++k, ++e) {
+      const float* src = emb + static_cast<int64_t>(elem_distinct[e]) * dim;
+      for (int32_t j = 0; j < dim; ++j) dst[j] += src[j];
+    }
+    if (scale != nullptr) {
+      const float sc = scale[s];
+      for (int32_t j = 0; j < dim; ++j) dst[j] *= sc;
+    }
+  }
+}
+
+// Summed-slot gradient aggregation (numpy twin: aggregate_gradients'
+// segment sum over stable-sorted elem_distinct): non-finite gradient
+// values are zeroed (the reference's NaN filter), the loss scale divided
+// out, the optional per-sample 1/sqrt(n) applied, then contributions
+// accumulate per distinct sign. Scatter-add in natural element order ==
+// numpy's stable-sort + reduceat order for equal keys; the two scale
+// factors are applied as SEPARATE f32 multiplies, matching numpy's
+// `grad * inv_ls` followed by `grad * scale[:, None]` rounding exactly.
+//   grad:       (bs, dim)
+//   inv_ls:     1/loss_scale; pass exactly 1.0f to skip (numpy skips too)
+//   scale:      (bs,) per-sample factor or null
+//   out:        (d, dim), zero-filled here
+inline void mw_sum_grad(const float* grad, const int32_t* elem_sample,
+                        const int32_t* elem_distinct, int64_t nnz,
+                        int64_t d, int32_t dim, float inv_ls,
+                        const float* scale, float* out) {
+  std::memset(out, 0, sizeof(float) * d * dim);
+  const bool have_ls = inv_ls != 1.0f;
+  for (int64_t e = 0; e < nnz; ++e) {
+    const int64_t s = elem_sample[e];
+    const float* src = grad + s * dim;
+    float* dst = out + static_cast<int64_t>(elem_distinct[e]) * dim;
+    const float sc = scale != nullptr ? scale[s] : 1.0f;
+    for (int32_t j = 0; j < dim; ++j) {
+      float v = src[j];
+      if (!std::isfinite(v)) v = 0.0f;
+      if (have_ls) v *= inv_ls;
+      if (scale != nullptr) v *= sc;
+      dst[j] += v;
+    }
+  }
+}
+
+// Row gather: dst[i, :] = src[idx[i], :], with optional scale and
+// non-finite zeroing (raw-slot gradient path: grad[rows + 1]).
+inline void mw_gather_rows(const float* src, const int32_t* idx, int64_t m,
+                           int32_t dim, float filter_scale, bool filter,
+                           float* dst) {
+  for (int64_t i = 0; i < m; ++i) {
+    const float* s = src + static_cast<int64_t>(idx[i]) * dim;
+    float* o = dst + i * dim;
+    if (filter) {
+      for (int32_t j = 0; j < dim; ++j) {
+        float v = s[j];
+        if (!std::isfinite(v)) v = 0.0f;
+        o[j] = v * filter_scale;
+      }
+    } else {
+      std::memcpy(o, s, sizeof(float) * dim);
+    }
+  }
+}
+
+// Row scatter: dst[idx[i], :] = src[i, :] (lookup-result assembly).
+inline void mw_scatter_rows(float* dst, const int32_t* idx, int64_t m,
+                            int32_t dim, const float* src) {
+  for (int64_t i = 0; i < m; ++i)
+    std::memcpy(dst + static_cast<int64_t>(idx[i]) * dim, src + i * dim,
+                sizeof(float) * dim);
+}
+
+// Row scatter-add: dst[idx[i], :] += src[i, :] (raw postprocess with
+// hashstack round accumulation; numpy twin np.add.at processes elements
+// in natural order too).
+inline void mw_scatter_add_rows(float* dst, const int32_t* idx, int64_t m,
+                                int32_t dim, const float* src) {
+  for (int64_t i = 0; i < m; ++i) {
+    float* o = dst + static_cast<int64_t>(idx[i]) * dim;
+    const float* s = src + i * dim;
+    for (int32_t j = 0; j < dim; ++j) o[j] += s[j];
+  }
+}
+
+}  // namespace persia

@@ -81,11 +81,15 @@ class BackwardEngine:
 
     def __init__(self, worker, num_workers: int = 2,
                  staleness_sem: Optional[threading.Semaphore] = None,
-                 loss_scale: float = 1.0):
+                 loss_scale: float = 1.0, queue_size: int = 16):
         self.worker = worker
         self.staleness_sem = staleness_sem
         self.loss_scale = loss_scale
-        self._q: "queue.Queue" = queue.Queue()
+        # Bounded: packed submissions hold still-on-device gradient blobs,
+        # so an unbounded queue would pin accelerator memory without limit
+        # whenever PS updates lag the training step (submit() blocking is
+        # the backpressure; the staleness semaphore usually binds first).
+        self._q: "queue.Queue" = queue.Queue(maxsize=queue_size)
         self._pending = 0
         self._pending_cv = threading.Condition()
         self._errors: List[BaseException] = []

@@ -124,10 +124,22 @@ class RpcServer:
 
     Handlers take ``(payload: bytes) -> bytes`` and run concurrently;
     state they touch must be internally synchronized (the stores are).
+
+    Requests carrying a request id (``RpcClient.call(dedup=True)``) are
+    executed at most once: a bounded LRU of recently-served ids returns
+    the cached response for retried deliveries, so non-idempotent methods
+    (gradient updates, forward-buffer ingestion) survive client retries
+    without double-applying.
     """
 
+    DEDUP_CACHE_SIZE = 8192
+
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        from collections import OrderedDict
+
         self._handlers: Dict[str, Callable[[bytes], bytes]] = {}
+        self._dedup: "OrderedDict[bytes, bytes]" = OrderedDict()
+        self._dedup_lock = threading.Lock()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -174,6 +186,7 @@ class RpcServer:
                 except (ConnectionError, OSError):
                     return
                 method = env[0]
+                req_id = env[1] if len(env) >= 3 else None
                 try:
                     if method == "__shutdown__":
                         _send_msg(conn, ["ok"], b"", False)
@@ -184,7 +197,17 @@ class RpcServer:
                     handler = self._handlers.get(method)
                     if handler is None:
                         raise RpcError(f"no such method {method!r}")
-                    result = handler(payload)
+                    result = None
+                    if req_id is not None:
+                        with self._dedup_lock:
+                            result = self._dedup.get(req_id)
+                    if result is None:
+                        result = handler(payload)
+                        if req_id is not None:
+                            with self._dedup_lock:
+                                self._dedup[req_id] = result
+                                while len(self._dedup) > self.DEDUP_CACHE_SIZE:
+                                    self._dedup.popitem(last=False)
                     _send_msg(conn, ["ok"], result, True)
                 except BaseException as e:
                     try:
@@ -228,21 +251,22 @@ class RpcClient:
         return conn
 
     def call(self, method: str, payload: bytes = b"",
-             no_retry: bool = False) -> bytes:
-        """``no_retry=True`` is for non-idempotent methods (gradient
-        updates, forward-buffer ingestion): a connection that dies after
-        the server may have processed the request must surface as an
-        error rather than silently re-sending (at-least-once would
-        double-apply the update or leak an orphaned forward-buffer
-        entry). Provably-safe retries still happen even with no_retry:
-        connect() failures (the request never left this host) and a
-        single fresh-dial retry after a *reused* pooled socket fails (the
-        overwhelmingly common cause is the peer having closed the idle
-        connection, in which case the send never reached the
-        application). Only a failure on a freshly-dialed connection is
-        genuinely ambiguous and honors no_retry."""
+             dedup: bool = False) -> bytes:
+        """``dedup=True`` attaches a per-request id that the server uses
+        to execute the request at most once (RpcServer's LRU of served
+        ids): required for non-idempotent methods (gradient updates,
+        forward-buffer ingestion), where a blind re-send after an
+        ambiguous connection death would double-apply the update or leak
+        an orphaned forward-buffer entry. With the id attached, retries
+        are safe, so every call keeps the full retry-with-backoff
+        resilience (the reference's forward workers block on
+        wait_for_serving until servers recover, forward.rs:708-715)."""
+        import os
         import time
 
+        envelope: list = [method]
+        if dedup:
+            envelope.append(os.urandom(12))
         delay = self.retry_backoff
         attempts_left = self.max_retries
         while True:
@@ -259,14 +283,14 @@ class RpcClient:
                     delay = min(delay * 2, 5.0)
                     continue
             try:
-                _send_msg(conn, [method], payload, True)
+                _send_msg(conn, envelope, payload, True)
                 env, result = _recv_msg(conn)
                 break
             except (ConnectionError, OSError):
                 self._local.conn = None
                 if not fresh:
                     continue  # stale pooled socket: redial once, no sleep
-                if no_retry or attempts_left <= 0:
+                if attempts_left <= 0:
                     raise
                 attempts_left -= 1
                 time.sleep(delay)

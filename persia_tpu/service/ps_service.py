@@ -191,9 +191,8 @@ class PsClient:
             np.ascontiguousarray(signs, np.uint64),
             np.ascontiguousarray(grads, np.float32),
         ])
-        # non-idempotent: a retry after connection death could apply the
-        # optimizer step twice
-        self.client.call("update_gradients", payload, no_retry=True)
+        # non-idempotent: dedup id makes the retry at-most-once server-side
+        self.client.call("update_gradients", payload, dedup=True)
 
     def __len__(self) -> int:
         return msgpack.unpackb(self.client.call("len"), raw=False)["len"]

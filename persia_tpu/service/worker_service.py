@@ -136,11 +136,11 @@ class RemoteEmbeddingWorker:
 
     def put_batch(self, id_type_features) -> tuple:
         addr = self._next_addr()
-        # non-idempotent: a blind retry could leave an orphaned
-        # forward-buffer entry on the worker (expired only much later)
+        # non-idempotent: dedup id prevents a retry from leaving an
+        # orphaned forward-buffer entry on the worker
         resp = self._clients[addr].call(
             "forward_batched", ser.pack_id_features(id_type_features),
-            no_retry=True)
+            dedup=True)
         return (addr, msgpack.unpackb(resp, raw=False)["ref_id"])
 
     def lookup(self, ref, training: bool = True) -> Dict[str, object]:
@@ -163,10 +163,10 @@ class RemoteEmbeddingWorker:
     def update_gradients(self, ref, grads: Dict[str, np.ndarray],
                          loss_scale: float = 1.0):
         client = self._client_for(ref)
-        # non-idempotent: a retry would double-apply the gradients
+        # non-idempotent: dedup id makes the retry at-most-once server-side
         client.call("update_gradients", ser.pack_gradients(
             grads, {"ref_id": ref[1], "loss_scale": loss_scale}),
-            no_retry=True)
+            dedup=True)
 
     # --- control plane ---------------------------------------------------
 

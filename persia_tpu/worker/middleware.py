@@ -41,6 +41,15 @@ from persia_tpu.hashing import farmhash64_np
 _U64 = np.uint64
 
 
+def _mw_native():
+    """The C++ kernel module when built, else None (numpy fallback).
+
+    Imported lazily so the pure-Python path never needs the toolchain."""
+    from persia_tpu.worker import mw_native
+
+    return mw_native if mw_native.available() else None
+
+
 @dataclass
 class DedupedFeature:
     """One ID feature after dedup (+ hashstack + prefix) transforms."""
@@ -67,31 +76,19 @@ class DedupedFeature:
             return self.num_distinct
         return int(self.raw_row_of_distinct.max()) + 1 if len(self.raw_row_of_distinct) else 0
 
-    @property
-    def distinct_order(self) -> np.ndarray:
-        """Element permutation sorting elem_distinct (cached); used for
-        segment-summed gradient aggregation."""
-        if getattr(self, "_distinct_order", None) is None:
-            self._distinct_order = np.argsort(self.elem_distinct,
-                                              kind="stable")
-        return self._distinct_order
-
-
-def _segment_sum(values: np.ndarray, segment_ids_sorted: np.ndarray,
+def _segment_sum(values: np.ndarray, segment_ids: np.ndarray,
                  num_segments: int) -> np.ndarray:
-    """Sum rows of `values` grouped by nondecreasing segment ids.
+    """Sum rows of `values` grouped by segment id, accumulating in element
+    order.
 
-    np.add.reduceat over contiguous runs — roughly an order of magnitude
-    faster than np.add.at's scattered atomics on big batches.
+    np.add.at is unbuffered (adds strictly in element order), which makes
+    this bit-identical to the C++ kernels' sequential accumulation — the
+    property the backend-parity and reproducibility goldens rely on.
+    (np.add.reduceat would be slightly faster but sums pairwise, so its
+    results differ in the last ulp.)
     """
     out = np.zeros((num_segments, values.shape[1]), dtype=values.dtype)
-    if len(segment_ids_sorted) == 0:
-        return out
-    run_starts = np.nonzero(
-        np.diff(segment_ids_sorted, prepend=segment_ids_sorted[0] - 1)
-    )[0]
-    sums = np.add.reduceat(values, run_starts, axis=0)
-    out[segment_ids_sorted[run_starts]] = sums
+    np.add.at(out, segment_ids, values)
     return out
 
 
@@ -104,14 +101,18 @@ def dedup_feature(feature: IDTypeFeature) -> DedupedFeature:
     elem_sample = np.repeat(np.arange(bs, dtype=np.int32), counts)
     elem_col = (np.arange(nnz, dtype=np.int32)
                 - np.repeat(offsets[:-1], counts).astype(np.int32))
-    distinct, inverse = np.unique(feature.signs, return_inverse=True)
+    native = _mw_native()
+    if native is not None:
+        distinct, inverse = native.dedup(feature.signs)
+    else:
+        distinct, inverse = np.unique(feature.signs, return_inverse=True)
     return DedupedFeature(
         name=feature.name,
         batch_size=bs,
         distinct_signs=distinct.astype(np.uint64, copy=False),
         elem_sample=elem_sample,
         elem_col=elem_col,
-        elem_distinct=inverse.astype(np.int32),
+        elem_distinct=inverse.astype(np.int32, copy=False),
         sample_num_signs=counts.astype(np.int32),
     )
 
@@ -232,6 +233,20 @@ def shard_split(
     return groups
 
 
+def _feature_runs(feature_idx: np.ndarray):
+    """Contiguous (start, end, fi) runs of a group's feature_idx array.
+
+    shard_split concatenates features in ascending order, so feature_idx
+    is nondecreasing — runs replace 26 boolean-mask scans with one diff."""
+    if len(feature_idx) == 0:
+        return
+    starts = np.nonzero(
+        np.diff(feature_idx, prepend=feature_idx[0] - 1))[0]
+    ends = np.append(starts[1:], len(feature_idx))
+    for a, b in zip(starts, ends):
+        yield int(a), int(b), int(feature_idx[a])
+
+
 def scatter_lookup_results(
     feats: List[DedupedFeature], schema: EmbeddingSchema,
     groups: List[ShardGroup], results: List[np.ndarray],
@@ -242,10 +257,15 @@ def scatter_lookup_results(
         np.zeros((f.num_distinct, schema.get_slot(f.name).dim), dtype=np.float32)
         for f in feats
     ]
+    native = _mw_native()
     for group, res in zip(groups, results):
-        for fi in np.unique(group.feature_idx):
-            sel = group.feature_idx == fi
-            mats[fi][group.distinct_idx[sel]] = res[sel]
+        res = np.ascontiguousarray(res, dtype=np.float32)
+        for a, b, fi in _feature_runs(group.feature_idx):
+            if native is not None:
+                native.scatter_rows(mats[fi], group.distinct_idx[a:b],
+                                    res[a:b], group.dim)
+            else:
+                mats[fi][group.distinct_idx[a:b]] = res[a:b]
     return mats
 
 
@@ -277,12 +297,20 @@ def postprocess_feature(
     (reference: lookup_batched_all_slots_postprocess, mod.rs:486-629)."""
     bs = feat.batch_size
     dim = slot.dim
+    native = _mw_native()
     if slot.embedding_summation:
-        # elem_sample is nondecreasing (CSR order), so a segment sum works
-        out = _segment_sum(emb[feat.elem_distinct], feat.elem_sample, bs)
+        scale = None
         if slot.sqrt_scaling:
             n = np.maximum(feat.sample_num_signs, 1).astype(np.float32)
-            out *= (1.0 / np.sqrt(n))[:, None]
+            scale = 1.0 / np.sqrt(n)
+        if native is not None:
+            out = native.sum_post(emb, feat.elem_distinct,
+                                  feat.sample_num_signs, bs, dim, scale)
+        else:
+            # elem_sample is nondecreasing (CSR order): segment sum works
+            out = _segment_sum(emb[feat.elem_distinct], feat.elem_sample, bs)
+            if scale is not None:
+                out *= scale[:, None]
         return SumEmbedding(feat.name, out)
 
     sfs = slot.sample_fixed_size
@@ -293,7 +321,10 @@ def postprocess_feature(
         else np.arange(feat.num_distinct, dtype=np.int32)
     )
     emb_out = np.zeros((capacity, dim), dtype=np.float32)
-    np.add.at(emb_out, rows + 1, emb)
+    if native is not None:
+        native.scatter_add_rows(emb_out, rows + 1, emb, dim)
+    else:
+        np.add.at(emb_out, rows + 1, emb)
     if slot.sqrt_scaling and feat.hash_stack_rounds > 1:
         emb_out *= 1.0 / np.sqrt(float(feat.hash_stack_rounds))
     index = np.zeros((bs, sfs), dtype=np.int32)
@@ -319,6 +350,28 @@ def aggregate_gradients(
     """
     dim = slot.dim
     grad = np.ascontiguousarray(grad, dtype=np.float32)
+    native = _mw_native()
+    if native is not None:
+        inv_ls = np.float32(1.0 / loss_scale) if loss_scale != 1.0 else 1.0
+        if slot.embedding_summation:
+            scale = None
+            if slot.sqrt_scaling:
+                n = np.maximum(feat.sample_num_signs, 1).astype(np.float32)
+                scale = 1.0 / np.sqrt(n)
+            return native.sum_grad(grad, feat.elem_sample,
+                                   feat.elem_distinct, feat.num_distinct,
+                                   dim, float(inv_ls), scale)
+        rows = (
+            feat.raw_row_of_distinct
+            if feat.raw_row_of_distinct is not None
+            else np.arange(feat.num_distinct, dtype=np.int32)
+        )
+        out = native.gather_rows(grad, rows + 1, dim,
+                                 filter_scale=float(inv_ls),
+                                 filter_nonfinite=True)
+        if slot.sqrt_scaling and feat.hash_stack_rounds > 1:
+            out *= 1.0 / np.sqrt(float(feat.hash_stack_rounds))
+        return out
     if not np.isfinite(grad).all():
         grad = np.nan_to_num(grad, nan=0.0, posinf=0.0, neginf=0.0)
     if loss_scale != 1.0:
@@ -327,10 +380,8 @@ def aggregate_gradients(
         if slot.sqrt_scaling:
             n = np.maximum(feat.sample_num_signs, 1).astype(np.float32)
             grad = grad * (1.0 / np.sqrt(n))[:, None]
-        order = feat.distinct_order
         out = _segment_sum(
-            grad[feat.elem_sample[order]], feat.elem_distinct[order],
-            feat.num_distinct,
+            grad[feat.elem_sample], feat.elem_distinct, feat.num_distinct,
         )
     else:
         rows = (
@@ -347,16 +398,19 @@ def aggregate_gradients(
 def shard_gradients(
     feats: List[DedupedFeature], schema: EmbeddingSchema,
     per_feature_grads: List[np.ndarray], replica_size: int,
+    groups: Optional[List[ShardGroup]] = None,
 ) -> List[Tuple[int, int, np.ndarray, np.ndarray]]:
     """Group per-sign gradients by (shard, dim) for the PS update calls.
 
-    Returns a list of (shard, dim, signs, grads)."""
-    groups = shard_split(feats, schema, replica_size)
+    Pass the ``groups`` computed by the forward ``shard_split`` (the
+    worker caches them in its post-forward buffer) to skip re-hashing and
+    re-grouping every sign. Returns a list of (shard, dim, signs, grads)."""
+    if groups is None:
+        groups = shard_split(feats, schema, replica_size)
     out = []
     for g in groups:
         grads = np.empty((len(g.signs), g.dim), dtype=np.float32)
-        for fi in np.unique(g.feature_idx):
-            sel = g.feature_idx == fi
-            grads[sel] = per_feature_grads[fi][g.distinct_idx[sel]]
+        for a, b, fi in _feature_runs(g.feature_idx):
+            grads[a:b] = per_feature_grads[fi][g.distinct_idx[a:b]]
         out.append((g.shard, g.dim, g.signs, grads))
     return out

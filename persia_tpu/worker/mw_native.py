@@ -1,0 +1,141 @@
+"""ctypes bindings for the C++ middleware kernels (native/src/mw_kernels.h).
+
+The middleware's O(nnz*dim) per-batch loops — dedup, summation
+postprocess, gradient aggregation, row gather/scatter — dispatch here
+when the native library is built (reference runs them in Rust,
+embedding_worker_service/mod.rs:341-872). Each kernel is bit-identical
+to its numpy twin in :mod:`persia_tpu.worker.middleware`; parity is
+enforced by tests/test_native_parity.py. Set
+``PERSIA_FORCE_PYTHON_MW=1`` to force the numpy path.
+"""
+
+import ctypes
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+_lib = None
+_checked = False
+
+
+def _load():
+    global _lib, _checked
+    if _checked:
+        return _lib
+    _checked = True
+    if os.environ.get("PERSIA_FORCE_PYTHON_MW") == "1":
+        return None
+    from persia_tpu.ps.native import load_native_lib
+
+    lib = load_native_lib()
+    if lib is None or not hasattr(lib, "ptmw_dedup"):
+        return None
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    i64 = ctypes.c_int64
+    i32 = ctypes.c_int32
+    lib.ptmw_dedup.restype = i64
+    lib.ptmw_dedup.argtypes = [u64p, i64, u64p, i32p]
+    lib.ptmw_sum_post.argtypes = [f32p, i32p, i32p, i32, i32, f32p, f32p]
+    lib.ptmw_sum_grad.argtypes = [f32p, i32p, i32p, i64, i64, i32,
+                                  ctypes.c_float, f32p, f32p]
+    lib.ptmw_gather_rows.argtypes = [f32p, i32p, i64, i32, ctypes.c_float,
+                                     ctypes.c_int, f32p]
+    lib.ptmw_scatter_rows.argtypes = [f32p, i32p, i64, i32, f32p]
+    lib.ptmw_scatter_add_rows.argtypes = [f32p, i32p, i64, i32, f32p]
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _p(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def dedup(signs: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """np.unique(signs, return_inverse=True) twin (sorted distinct)."""
+    lib = _load()
+    signs = np.ascontiguousarray(signs, dtype=np.uint64)
+    nnz = len(signs)
+    distinct = np.empty(nnz, dtype=np.uint64)
+    inverse = np.empty(nnz, dtype=np.int32)
+    d = lib.ptmw_dedup(_p(signs, ctypes.c_uint64), nnz,
+                       _p(distinct, ctypes.c_uint64),
+                       _p(inverse, ctypes.c_int32))
+    return distinct[:d].copy(), inverse
+
+
+def sum_post(emb: np.ndarray, elem_distinct: np.ndarray, counts: np.ndarray,
+             bs: int, dim: int, scale: Optional[np.ndarray]) -> np.ndarray:
+    lib = _load()
+    emb = np.ascontiguousarray(emb, dtype=np.float32)
+    elem_distinct = np.ascontiguousarray(elem_distinct, dtype=np.int32)
+    counts = np.ascontiguousarray(counts, dtype=np.int32)
+    out = np.empty((bs, dim), dtype=np.float32)
+    sp = None
+    if scale is not None:
+        scale = np.ascontiguousarray(scale, dtype=np.float32)
+        sp = _p(scale, ctypes.c_float)
+    lib.ptmw_sum_post(_p(emb, ctypes.c_float),
+                      _p(elem_distinct, ctypes.c_int32),
+                      _p(counts, ctypes.c_int32), bs, dim, sp,
+                      _p(out, ctypes.c_float))
+    return out
+
+
+def sum_grad(grad: np.ndarray, elem_sample: np.ndarray,
+             elem_distinct: np.ndarray, num_distinct: int, dim: int,
+             inv_loss_scale: float,
+             scale: Optional[np.ndarray]) -> np.ndarray:
+    lib = _load()
+    grad = np.ascontiguousarray(grad, dtype=np.float32)
+    elem_sample = np.ascontiguousarray(elem_sample, dtype=np.int32)
+    elem_distinct = np.ascontiguousarray(elem_distinct, dtype=np.int32)
+    out = np.empty((num_distinct, dim), dtype=np.float32)
+    sp = None
+    if scale is not None:
+        scale = np.ascontiguousarray(scale, dtype=np.float32)
+        sp = _p(scale, ctypes.c_float)
+    lib.ptmw_sum_grad(_p(grad, ctypes.c_float),
+                      _p(elem_sample, ctypes.c_int32),
+                      _p(elem_distinct, ctypes.c_int32), len(elem_sample),
+                      num_distinct, dim, inv_loss_scale, sp,
+                      _p(out, ctypes.c_float))
+    return out
+
+
+def gather_rows(src: np.ndarray, idx: np.ndarray, dim: int,
+                filter_scale: float = 1.0,
+                filter_nonfinite: bool = False) -> np.ndarray:
+    lib = _load()
+    src = np.ascontiguousarray(src, dtype=np.float32)
+    idx = np.ascontiguousarray(idx, dtype=np.int32)
+    out = np.empty((len(idx), dim), dtype=np.float32)
+    lib.ptmw_gather_rows(_p(src, ctypes.c_float), _p(idx, ctypes.c_int32),
+                         len(idx), dim, filter_scale,
+                         1 if filter_nonfinite else 0,
+                         _p(out, ctypes.c_float))
+    return out
+
+
+def scatter_rows(dst: np.ndarray, idx: np.ndarray, src: np.ndarray, dim: int):
+    lib = _load()
+    idx = np.ascontiguousarray(idx, dtype=np.int32)
+    src = np.ascontiguousarray(src, dtype=np.float32)
+    lib.ptmw_scatter_rows(_p(dst, ctypes.c_float), _p(idx, ctypes.c_int32),
+                          len(idx), dim, _p(src, ctypes.c_float))
+
+
+def scatter_add_rows(dst: np.ndarray, idx: np.ndarray, src: np.ndarray,
+                     dim: int):
+    lib = _load()
+    idx = np.ascontiguousarray(idx, dtype=np.int32)
+    src = np.ascontiguousarray(src, dtype=np.float32)
+    lib.ptmw_scatter_add_rows(_p(dst, ctypes.c_float),
+                              _p(idx, ctypes.c_int32), len(idx), dim,
+                              _p(src, ctypes.c_float))

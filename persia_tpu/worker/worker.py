@@ -70,7 +70,8 @@ class EmbeddingWorker:
         self._next_ref_id = 1
         # ref_id -> (feats, enter_time)
         self._forward_id_buffer: Dict[int, Tuple[list, float]] = {}
-        self._post_forward_buffer: Dict[int, Tuple[list, float]] = {}
+        # ref_id -> (feats, shard groups from the forward split, enter_time)
+        self._post_forward_buffer: Dict[int, tuple] = {}
         self.staleness = 0
         # distinct-id cardinality estimation (reference monitor.rs)
         from persia_tpu.worker.monitor import DistinctIdMonitor
@@ -122,7 +123,7 @@ class EmbeddingWorker:
         horizon = time.monotonic() - self.buffered_data_expired_sec
         with self._lock:
             for buf in (self._forward_id_buffer, self._post_forward_buffer):
-                expired = [r for r, (_, t) in buf.items() if t < horizon]
+                expired = [r for r, item in buf.items() if item[-1] < horizon]
                 for r in expired:
                     del buf[r]
                 if expired:
@@ -139,10 +140,13 @@ class EmbeddingWorker:
         if item is None:
             raise KeyError(f"ref_id {ref_id} not in forward buffer")
         feats, _ = item
-        result = self._lookup_feats(feats, training)
+        result, groups = self._lookup_feats(feats, training)
         if training:
             with self._lock:
-                self._post_forward_buffer[ref_id] = (feats, time.monotonic())
+                # cache the shard groups so the gradient path reuses the
+                # forward split instead of re-hashing every sign
+                self._post_forward_buffer[ref_id] = (
+                    feats, groups, time.monotonic())
                 self.staleness += 1
         return result
 
@@ -152,7 +156,7 @@ class EmbeddingWorker:
         """One-shot preprocess+lookup without buffers — the inference/eval
         path (reference: forward_batched_direct, mod.rs:1076-1107)."""
         feats = mw.preprocess_batch(id_type_features, self.schema)
-        return self._lookup_feats(feats, training)
+        return self._lookup_feats(feats, training)[0]
 
     def lookup_direct_training(
         self, id_type_features: List[IDTypeFeature]
@@ -187,7 +191,7 @@ class EmbeddingWorker:
             for feat, mat in zip(feats, mats):
                 slot = self.schema.get_slot(feat.name)
                 out[feat.name] = mw.postprocess_feature(feat, slot, mat)
-        return out
+        return out, groups
 
     def update_gradients(
         self, ref_id: int, grads: Dict[str, np.ndarray],
@@ -201,7 +205,7 @@ class EmbeddingWorker:
                 self.staleness -= 1
         if item is None:
             raise KeyError(f"ref_id {ref_id} not in post-forward buffer")
-        feats, _ = item
+        feats, fwd_groups, _ = item
         per_feature = []
         for feat in feats:
             slot = self.schema.get_slot(feat.name)
@@ -211,7 +215,8 @@ class EmbeddingWorker:
                 mw.aggregate_gradients(feat, slot, grads[feat.name], loss_scale)
             )
         shard_groups = mw.shard_gradients(
-            feats, self.schema, per_feature, self.replica_size
+            feats, self.schema, per_feature, self.replica_size,
+            groups=fwd_groups,
         )
         if self._fanout is None or len(shard_groups) <= 1:
             for shard, dim, signs, g in shard_groups:

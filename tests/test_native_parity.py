@@ -236,3 +236,113 @@ def test_flat_table_rehash_growth_and_eviction():
         pe = {s: v.tobytes() for s, d, v in iter_psd_entries(pp)}
         ce = {s: v.tobytes() for s, d, v in iter_psd_entries(cp)}
         assert pe == ce
+
+
+# --- middleware kernel parity (native/src/mw_kernels.h) -------------------
+
+
+def test_mw_dedup_matches_numpy_unique():
+    from persia_tpu.worker import mw_native
+
+    assert mw_native.available()
+    rng = np.random.default_rng(7)
+    for n in (0, 1, 17, 4096):
+        signs = rng.integers(0, 1000, size=n, dtype=np.uint64)
+        d_ref, inv_ref = np.unique(signs, return_inverse=True)
+        d_nat, inv_nat = mw_native.dedup(signs)
+        np.testing.assert_array_equal(d_nat, d_ref)
+        np.testing.assert_array_equal(inv_nat, inv_ref.astype(np.int32))
+
+
+def test_mw_dedup_radix_branch():
+    """> 1024 distinct signs takes the LSD radix path (incl. the
+    constant-byte pass skip); cover full-64-bit keys, keys differing only
+    in the high bytes, and keys sharing low bytes."""
+    from persia_tpu.worker import mw_native
+
+    rng = np.random.default_rng(13)
+    cases = [
+        rng.integers(0, 1 << 63, size=8000, dtype=np.uint64),  # full range
+        # differ ONLY in the top two bytes
+        (rng.integers(0, 5000, size=8000, dtype=np.uint64) << np.uint64(48))
+        | np.uint64(0xABCD),
+        # low 16 bits shared, middle varying
+        (rng.integers(0, 3000, size=4096, dtype=np.uint64) << np.uint64(16)),
+    ]
+    for signs in cases:
+        d_ref, inv_ref = np.unique(signs, return_inverse=True)
+        assert len(d_ref) > 1024  # must exercise the radix branch
+        d_nat, inv_nat = mw_native.dedup(signs)
+        np.testing.assert_array_equal(d_nat, d_ref)
+        np.testing.assert_array_equal(inv_nat, inv_ref.astype(np.int32))
+
+
+def test_mw_middleware_bit_parity_full_pipeline():
+    """The full middleware pipeline must produce bit-identical outputs
+    with and without the C++ kernels (sum + raw + sqrt-scaling +
+    hashstack + loss scale)."""
+    import os
+
+    from persia_tpu.config import EmbeddingSchema
+    from persia_tpu.data.batch import IDTypeFeature
+    from persia_tpu.worker import middleware as mw
+    from persia_tpu.worker import mw_native
+
+    assert mw_native.available()
+    schema = EmbeddingSchema.from_dict({
+        "slots_config": {
+            "summed": {"dim": 8, "sqrt_scaling": True},
+            "raw": {"dim": 4, "embedding_summation": False,
+                    "sample_fixed_size": 3},
+            "stacked": {"dim": 8, "hash_stack_config": {
+                "hash_stack_rounds": 2, "embedding_size": 100}},
+        }
+    })
+    rng = np.random.default_rng(3)
+    data_summed = [rng.integers(0, 500, size=rng.integers(0, 6),
+                                dtype=np.uint64) for _ in range(32)]
+    data_raw = [rng.integers(0, 500, size=rng.integers(0, 8),
+                             dtype=np.uint64) for _ in range(32)]
+    data_stacked = [rng.integers(0, 100000, size=rng.integers(1, 4),
+                                 dtype=np.uint64) for _ in range(32)]
+
+    def run():
+        feats = mw.preprocess_batch(
+            [IDTypeFeature("summed", data_summed),
+             IDTypeFeature("raw", data_raw),
+             IDTypeFeature("stacked", data_stacked)], schema)
+        embs = [rng2.normal(size=(f.num_distinct,
+                                  schema.get_slot(f.name).dim))
+                .astype(np.float32) for f in feats]
+        outs = [mw.postprocess_feature(f, schema.get_slot(f.name), e)
+                for f, e in zip(feats, embs)]
+        grads = []
+        for o in outs:
+            g = rng2.normal(size=o.embeddings.shape).astype(np.float32)
+            g.ravel()[::97] = np.nan  # exercise the NaN filter
+            grads.append(g)
+        aggs = [mw.aggregate_gradients(f, schema.get_slot(f.name), g,
+                                       loss_scale=2.5)
+                for f, g in zip(feats, grads)]
+        return feats, outs, aggs
+
+    rng2 = np.random.default_rng(11)
+    f_nat, o_nat, a_nat = run()
+    os.environ["PERSIA_FORCE_PYTHON_MW"] = "1"
+    mw_native._checked, mw_native._lib = False, None
+    try:
+        rng2 = np.random.default_rng(11)
+        f_py, o_py, a_py = run()
+    finally:
+        del os.environ["PERSIA_FORCE_PYTHON_MW"]
+        mw_native._checked, mw_native._lib = False, None
+
+    for fn, fp in zip(f_nat, f_py):
+        np.testing.assert_array_equal(fn.distinct_signs, fp.distinct_signs)
+        np.testing.assert_array_equal(fn.elem_distinct, fp.elem_distinct)
+    for on, op in zip(o_nat, o_py):
+        np.testing.assert_array_equal(on.embeddings, op.embeddings)
+        if hasattr(on, "index"):
+            np.testing.assert_array_equal(on.index, op.index)
+    for an, ap in zip(a_nat, a_py):
+        np.testing.assert_array_equal(an, ap)

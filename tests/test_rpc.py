@@ -91,3 +91,34 @@ def test_exhausted_retries_raise():
     c = RpcClient(addr, max_retries=1, retry_backoff=0.05)
     with pytest.raises(OSError):
         c.call("ping")
+
+
+def test_rpc_request_dedup_at_most_once():
+    """Requests carrying a dedup id execute at most once: a re-delivery
+    (retry after ambiguous connection death) returns the cached response
+    instead of re-running the handler."""
+    import socket
+
+    from persia_tpu.rpc import RpcServer, _recv_msg, _send_msg
+
+    calls = []
+    server = RpcServer()
+    server.register(
+        "bump", lambda p: (calls.append(1), b"%d" % len(calls))[1])
+    server.serve_background()
+    try:
+        host, port = server.addr.rsplit(":", 1)
+        with socket.create_connection((host, int(port))) as conn:
+            req_id = b"x" * 12
+            _send_msg(conn, ["bump", req_id], b"", False)
+            env1, r1 = _recv_msg(conn)
+            _send_msg(conn, ["bump", req_id], b"", False)  # retry delivery
+            env2, r2 = _recv_msg(conn)
+            assert env1[0] == env2[0] == "ok"
+            assert r1 == r2 == b"1"
+            assert len(calls) == 1
+            _send_msg(conn, ["bump", b"y" * 12], b"", False)  # fresh id
+            _, r3 = _recv_msg(conn)
+            assert r3 == b"2" and len(calls) == 2
+    finally:
+        server.stop()
