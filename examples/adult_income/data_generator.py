@@ -64,3 +64,39 @@ def batches(
             requires_grad=requires_grad,
             batch_id=start // batch_size,
         )
+
+
+def npz_batches(
+    path: str, batch_size: int = 128, requires_grad: bool = True
+) -> Iterator[PersiaBatch]:
+    """Batches from the reference's preprocessed dataset format.
+
+    Reads the exact ``train.npz``/``test.npz`` layout the reference's
+    ``data_preprocess.py`` emits (keys: target, continuous_data,
+    categorical_data, categorical_columns — see
+    examples/src/adult-income/data/data_preprocess.py and the loader in
+    data_generator.py:79-95), so real UCI adult-income files prepared
+    for the reference drop straight into this framework for AUC
+    comparison against its published goldens (train.py:23-24)."""
+    with np.load(path) as data:
+        target = data["target"].astype(np.float32)
+        dense = data["continuous_data"].astype(np.float32)
+        cats = data["categorical_data"].astype(np.uint64)
+        names = [str(c) for c in data["categorical_columns"]]
+    n = len(target)
+    labels = target.reshape(n, 1)
+    for start in range(0, n, batch_size):
+        end = min(start + batch_size, n)
+        id_feats = [
+            IDTypeFeatureWithSingleID(
+                name, np.ascontiguousarray(cats[start:end, i])
+            )
+            for i, name in enumerate(names)
+        ]
+        yield PersiaBatch(
+            id_feats,
+            non_id_type_features=[NonIDTypeFeature(dense[start:end])],
+            labels=[Label(labels[start:end])],
+            requires_grad=requires_grad,
+            batch_id=start // batch_size,
+        )

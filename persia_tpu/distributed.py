@@ -47,7 +47,10 @@ class DistributedOption:
 
         from persia_tpu.parallel.mesh import make_mesh
 
-        if self.multihost and jax.process_count() == 1:
+        # jax.process_count() would itself initialize the backend, which
+        # jax.distributed.initialize refuses to run after — probe the
+        # distributed client state instead
+        if self.multihost and not jax.distributed.is_initialized():
             kwargs = {}
             if self.coordinator_address:
                 kwargs["coordinator_address"] = self.coordinator_address

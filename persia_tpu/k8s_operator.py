@@ -1,0 +1,217 @@
+"""Kubernetes reconcile loop for persia_tpu jobs.
+
+The reference runs a Rust kube-runtime Controller that creates the
+job's pods, restarts failures, and tears everything down on delete
+(k8s/src/bin/operator.rs:25-123, reconcile interval 10 s, with
+PersiaJobResources apply/delete in k8s/src/lib.rs). This is the same
+control loop over the declarative manifests from
+:mod:`persia_tpu.k8s_utils`:
+
+- **desired state** = ``gen_manifests(job_spec)`` for every tracked job
+- **observed state** = pods/services labeled ``persia-job=<name>``
+- reconcile: create missing objects, delete+recreate pods in a terminal
+  phase (Failed, or Succeeded for long-running roles), delete objects
+  that are no longer desired, and tear down all objects of untracked
+  (deleted) jobs.
+
+The API surface is pluggable: :class:`KubectlApi` shells out to
+``kubectl`` (no client library dependency, works against any cluster),
+and :class:`FakeKubeApi` is an in-memory twin for tests (the reference's
+operator is e2e-tested against a real cluster, k8s/src/bin/e2e.rs; the
+fake gives the same coverage in-process).
+
+CLI: ``python -m persia_tpu.k8s_operator job1.yml job2.yml
+[--interval 10] [--once]``
+"""
+
+import argparse
+import json
+import subprocess
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from persia_tpu.k8s_utils import gen_manifests
+from persia_tpu.logger import get_default_logger
+from persia_tpu.utils import load_yaml
+
+_logger = get_default_logger(__name__)
+
+# pods in these phases are dead and must be replaced (every persia role
+# is a long-running service; a "Succeeded" PS/worker means it exited)
+_TERMINAL_PHASES = ("Failed", "Succeeded", "Unknown")
+
+
+class KubectlApi:
+    """Real-cluster access through the kubectl CLI."""
+
+    def __init__(self, namespace: str = "default", kubectl: str = "kubectl"):
+        self.namespace = namespace
+        self.kubectl = kubectl
+
+    def _run(self, args: List[str], stdin: Optional[str] = None) -> str:
+        proc = subprocess.run(
+            [self.kubectl, "-n", self.namespace, *args],
+            input=stdin, capture_output=True, text=True,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"kubectl {' '.join(args)} failed: {proc.stderr.strip()}")
+        return proc.stdout
+
+    def apply(self, manifest: dict):
+        self._run(["apply", "-f", "-"], stdin=json.dumps(manifest))
+
+    def delete(self, kind: str, name: str):
+        self._run(["delete", kind.lower(), name, "--ignore-not-found",
+                   "--wait=false"])
+
+    def list_objects(self, label_selector: str) -> List[dict]:
+        out = []
+        for kind in ("pods", "services"):
+            data = json.loads(
+                self._run(["get", kind, "-l", label_selector, "-o", "json"]))
+            out.extend(data.get("items", []))
+        return out
+
+
+class FakeKubeApi:
+    """In-memory twin of KubectlApi for unit tests.
+
+    Tests mutate observed state directly (``kill_pod``) to simulate
+    crashes; new pods come up ``Running``.
+    """
+
+    def __init__(self):
+        # (kind, name) -> manifest (with .status.phase for pods)
+        self.objects: Dict[Tuple[str, str], dict] = {}
+        self.apply_log: List[str] = []
+        self.delete_log: List[str] = []
+
+    def apply(self, manifest: dict):
+        kind = manifest["kind"]
+        name = manifest["metadata"]["name"]
+        manifest = dict(manifest)
+        if kind == "Pod":
+            manifest["status"] = {"phase": "Running"}
+        self.objects[(kind, name)] = manifest
+        self.apply_log.append(f"{kind}/{name}")
+
+    def delete(self, kind: str, name: str):
+        self.objects.pop((kind.capitalize(), name), None)
+        # kubectl's kind argument is lowercase; normalize both spellings
+        self.objects.pop((kind, name), None)
+        self.delete_log.append(f"{kind}/{name}")
+
+    def list_objects(self, label_selector: str) -> List[dict]:
+        want = dict(kv.split("=", 1) for kv in label_selector.split(","))
+        out = []
+        for obj in self.objects.values():
+            labels = obj.get("metadata", {}).get("labels", {})
+            if all(labels.get(k) == v for k, v in want.items()):
+                out.append(obj)
+        return out
+
+    def kill_pod(self, name: str, phase: str = "Failed"):
+        self.objects[("Pod", name)]["status"] = {"phase": phase}
+
+
+class Operator:
+    """The reconcile loop (reference operator.rs:25-123)."""
+
+    def __init__(self, api, job_specs: Optional[List[dict]] = None,
+                 interval: float = 10.0):
+        self.api = api
+        self.interval = interval
+        self._jobs: Dict[str, dict] = {}
+        self._stop = threading.Event()
+        for spec in job_specs or []:
+            self.track(spec)
+
+    # --- job tracking (the CRD add/delete events) -----------------------
+
+    def track(self, spec: dict):
+        self._jobs[spec["jobName"]] = spec
+
+    def untrack(self, job_name: str):
+        """Stop managing a job; its objects are torn down on the next
+        reconcile (the reference's delete finalizer)."""
+        self._jobs.pop(job_name, None)
+        self.teardown(job_name)
+
+    def teardown(self, job_name: str):
+        for obj in self.api.list_objects(f"persia-job={job_name}"):
+            self.api.delete(obj["kind"], obj["metadata"]["name"])
+
+    # --- reconcile ------------------------------------------------------
+
+    def reconcile_job(self, spec: dict) -> Dict[str, int]:
+        """Drive one job toward its desired manifest set. Returns action
+        counts (created/restarted/removed) for observability."""
+        job = spec["jobName"]
+        desired = {
+            (m["kind"], m["metadata"]["name"]): m
+            for m in gen_manifests(spec)
+        }
+        observed = {
+            (o["kind"], o["metadata"]["name"]): o
+            for o in self.api.list_objects(f"persia-job={job}")
+        }
+        stats = {"created": 0, "restarted": 0, "removed": 0}
+        for key, manifest in desired.items():
+            obj = observed.get(key)
+            if obj is None:
+                self.api.apply(manifest)
+                stats["created"] += 1
+            elif (key[0] == "Pod"
+                  and obj.get("status", {}).get("phase") in _TERMINAL_PHASES):
+                # dead pod: delete now; the NEXT pass's missing-object
+                # branch recreates it. Re-applying the same name in the
+                # same pass races the apiserver's termination grace
+                # period (the object still exists with a
+                # deletionTimestamp) and would abort the reconcile.
+                self.api.delete(key[0], key[1])
+                stats["restarted"] += 1
+        for key in observed.keys() - desired.keys():
+            self.api.delete(key[0], key[1])
+            stats["removed"] += 1
+        if any(stats.values()):
+            _logger.info("reconciled %s: %s", job, stats)
+        return stats
+
+    def reconcile_all(self):
+        for spec in list(self._jobs.values()):
+            try:
+                self.reconcile_job(spec)
+            except Exception as e:  # keep the loop alive (operator.rs
+                # requeues on error rather than crashing)
+                _logger.error("reconcile %s failed: %s",
+                              spec.get("jobName"), e)
+
+    def run(self):
+        while not self._stop.is_set():
+            self.reconcile_all()
+            self._stop.wait(self.interval)
+
+    def stop(self):
+        self._stop.set()
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="persia-tpu-operator")
+    p.add_argument("job_yamls", nargs="+", help="job spec YAML files")
+    p.add_argument("--namespace", default="default")
+    p.add_argument("--interval", type=float, default=10.0)
+    p.add_argument("--once", action="store_true",
+                   help="single reconcile pass, then exit")
+    args = p.parse_args(argv)
+    specs = [load_yaml(f) for f in args.job_yamls]
+    op = Operator(KubectlApi(args.namespace), specs, interval=args.interval)
+    if args.once:
+        op.reconcile_all()
+    else:
+        op.run()
+
+
+if __name__ == "__main__":
+    main()

@@ -96,6 +96,43 @@ def gen_manifests(spec: dict) -> List[dict]:
         },
     })
 
+    # Prometheus pushgateway (reference synthesizes one per job when
+    # metrics are enabled, k8s/src/crd.rs:435-464); every role pod gets
+    # PERSIA_METRICS_GATEWAY_ADDR pointing at it.
+    metrics = spec.get("metrics", {})
+    gateway_env = {}
+    if metrics.get("enabled"):
+        gw_host = f"{job}-metrics-gateway"
+        gw_port = int(metrics.get("port", 9091))
+        manifests.append({
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": gw_host,
+                "labels": {"persia-job": job,
+                           "persia-role": "metricsGateway"},
+            },
+            "spec": {
+                "containers": [{
+                    "name": "pushgateway",
+                    "image": metrics.get("image", "prom/pushgateway:v1.9.0"),
+                    "ports": [{"containerPort": gw_port}],
+                }],
+                "restartPolicy": "OnFailure",
+            },
+        })
+        manifests.append({
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {"name": gw_host, "labels": {"persia-job": job}},
+            "spec": {
+                "selector": {"persia-job": job,
+                             "persia-role": "metricsGateway"},
+                "ports": [{"port": gw_port, "targetPort": gw_port}],
+            },
+        })
+        gateway_env = {"PERSIA_METRICS_GATEWAY_ADDR": f"{gw_host}:{gw_port}"}
+
     roles = spec.get("roles", {})
     n_ps = int(roles.get("embeddingParameterServer", {}).get("replicas", 0))
     for role, conf in roles.items():
@@ -107,6 +144,7 @@ def gen_manifests(spec: dict) -> List[dict]:
                 "REPLICA_SIZE": replicas,
                 "PERSIA_COORDINATOR_ADDR": f"{coord_host}:{coord_port}",
                 "PERSIA_NUM_PS": n_ps,
+                **gateway_env,
                 **conf.get("env", {}),
             }
             command = ["python", "-m", "persia_tpu.launcher", launcher_role]

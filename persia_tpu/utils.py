@@ -22,7 +22,7 @@ def setup_seed(seed: int):
     os.environ["PYTHONHASHSEED"] = str(seed)
 
 
-def force_cpu_platform(n_devices: int = 8) -> None:
+def force_cpu_platform(n_devices: int = 8, verify: bool = True) -> None:
     """Force JAX onto a virtual ``n_devices``-device CPU platform.
 
     Must run before the JAX backend initializes. Env vars alone are not
@@ -31,6 +31,10 @@ def force_cpu_platform(n_devices: int = 8) -> None:
     already initialized with fewer devices (at that point the flags are
     dead letters). Shared by tests/conftest.py, dryrun_multichip, and any
     multi-process CPU-cluster harness.
+
+    ``verify=False`` skips the device-count check, which itself
+    initializes the backend — required when ``jax.distributed.initialize``
+    must still run after this (it rejects any prior backend init).
     """
     import re
 
@@ -47,6 +51,8 @@ def force_cpu_platform(n_devices: int = 8) -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    if not verify:
+        return
     have = len(jax.devices("cpu"))
     if have < n_devices:
         raise RuntimeError(
