@@ -66,25 +66,38 @@ def batches(
         )
 
 
-def npz_batches(
-    path: str, batch_size: int = 128, requires_grad: bool = True
-) -> Iterator[PersiaBatch]:
-    """Batches from the reference's preprocessed dataset format.
+def load_npz(path: str):
+    """Load the reference's preprocessed dataset format once.
 
-    Reads the exact ``train.npz``/``test.npz`` layout the reference's
+    The exact ``train.npz``/``test.npz`` layout the reference's
     ``data_preprocess.py`` emits (keys: target, continuous_data,
     categorical_data, categorical_columns — see
     examples/src/adult-income/data/data_preprocess.py and the loader in
     data_generator.py:79-95), so real UCI adult-income files prepared
     for the reference drop straight into this framework for AUC
-    comparison against its published goldens (train.py:23-24)."""
+    comparison against its published goldens (train.py:23-24).
+
+    Returns (names, categorical u64 (n, C), dense f32 (n, D),
+    labels f32 (n, 1)). Note the per-column codes start at 0 for every
+    column — the schema must namespace slots via
+    ``feature_index_prefix_bit`` (the reference config uses 12) or
+    different columns collide on the same embedding rows."""
     with np.load(path) as data:
         target = data["target"].astype(np.float32)
         dense = data["continuous_data"].astype(np.float32)
         cats = data["categorical_data"].astype(np.uint64)
         names = [str(c) for c in data["categorical_columns"]]
-    n = len(target)
-    labels = target.reshape(n, 1)
+    if len(target) == 0:
+        raise ValueError(f"{path}: dataset is empty")
+    return names, cats, dense, target.reshape(len(target), 1)
+
+
+def array_batches(
+    names, cats, dense, labels, batch_size: int = 128,
+    requires_grad: bool = True,
+) -> Iterator[PersiaBatch]:
+    """Batches over preloaded arrays (one load, many epochs)."""
+    n = len(labels)
     for start in range(0, n, batch_size):
         end = min(start + batch_size, n)
         id_feats = [
@@ -100,3 +113,11 @@ def npz_batches(
             requires_grad=requires_grad,
             batch_id=start // batch_size,
         )
+
+
+def npz_batches(
+    path: str, batch_size: int = 128, requires_grad: bool = True
+) -> Iterator[PersiaBatch]:
+    """One-shot convenience: :func:`load_npz` + :func:`array_batches`."""
+    return array_batches(*load_npz(path), batch_size=batch_size,
+                         requires_grad=requires_grad)

@@ -45,7 +45,8 @@ EMBEDDING_DIM = 8
 
 
 def build_ctx(n_ps: int = 2, seed: int = 42,
-              config_dir: str = None, slot_names=None) -> TrainCtx:
+              config_dir: str = None, slot_names=None,
+              feature_index_prefix_bit: int = 0) -> TrainCtx:
     setup_seed(seed)
     if config_dir:
         from persia_tpu.config import GlobalConfig
@@ -61,7 +62,8 @@ def build_ctx(n_ps: int = 2, seed: int = 42,
         if slot_names is None:
             slot_names = [f"slot_{s}" for s in range(NUM_SLOTS)]
         schema = EmbeddingSchema(
-            slots_config=uniform_slots(slot_names, dim=EMBEDDING_DIM)
+            slots_config=uniform_slots(slot_names, dim=EMBEDDING_DIM),
+            feature_index_prefix_bit=feature_index_prefix_bit,
         )
         holders = [make_holder(1_000_000, 8) for _ in range(n_ps)]
     worker = EmbeddingWorker(schema, holders)
@@ -111,19 +113,21 @@ def main_npz(train_npz: str, test_npz: str, batch_size: int = 128,
     reference's deterministic goldens (train.py:23-24: CPU 0.8928645...,
     GPU 0.8927145...; exact equality additionally needs reproducible
     dataflow + staleness=1, matching its e2e harness)."""
-    from data_generator import npz_batches
+    from data_generator import array_batches, load_npz
 
-    # np.load is lazy per key: reading only the column names avoids
-    # decompressing the full dataset for the schema probe
-    slot_names = [str(c) for c in np.load(train_npz)["categorical_columns"]]
-    ctx = build_ctx(slot_names=slot_names)
+    train_data = load_npz(train_npz)  # one decompression for all epochs
+    test_data = load_npz(test_npz)
+    # feature_index_prefix_bit=12 matches the reference's adult-income
+    # config: per-column codes all start at 0, so without per-slot sign
+    # namespacing different columns would collide on embedding rows
+    ctx = build_ctx(slot_names=train_data[0], feature_index_prefix_bit=12)
     with ctx:
         for epoch in range(epochs):
-            for batch in npz_batches(train_npz, batch_size):
+            for batch in array_batches(*train_data, batch_size=batch_size):
                 loss, _pred = ctx.train_step(batch)
             logger.info("epoch %d done, last loss %.4f", epoch, float(loss))
-        auc = evaluate(ctx, npz_batches(test_npz, batch_size,
-                                        requires_grad=False))
+        auc = evaluate(ctx, array_batches(*test_data, batch_size=batch_size,
+                                          requires_grad=False))
     logger.info("npz test auc %.6f (reference CPU golden 0.892865)", auc)
     return auc
 

@@ -248,42 +248,86 @@ class TrainCtx(EmbeddingCtx):
                 loss_fn=self.loss_fn, wire_dtype=self._wire_dtype(),
             )
 
+    def _prep_train_inputs(self, batch: PersiaBatch,
+                           lookup: Dict[str, Any]) -> tuple:
+        """Lookup results -> train-step inputs, uploading the embedding
+        values ONLY as the single packed wire blob.
+
+        Unlike :meth:`prepare_features` (the eval path), the per-slot
+        value matrices stay numpy: the jitted train step consumes the
+        packed array, so per-slot device uploads would both double the
+        pinned device memory and force a device->host round trip at
+        pack time. Returns (non_id, emb_inputs_host, emb_shapes,
+        flat_emb, emb_indices, labels)."""
+        from persia_tpu.parallel.train import pack_embedding_values
+
+        non_id = [jnp.asarray(f.data) for f in batch.non_id_type_features]
+        labels = [jnp.asarray(l.data) for l in batch.labels]
+        emb_np: List[np.ndarray] = []
+        emb_indices: List[Any] = []
+        emb_inputs: List[Any] = []  # host-side, for model init/shapes only
+        for f in batch.id_type_features:
+            r = lookup[f.name]
+            if isinstance(r, SumEmbedding):
+                emb_np.append(r.embeddings)
+                emb_indices.append(None)
+                emb_inputs.append(r.embeddings)
+            elif isinstance(r, RawEmbedding):
+                idx = jnp.asarray(r.index)
+                emb_np.append(r.embeddings)
+                emb_indices.append(idx)
+                emb_inputs.append((r.embeddings, idx))
+            else:
+                raise TypeError(f"unexpected lookup result {type(r)}")
+        emb_shapes = tuple(tuple(v.shape) for v in emb_np)
+        flat_emb = jnp.asarray(
+            pack_embedding_values(emb_np, self._wire_dtype())
+        )
+        return non_id, emb_inputs, emb_shapes, flat_emb, emb_indices, labels
+
+    def stage_batch(self, batch: PersiaBatch, lookup: Dict[str, Any]):
+        """Host->device staging for one looked-up batch, run by the
+        forward engine's prefetch workers so the uploads overlap the
+        previous batch's compute (the reference's postprocess_worker
+        moves batches to the GPU off the training thread via pinned
+        pools, forward.rs:572-638 + cuda/). Returns the staged tuple the
+        next ``train_step`` consumes; None when staging does not apply
+        (mesh placement happens on the training thread)."""
+        if self.mesh is not None:
+            return None
+        return self._prep_train_inputs(batch, lookup)
+
     def train_step(self, batch) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """One full hybrid step: lookup -> dense step -> sparse update.
 
         Accepts a raw :class:`PersiaBatch` (synchronous lookup + update)
         or a pipeline :class:`~persia_tpu.pipeline.LookedUpBatch` from a
         DataLoader, in which case the lookup already happened in a
-        prefetch worker and the gradient update is submitted to the async
-        backward engine (bounded by the staleness semaphore).
+        prefetch worker (with host->device staging done there too) and
+        the gradient update is submitted to the async backward engine
+        (bounded by the staleness semaphore).
 
         Embedding values/gradients cross the host<->device boundary as a
         single packed bf16 array in each direction (the TPU analogue of
         the reference's f16 wire, persia-common/src/lib.rs:85-113).
         Returns (loss, pred)."""
-        from persia_tpu.parallel.train import (
-            pack_embedding_values,
-            split_embedding_inputs,
-            unpack_embedding_grads,
-        )
+        from persia_tpu.parallel.train import unpack_embedding_grads
         from persia_tpu.pipeline import LookedUpBatch
 
         engine = None
+        staged = None
         if isinstance(batch, LookedUpBatch):
             ref_id, lookup, engine = batch.ref_id, batch.lookup, batch.engine
+            staged = batch.staged
             batch = batch.batch
         else:
             ref_id, lookup = self.worker.lookup_direct_training(
                 batch.id_type_features
             )
-        non_id, emb_inputs, labels = self.prepare_features(batch, lookup)
+        if staged is None:
+            staged = self._prep_train_inputs(batch, lookup)
+        non_id, emb_inputs, _emb_shapes, flat_emb, emb_indices, labels = staged
         self._ensure_compiled(non_id, emb_inputs)
-        emb_values, emb_indices = split_embedding_inputs(emb_inputs)
-        flat_emb = jnp.asarray(
-            pack_embedding_values(
-                [np.asarray(v) for v in emb_values], self._wire_dtype()
-            )
-        )
         if self.mesh is not None:
             from persia_tpu.parallel.mesh import shard_batch_pytree
 

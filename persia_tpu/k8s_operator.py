@@ -37,9 +37,23 @@ from persia_tpu.utils import load_yaml
 
 _logger = get_default_logger(__name__)
 
-# pods in these phases are dead and must be replaced (every persia role
-# is a long-running service; a "Succeeded" PS/worker means it exited)
-_TERMINAL_PHASES = ("Failed", "Succeeded", "Unknown")
+# Service roles run forever — any terminal phase (even Succeeded) means
+# the process exited and must be replaced. Entry-script roles (trainer,
+# data-loader) legitimately finish: only Failed/Unknown restarts them.
+_SERVICE_ROLES = frozenset({
+    "coordinator", "embeddingParameterServer", "embeddingWorker",
+    "metricsGateway",
+})
+_FAILED_PHASES = ("Failed", "Unknown")
+_SERVICE_TERMINAL_PHASES = ("Failed", "Succeeded", "Unknown")
+
+
+def _pod_needs_restart(manifest: dict, observed: dict) -> bool:
+    phase = observed.get("status", {}).get("phase")
+    role = manifest["metadata"].get("labels", {}).get("persia-role", "")
+    terminal = (_SERVICE_TERMINAL_PHASES if role in _SERVICE_ROLES
+                else _FAILED_PHASES)
+    return phase in terminal
 
 
 class KubectlApi:
@@ -163,8 +177,7 @@ class Operator:
             if obj is None:
                 self.api.apply(manifest)
                 stats["created"] += 1
-            elif (key[0] == "Pod"
-                  and obj.get("status", {}).get("phase") in _TERMINAL_PHASES):
+            elif key[0] == "Pod" and _pod_needs_restart(manifest, obj):
                 # dead pod: delete now; the NEXT pass's missing-object
                 # branch recreates it. Re-applying the same name in the
                 # same pass races the apiserver's termination grace

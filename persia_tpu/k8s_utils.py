@@ -116,6 +116,9 @@ def gen_manifests(spec: dict) -> List[dict]:
                 "containers": [{
                     "name": "pushgateway",
                     "image": metrics.get("image", "prom/pushgateway:v1.9.0"),
+                    # the process defaults to :9091; a non-default port
+                    # must reach the listener, not just the Service
+                    "args": [f"--web.listen-address=:{gw_port}"],
                     "ports": [{"containerPort": gw_port}],
                 }],
                 "restartPolicy": "OnFailure",

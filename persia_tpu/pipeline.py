@@ -47,12 +47,17 @@ _SENTINEL = object()
 @dataclass
 class LookedUpBatch:
     """A batch whose embeddings have been fetched — ready for the jitted
-    dense step (reference: PersiaTrainingBatch, forward.rs:101-117)."""
+    dense step (reference: PersiaTrainingBatch, forward.rs:101-117).
+
+    ``staged`` carries the device-resident inputs when the engine's
+    prefetch worker already ran the host->device staging (the
+    postprocess_worker -> GPU move of forward.rs:572-638)."""
 
     batch: PersiaBatch
     lookup: Dict[str, Any]
     ref_id: Optional[int]
     engine: Optional["ForwardEngine"] = None
+    staged: Optional[tuple] = None
 
     @property
     def requires_grad(self) -> bool:
@@ -252,8 +257,16 @@ class ForwardEngine:
                             lookup = self.worker.lookup_direct(
                                 batch.id_type_features, training=False
                             )
+                    staged = None
+                    stage = getattr(self.ctx, "stage_batch", None)
+                    if stage is not None and batch.requires_grad:
+                        # host->device staging off the training thread;
+                        # device_put is async so the upload overlaps the
+                        # in-flight compute
+                        staged = stage(batch, lookup)
                     heartbeat()
-                    out_q.put((seq, LookedUpBatch(batch, lookup, ref_id, self)))
+                    out_q.put((seq, LookedUpBatch(batch, lookup, ref_id,
+                                                  self, staged)))
                 except BaseException as e:
                     errors.append(e)
                     out_q.put(_SENTINEL)

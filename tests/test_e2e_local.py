@@ -88,9 +88,15 @@ def test_npz_reference_format_training(tmp_path):
     data/data_preprocess.py) so real UCI adult-income files drop in for
     AUC parity; prove the format path with a synthetic file of the same
     shape (8 categorical + 5 continuous columns) and check learning."""
-    from data_generator import generate, npz_batches
+    from data_generator import VOCAB_PER_SLOT, generate, npz_batches
 
     signs, dense, labels = generate(6144, seed=5)
+    # store RAW per-column ordinal codes (every column starting at 0),
+    # exactly like the reference's OrdinalEncoder output — the schema's
+    # feature_index_prefix_bit must prevent cross-column collisions
+    codes = signs - (np.arange(signs.shape[1], dtype=np.uint64)[None, :]
+                     * np.uint64(VOCAB_PER_SLOT))
+    assert codes.max() < VOCAB_PER_SLOT
     cols = ["workclass", "education", "marital_status", "occupation",
             "relationship", "race", "gender", "native_country"]
     path = tmp_path / "train.npz"
@@ -98,7 +104,7 @@ def test_npz_reference_format_training(tmp_path):
         path,
         target=labels.ravel().astype(np.float32),
         continuous_data=dense,
-        categorical_data=signs,  # already uint64 ordinal-style codes
+        categorical_data=codes,
         categorical_columns=np.array(cols),
     )
     first = next(iter(npz_batches(str(path), 128)))

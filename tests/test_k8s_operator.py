@@ -51,12 +51,20 @@ def test_killed_ps_pod_is_recreated():
     assert api.objects[("Pod", victim)]["status"]["phase"] == "Running"
 
 
-def test_exited_long_running_pod_is_restarted():
+def test_exited_service_pod_is_restarted_but_finished_entry_is_not():
     api, op = _operator()
     op.reconcile_job(SPEC)
-    api.kill_pod("testjob-nnworker-0", phase="Succeeded")
+    # service role: Succeeded means the server process exited -> restart
+    api.kill_pod("testjob-embeddingworker-0", phase="Succeeded")
     assert op.reconcile_job(SPEC)["restarted"] == 1
     assert op.reconcile_job(SPEC)["created"] == 1
+    # entry-script role: Succeeded is legitimate completion -> leave it
+    api.kill_pod("testjob-nnworker-0", phase="Succeeded")
+    assert op.reconcile_job(SPEC) == {"created": 0, "restarted": 0,
+                                      "removed": 0}
+    # ...but a Failed entry pod does restart
+    api.kill_pod("testjob-nnworker-0", phase="Failed")
+    assert op.reconcile_job(SPEC)["restarted"] == 1
 
 
 def test_scale_down_removes_extra_pods():
