@@ -193,6 +193,7 @@ class TrainCtx(EmbeddingCtx):
         loss_fn=None,
         grad_update_interval: int = 1,
         seed: int = 0,
+        grad_reduce_dtype: Optional[str] = None,
     ):
         super().__init__(model=model, schema=schema, worker=worker,
                          embedding_config=embedding_config,
@@ -205,10 +206,14 @@ class TrainCtx(EmbeddingCtx):
         self.loss_fn = loss_fn or bce_loss
         self.grad_update_interval = grad_update_interval
         self.seed = seed
+        # "bf16" halves dense all-reduce bytes over ICI (the Bagua
+        # low-precision-algorithm analogue); None = full f32 reduction
+        self.grad_reduce_dtype = grad_reduce_dtype
         self.state = None
         self._train_step = None
         self._eval_step = None
         self._emb_shapes = None
+        self._ddp = False
 
     def __enter__(self):
         super().__enter__()
@@ -223,15 +228,28 @@ class TrainCtx(EmbeddingCtx):
             else jnp.float32
         )
 
+    def _use_ddp_step(self, emb_indices, batch_size: int) -> bool:
+        """Mesh present + every slot summed + batch divisible by the data
+        axis -> the explicit shard_map DDP step with batch-major packed
+        wire. Raw slots' shared distinct tensors cannot batch-shard, and
+        a partial final batch cannot split evenly — both keep the
+        auto-sharded path (shard_batch_pytree's replicate fallback)."""
+        if self.mesh is None or any(i is not None for i in emb_indices):
+            return False
+        from persia_tpu.parallel.mesh import DATA_AXIS
+
+        return batch_size % self.mesh.shape[DATA_AXIS] == 0
+
     def _ensure_compiled(self, non_id, emb_inputs):
         from persia_tpu.parallel.train import (
             create_train_state,
             make_eval_step,
             make_packed_train_step,
+            make_packed_train_step_ddp,
             split_embedding_inputs,
         )
 
-        emb_values, _ = split_embedding_inputs(emb_inputs)
+        emb_values, emb_indices = split_embedding_inputs(emb_inputs)
         emb_shapes = tuple(tuple(v.shape) for v in emb_values)
         if self.state is None:
             self.state = create_train_state(
@@ -243,10 +261,25 @@ class TrainCtx(EmbeddingCtx):
             # (re)build the packed step for this batch geometry; jit caches
             # by shape so alternating geometries stay cheap
             self._emb_shapes = emb_shapes
-            self._train_step = make_packed_train_step(
-                self.model, self.dense_optimizer, emb_shapes,
-                loss_fn=self.loss_fn, wire_dtype=self._wire_dtype(),
+            reduce_dtype = (
+                jnp.bfloat16 if self.grad_reduce_dtype == "bf16" else None
             )
+            batch_size = emb_shapes[0][0] if emb_shapes else 0
+            if self._use_ddp_step(emb_indices, batch_size):
+                self._ddp = True
+                self._slot_dims = [s[1] for s in emb_shapes]
+                self._train_step = make_packed_train_step_ddp(
+                    self.model, self.dense_optimizer, self._slot_dims,
+                    self.mesh, loss_fn=self.loss_fn,
+                    wire_dtype=self._wire_dtype(),
+                    grad_reduce_dtype=reduce_dtype,
+                )
+            else:
+                self._ddp = False
+                self._train_step = make_packed_train_step(
+                    self.model, self.dense_optimizer, emb_shapes,
+                    loss_fn=self.loss_fn, wire_dtype=self._wire_dtype(),
+                )
 
     def _prep_train_inputs(self, batch: PersiaBatch,
                            lookup: Dict[str, Any]) -> tuple:
@@ -258,8 +291,13 @@ class TrainCtx(EmbeddingCtx):
         packed array, so per-slot device uploads would both double the
         pinned device memory and force a device->host round trip at
         pack time. Returns (non_id, emb_inputs_host, emb_shapes,
-        flat_emb, emb_indices, labels)."""
-        from persia_tpu.parallel.train import pack_embedding_values
+        flat_emb, emb_indices, labels). The packed layout is batch-major
+        ``(batch, sum dims)`` for the DDP shard_map step (batch axis
+        shards over the mesh), flat otherwise."""
+        from persia_tpu.parallel.train import (
+            pack_embedding_values,
+            pack_embedding_values_batch_major,
+        )
 
         non_id = [jnp.asarray(f.data) for f in batch.non_id_type_features]
         labels = [jnp.asarray(l.data) for l in batch.labels]
@@ -280,9 +318,18 @@ class TrainCtx(EmbeddingCtx):
             else:
                 raise TypeError(f"unexpected lookup result {type(r)}")
         emb_shapes = tuple(tuple(v.shape) for v in emb_np)
-        flat_emb = jnp.asarray(
-            pack_embedding_values(emb_np, self._wire_dtype())
-        )
+        if self._use_ddp_step(emb_indices, len(labels[0])):
+            from persia_tpu.parallel.mesh import batch_sharding
+
+            flat_emb = jax.device_put(
+                pack_embedding_values_batch_major(emb_np,
+                                                  self._wire_dtype()),
+                batch_sharding(self.mesh),
+            )
+        else:
+            flat_emb = jnp.asarray(
+                pack_embedding_values(emb_np, self._wire_dtype())
+            )
         return non_id, emb_inputs, emb_shapes, flat_emb, emb_indices, labels
 
     def stage_batch(self, batch: PersiaBatch, lookup: Dict[str, Any]):
@@ -337,18 +384,34 @@ class TrainCtx(EmbeddingCtx):
             non_id, emb_indices, label = placed["n"], placed["i"], placed["l"]
         else:
             label = labels[0]
-        self.state, loss, flat_grads, pred = self._train_step(
-            self.state, non_id, flat_emb, emb_indices, label
-        )
+        if self._ddp:
+            self.state, loss, flat_grads, pred = self._train_step(
+                self.state, non_id, flat_emb, label
+            )
+        else:
+            self.state, loss, flat_grads, pred = self._train_step(
+                self.state, non_id, flat_emb, emb_indices, label
+            )
         names = [f.name for f in batch.id_type_features]
+        slot_dims = self._slot_dims if self._ddp else None
         if engine is not None:
             # the device->host gradient fetch happens in a backward worker
             # thread, not here — on a slow host link a synchronous fetch
             # would serialize every step on the d2h transfer
             engine.backward.submit_packed(
-                ref_id, flat_grads, self._emb_shapes, names)
+                ref_id, flat_grads, self._emb_shapes, names,
+                slot_dims=slot_dims)
         else:
-            per_slot = unpack_embedding_grads(flat_grads, self._emb_shapes)
+            if self._ddp:
+                from persia_tpu.parallel.train import (
+                    unpack_embedding_grads_batch_major,
+                )
+
+                per_slot = unpack_embedding_grads_batch_major(
+                    flat_grads, slot_dims)
+            else:
+                per_slot = unpack_embedding_grads(flat_grads,
+                                                  self._emb_shapes)
             self.worker.update_gradients(ref_id, dict(zip(names, per_slot)))
         return loss, pred
 

@@ -33,6 +33,13 @@ class DistributedOption:
             (coordinator address/process id), for pods spanning hosts.
         coordinator_address / num_processes / process_id: explicit
             multihost rendezvous parameters; default to the JAX env vars.
+        grad_reduce_dtype: "bf16" casts dense gradients before the
+            cross-replica all-reduce (the analogue of Bagua's
+            low-precision algorithms, persia/distributed.py:204-410);
+            None reduces in f32. Decentralized/async peer algorithms are
+            deliberately absent — ICI all-reduce is already the fast
+            path they approximate. Pass to ``TrainCtx`` alongside the
+            mesh this option builds.
     """
 
     mesh_shape: Optional[Tuple[int, int]] = None
@@ -40,6 +47,7 @@ class DistributedOption:
     coordinator_address: Optional[str] = None
     num_processes: Optional[int] = None
     process_id: Optional[int] = None
+    grad_reduce_dtype: Optional[str] = None
 
     def initialize(self):
         """Bring up multi-host JAX if requested; returns the Mesh."""
@@ -62,6 +70,16 @@ class DistributedOption:
             _logger.info("jax.distributed up: process %d/%d",
                          jax.process_index(), jax.process_count())
         return make_mesh(self.mesh_shape)
+
+    def train_ctx_kwargs(self) -> dict:
+        """Everything TrainCtx needs from this option:
+        ``TrainCtx(..., **option.train_ctx_kwargs())`` wires both the
+        mesh and the gradient-reduction dtype (a bare ``initialize()``
+        returns only the mesh and would drop grad_reduce_dtype)."""
+        return {
+            "mesh": self.initialize(),
+            "grad_reduce_dtype": self.grad_reduce_dtype,
+        }
 
 
 def get_default_distributed_option() -> DistributedOption:

@@ -66,11 +66,15 @@ class LookedUpBatch:
 
 @dataclass
 class _PackedGrads:
-    """A still-on-device packed gradient array awaiting d2h + unpack."""
+    """A still-on-device packed gradient array awaiting d2h + unpack.
+
+    ``slot_dims`` set means the batch-major (batch, sum dims) DDP wire
+    layout; otherwise the flat per-slot concatenation of ``shapes``."""
 
     flat: Any  # device array (one wire-dtype blob)
     shapes: Sequence[Tuple[int, ...]]
     names: Sequence[str]
+    slot_dims: Optional[Sequence[int]] = None
 
 
 def flush_backward_engines(worker, timeout: Optional[float] = None):
@@ -122,13 +126,15 @@ class BackwardEngine:
 
     def submit_packed(self, ref_id: int, flat_grads,
                       shapes: Sequence[Tuple[int, ...]],
-                      names: Sequence[str]):
+                      names: Sequence[str],
+                      slot_dims: Optional[Sequence[int]] = None):
         """Queue a packed gradient array WITHOUT forcing the device->host
         transfer: the fetch + unpack happen in a backward worker thread
         (the reference does its d2h in backward_to_cpu_worker,
         backward.rs:233-302), keeping the slow link off the training
         thread."""
-        self.submit(ref_id, _PackedGrads(flat_grads, shapes, names))
+        self.submit(ref_id, _PackedGrads(flat_grads, shapes, names,
+                                         slot_dims))
 
     def _run(self):
         import numpy as np
@@ -143,10 +149,15 @@ class BackwardEngine:
                     if isinstance(grads, _PackedGrads):
                         from persia_tpu.parallel.train import (
                             unpack_embedding_grads,
+                            unpack_embedding_grads_batch_major,
                         )
 
-                        per_slot = unpack_embedding_grads(
-                            np.asarray(grads.flat), grads.shapes)
+                        if grads.slot_dims is not None:
+                            per_slot = unpack_embedding_grads_batch_major(
+                                np.asarray(grads.flat), grads.slot_dims)
+                        else:
+                            per_slot = unpack_embedding_grads(
+                                np.asarray(grads.flat), grads.shapes)
                         grads = dict(zip(grads.names, per_slot))
                     self.worker.update_gradients(ref_id, grads,
                                                  loss_scale=self.loss_scale)

@@ -136,3 +136,121 @@ def test_sequence_tower_trains():
     assert losses[-1] < losses[0]
     # raw-slot gradient flows through attention
     assert float(jnp.abs(emb_grads[3]).sum()) > 0
+
+
+def test_ddp_hybrid_step_matches_single_device():
+    """The explicit shard_map DDP step (batch-major wire, pmean'd dense
+    grads) must match the single-device packed step closely, and the
+    bf16 gradient-reduction toggle (the Bagua low-precision analogue)
+    must still train."""
+    import optax
+
+    from persia_tpu.config import EmbeddingSchema, uniform_slots
+    from persia_tpu.ctx import TrainCtx
+    from persia_tpu.embedding.optim import Adagrad
+    from persia_tpu.models import DLRM
+    from persia_tpu.ps.store import EmbeddingHolder
+    from persia_tpu.worker.worker import EmbeddingWorker
+
+    from persia_tpu.data.batch import (
+        IDTypeFeatureWithSingleID,
+        Label,
+        NonIDTypeFeature,
+        PersiaBatch,
+    )
+
+    def make_batches(n, bs, seed=0):
+        rng = np.random.default_rng(seed)
+        out = []
+        for i in range(n):
+            out.append(PersiaBatch(
+                [IDTypeFeatureWithSingleID(
+                    f"s{k}",
+                    rng.integers(0, 500, size=bs, dtype=np.uint64))
+                 for k in range(4)],
+                non_id_type_features=[NonIDTypeFeature(
+                    rng.normal(size=(bs, 13)).astype(np.float32))],
+                labels=[Label(rng.integers(0, 2, size=(bs, 1))
+                              .astype(np.float32))],
+                batch_id=i,
+            ))
+        return out
+
+    def run(mesh, grad_reduce_dtype=None):
+        schema = EmbeddingSchema(
+            slots_config=uniform_slots([f"s{k}" for k in range(4)], dim=8))
+        worker = EmbeddingWorker(
+            schema, [EmbeddingHolder(100_000, 4) for _ in range(2)])
+        ctx = TrainCtx(
+            model=DLRM(embedding_dim=8),
+            dense_optimizer=optax.adagrad(0.05),
+            embedding_optimizer=Adagrad(lr=0.05),
+            schema=schema, worker=worker, mesh=mesh,
+            grad_reduce_dtype=grad_reduce_dtype, seed=3,
+        )
+        losses = []
+        with ctx:
+            for batch in make_batches(8, 64, seed=11):
+                loss, _ = ctx.train_step(batch)
+                losses.append(float(loss))
+        return losses
+
+    base = run(None)
+    ddp = run(make_mesh((8, 1)))
+    # same data, same init; only the reduction structure differs -> the
+    # trajectories must agree to f32 reduction-order tolerance
+    np.testing.assert_allclose(ddp, base, rtol=2e-3, atol=2e-3)
+    assert len(set(ddp)) > 1  # steps actually progressed
+
+    # bf16 reduction halves all-reduce bytes; numerics shift but the
+    # trajectory stays near the f32 one
+    low_prec = run(make_mesh((8, 1)), grad_reduce_dtype="bf16")
+    np.testing.assert_allclose(low_prec, ddp, rtol=0.05, atol=0.05)
+    assert low_prec != ddp  # the cast genuinely changed the reduction
+
+
+def test_ddp_partial_final_batch_falls_back():
+    """A batch not divisible by the data axis (the final partial batch of
+    an epoch) must fall back to the auto-sharded step, not crash in
+    shard_map."""
+    import optax
+
+    from persia_tpu.config import EmbeddingSchema, uniform_slots
+    from persia_tpu.ctx import TrainCtx
+    from persia_tpu.data.batch import (
+        IDTypeFeatureWithSingleID,
+        Label,
+        NonIDTypeFeature,
+        PersiaBatch,
+    )
+    from persia_tpu.embedding.optim import Adagrad
+    from persia_tpu.models import DLRM
+    from persia_tpu.ps.store import EmbeddingHolder
+    from persia_tpu.worker.worker import EmbeddingWorker
+
+    rng = np.random.default_rng(0)
+
+    def batch(bs, i):
+        return PersiaBatch(
+            [IDTypeFeatureWithSingleID(
+                "s0", rng.integers(0, 100, size=bs, dtype=np.uint64))],
+            non_id_type_features=[NonIDTypeFeature(
+                rng.normal(size=(bs, 13)).astype(np.float32))],
+            labels=[Label(rng.integers(0, 2, size=(bs, 1))
+                          .astype(np.float32))],
+            batch_id=i,
+        )
+
+    schema = EmbeddingSchema(slots_config=uniform_slots(["s0"], dim=8))
+    worker = EmbeddingWorker(schema, [EmbeddingHolder(10_000, 2)])
+    ctx = TrainCtx(
+        model=DLRM(embedding_dim=8), dense_optimizer=optax.adagrad(0.05),
+        embedding_optimizer=Adagrad(lr=0.05), schema=schema, worker=worker,
+        mesh=make_mesh((8, 1)),
+    )
+    with ctx:
+        loss1, _ = ctx.train_step(batch(64, 0))  # divisible: DDP step
+        assert ctx._ddp
+        loss2, _ = ctx.train_step(batch(60, 1))  # partial: fallback
+        assert not ctx._ddp
+    assert np.isfinite(float(loss1)) and np.isfinite(float(loss2))
