@@ -1,0 +1,21 @@
+# Runtime image for persia_tpu jobs (reference ships
+# persiaml/persia-{cuda,cpu}-runtime images, k8s/src/crd.rs:11-12).
+# CPU/PS roles need no accelerator; trainer pods on TPU VMs should use a
+# jax[tpu]-enabled base instead.
+FROM python:3.12-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ make libzstd-dev \
+    && rm -rf /var/lib/apt/lists/*
+
+RUN pip install --no-cache-dir \
+        "jax" "flax" "optax" "chex" "einops" \
+        numpy pyyaml msgpack zstandard ml_dtypes
+
+WORKDIR /workspace
+COPY persia_tpu/ persia_tpu/
+COPY native/ native/
+COPY examples/ examples/
+RUN make -C native -j"$(nproc)"
+
+ENV PYTHONPATH=/workspace

@@ -47,7 +47,17 @@ logger = get_default_logger("nn_worker")
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--num-workers", type=int, default=1)
-    p.add_argument("--embedding-staleness", type=int, default=8)
+    # env fallbacks mirror the reference's e2e compose contract
+    # (REPRODUCIBLE=1 + EMBEDDING_STALENESS=1 -> deterministic runs);
+    # empty/unset values fall back rather than crashing at startup
+    try:
+        staleness_default = int(os.environ.get("EMBEDDING_STALENESS") or 8)
+    except ValueError:
+        staleness_default = 8
+    p.add_argument("--embedding-staleness", type=int,
+                   default=staleness_default)
+    p.add_argument("--reproducible", action="store_true",
+                   default=os.environ.get("REPRODUCIBLE") == "1")
     args = p.parse_args()
 
     rank = get_rank()
@@ -69,13 +79,16 @@ def main():
         embedding_config=EmbeddingConfig(emb_initialization=(-0.05, 0.05)),
     )
     loader = DataLoader(StreamingDataset(receiver),
-                        embedding_staleness=args.embedding_staleness)
+                        embedding_staleness=args.embedding_staleness,
+                        reproducible=args.reproducible)
+    steps = 0
     with ctx:
-        for i, batch in enumerate(loader):
+        for batch in loader:
             loss, _ = ctx.train_step(batch)
-            if i % 50 == 0:
-                logger.info("step %d loss %.4f", i, float(loss))
-    logger.info("stream ended after %d steps", i + 1)
+            if steps % 50 == 0:
+                logger.info("step %d loss %.4f", steps, float(loss))
+            steps += 1
+    logger.info("stream ended after %d steps", steps)
     receiver.close()
 
 
