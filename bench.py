@@ -157,6 +157,62 @@ def bench_device(batch_size, steps, warmup, vocab=1 << 20):
     return steps * batch_size / elapsed
 
 
+def bench_worker(batch_size, steps, n_ps=2, dim=DIM):
+    """Host-side worker cycle (put+lookup+update through the C++ store),
+    all-miss worst case — the middleware throughput ceiling per core
+    (reference's equivalent tier: the Rust embedding worker)."""
+    from persia_tpu.config import EmbeddingSchema, uniform_slots
+    from persia_tpu.data.batch import IDTypeFeatureWithSingleID
+    from persia_tpu.ps.native import make_holder
+    from persia_tpu.worker.worker import EmbeddingWorker
+
+    schema = EmbeddingSchema(slots_config=uniform_slots(
+        [f"slot_{s}" for s in range(NUM_SLOTS)], dim=dim))
+    holders = [make_holder(50_000_000, 16) for _ in range(n_ps)]
+    worker = EmbeddingWorker(schema, holders)
+    worker.configure_parameter_servers(
+        "bounded_uniform", {"lower": -0.01, "upper": 0.01}, 1.0, 10.0)
+    worker.register_optimizer({
+        "type": "adagrad", "lr": 0.02, "initial_accumulator_value": 0.1,
+        "g_square_momentum": 1.0, "vectorwise_shared": False,
+    })
+    rng = np.random.default_rng(0)
+
+    def batch():
+        return [
+            IDTypeFeatureWithSingleID(
+                f"slot_{s}",
+                rng.integers(0, 1 << 40, size=batch_size, dtype=np.uint64))
+            for s in range(NUM_SLOTS)
+        ]
+
+    def cycle(b):
+        ref = worker.put_batch(b)
+        lk = worker.lookup(ref)
+        worker.update_gradients(ref, {k: v.embeddings for k, v in lk.items()})
+
+    for _ in range(3):
+        cycle(batch())
+    batches = [batch() for _ in range(steps)]  # generation outside timing
+    t0 = time.perf_counter()
+    for b in batches:
+        cycle(b)
+    elapsed = time.perf_counter() - t0
+    log(f"worker: {elapsed / steps * 1e3:.1f} ms/batch all-miss "
+        f"(bs={batch_size} x {NUM_SLOTS} slots, {n_ps} in-process PS)")
+    # steady-state complement: repeated signs -> hit path (what a
+    # converged production workload mostly sees)
+    hot = batches[-1]
+    cycle(hot)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        cycle(hot)
+    hot_elapsed = time.perf_counter() - t0
+    log(f"worker: {hot_elapsed / steps * 1e3:.1f} ms/batch steady-state "
+        f"(all hits)")
+    return steps * batch_size / elapsed
+
+
 def bench_wire(batch_size, steps):
     """Serialization microbench (analogue of the reference's
     persia-common-benchmark criterion suite): PTB2 batch round trip +
@@ -263,7 +319,7 @@ def preflight_backend(metric, unit, timeout=90):
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--mode", choices=["hybrid", "device", "wire"],
+    p.add_argument("--mode", choices=["hybrid", "device", "wire", "worker"],
                    default="hybrid")
     p.add_argument("--batch-size", type=int, default=4096)
     p.add_argument("--steps", type=int, default=30)
@@ -280,6 +336,7 @@ def main():
         "hybrid": ("dlrm_hybrid_samples_per_sec_chip", "samples/sec"),
         "device": ("dlrm_device_samples_per_sec_chip", "samples/sec"),
         "wire": ("ptb2_serialize_gb_per_sec", "GB/sec"),
+        "worker": ("worker_cycle_samples_per_sec_core", "samples/sec"),
     }[args.mode]
 
     # Two-tier watchdog. Tier 1 (threading.Timer) emits the diagnostic
@@ -302,7 +359,7 @@ def main():
     if args.smoke:
         args.batch_size, args.steps, args.warmup = 256, 3, 1
 
-    if args.mode != "wire":
+    if args.mode not in ("wire", "worker"):  # host-only modes skip jax
         preflight_backend(metric, unit,
                           timeout=max(args.max_seconds // 4, 90))
 
@@ -310,6 +367,9 @@ def main():
     t0 = time.perf_counter()
     if args.mode == "hybrid":
         value = bench_hybrid(args.batch_size, args.steps, args.warmup)
+        vs_baseline = value / BASELINE_SAMPLES_PER_SEC
+    elif args.mode == "worker":
+        value = bench_worker(args.batch_size, max(args.steps, 5))
         vs_baseline = value / BASELINE_SAMPLES_PER_SEC
     elif args.mode == "wire":
         value = bench_wire(args.batch_size, max(args.steps, 5))

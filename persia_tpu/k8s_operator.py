@@ -138,6 +138,11 @@ class Operator:
         self.api = api
         self.interval = interval
         self._jobs: Dict[str, dict] = {}
+        # serializes reconcile passes against track/untrack (the REST
+        # API mutates job state while the loop runs; without this a
+        # delete could race an in-flight reconcile, which would recreate
+        # the torn-down pods of a no-longer-tracked job — orphans)
+        self._lock = threading.RLock()
         self._stop = threading.Event()
         for spec in job_specs or []:
             self.track(spec)
@@ -145,13 +150,15 @@ class Operator:
     # --- job tracking (the CRD add/delete events) -----------------------
 
     def track(self, spec: dict):
-        self._jobs[spec["jobName"]] = spec
+        with self._lock:
+            self._jobs[spec["jobName"]] = spec
 
     def untrack(self, job_name: str):
-        """Stop managing a job; its objects are torn down on the next
-        reconcile (the reference's delete finalizer)."""
-        self._jobs.pop(job_name, None)
-        self.teardown(job_name)
+        """Stop managing a job; its objects are torn down immediately
+        (the reference's delete finalizer)."""
+        with self._lock:
+            self._jobs.pop(job_name, None)
+            self.teardown(job_name)
 
     def teardown(self, job_name: str):
         for obj in self.api.list_objects(f"persia-job={job_name}"):
@@ -162,7 +169,12 @@ class Operator:
     def reconcile_job(self, spec: dict) -> Dict[str, int]:
         """Drive one job toward its desired manifest set. Returns action
         counts (created/restarted/removed) for observability."""
+        with self._lock:
+            return self._reconcile_job_locked(spec)
+
+    def _reconcile_job_locked(self, spec: dict) -> Dict[str, int]:
         job = spec["jobName"]
+        stats = {"created": 0, "restarted": 0, "removed": 0}
         desired = {
             (m["kind"], m["metadata"]["name"]): m
             for m in gen_manifests(spec)
@@ -171,7 +183,6 @@ class Operator:
             (o["kind"], o["metadata"]["name"]): o
             for o in self.api.list_objects(f"persia-job={job}")
         }
-        stats = {"created": 0, "restarted": 0, "removed": 0}
         for key, manifest in desired.items():
             obj = observed.get(key)
             if obj is None:
@@ -193,13 +204,19 @@ class Operator:
         return stats
 
     def reconcile_all(self):
-        for spec in list(self._jobs.values()):
-            try:
-                self.reconcile_job(spec)
-            except Exception as e:  # keep the loop alive (operator.rs
-                # requeues on error rather than crashing)
-                _logger.error("reconcile %s failed: %s",
-                              spec.get("jobName"), e)
+        with self._lock:
+            specs = list(self._jobs.values())
+        for spec in specs:
+            with self._lock:
+                if spec["jobName"] not in self._jobs:
+                    continue  # deleted since the snapshot — do not
+                    # resurrect a torn-down job's pods
+                try:
+                    self._reconcile_job_locked(spec)
+                except Exception as e:  # keep the loop alive (operator.rs
+                    # requeues on error rather than crashing)
+                    _logger.error("reconcile %s failed: %s",
+                                  spec.get("jobName"), e)
 
     def run(self):
         while not self._stop.is_set():
@@ -210,16 +227,124 @@ class Operator:
         self._stop.set()
 
 
+class SchedulingServer:
+    """REST surface over the operator (reference: the actix-web
+    scheduling server, k8s/src/bin/server.rs — /apply /delete /listjobs
+    /listpods /podstatus). Submitting a job spec tracks + reconciles it;
+    deleting untracks + tears it down."""
+
+    def __init__(self, operator: Operator, host: str = "127.0.0.1",
+                 port: int = 0):
+        import http.server
+
+        op = operator
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):  # route through our logger
+                _logger.debug("rest: " + a[0], *a[1:])
+
+            def _send(self, code: int, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _query(self) -> dict:
+                from urllib.parse import parse_qsl, urlparse
+
+                return dict(parse_qsl(urlparse(self.path).query))
+
+            def do_GET(self):
+                from urllib.parse import urlparse
+
+                route = urlparse(self.path).path
+                q = self._query()
+                try:
+                    if route == "/listjobs":
+                        self._send(200, {"jobs": sorted(op._jobs)})
+                    elif route == "/listpods":
+                        job = q.get("job", "")
+                        pods = [
+                            {"name": o["metadata"]["name"],
+                             "phase": o.get("status", {}).get("phase")}
+                            for o in op.api.list_objects(f"persia-job={job}")
+                            if o["kind"] == "Pod"
+                        ]
+                        self._send(200, {"pods": pods})
+                    elif route == "/podstatus":
+                        job, pod = q.get("job", ""), q.get("pod", "")
+                        for o in op.api.list_objects(f"persia-job={job}"):
+                            if (o["kind"] == "Pod"
+                                    and o["metadata"]["name"] == pod):
+                                self._send(200, {
+                                    "phase": o.get("status", {}).get("phase")
+                                })
+                                return
+                        self._send(404, {"error": f"pod {pod!r} not found"})
+                    else:
+                        self._send(404, {"error": f"no route {route!r}"})
+                except Exception as e:  # surface as HTTP, keep serving
+                    self._send(500, {"error": repr(e)})
+
+            def do_POST(self):
+                from urllib.parse import urlparse
+
+                route = urlparse(self.path).path
+                try:
+                    if route == "/apply":
+                        n = int(self.headers.get("Content-Length", 0))
+                        spec = json.loads(self.rfile.read(n))
+                        op.track(spec)
+                        stats = op.reconcile_job(spec)
+                        self._send(200, {"job": spec["jobName"],
+                                         "reconcile": stats})
+                    elif route == "/delete":
+                        job = self._query().get("job", "")
+                        op.untrack(job)
+                        self._send(200, {"deleted": job})
+                    else:
+                        self._send(404, {"error": f"no route {route!r}"})
+                except Exception as e:
+                    self._send(500, {"error": repr(e)})
+
+        self._httpd = http.server.ThreadingHTTPServer((host, port), Handler)
+        self.addr = f"{host}:{self._httpd.server_address[1]}"
+
+    def serve_background(self):
+        threading.Thread(target=self._httpd.serve_forever, daemon=True,
+                         name=f"k8s-rest-{self.addr}").start()
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(prog="persia-tpu-operator")
-    p.add_argument("job_yamls", nargs="+", help="job spec YAML files")
+    p.add_argument("job_yamls", nargs="*", help="job spec YAML files")
     p.add_argument("--namespace", default="default")
     p.add_argument("--interval", type=float, default=10.0)
     p.add_argument("--once", action="store_true",
                    help="single reconcile pass, then exit")
+    p.add_argument("--serve", default=None, metavar="HOST:PORT",
+                   help="also expose the REST scheduling API")
     args = p.parse_args(argv)
+    if not args.job_yamls and not args.serve:
+        p.error("give job YAML files, --serve HOST:PORT, or both")
+    if args.once and args.serve:
+        p.error("--once exits immediately and would kill the REST server; "
+                "use one or the other")
     specs = [load_yaml(f) for f in args.job_yamls]
     op = Operator(KubectlApi(args.namespace), specs, interval=args.interval)
+    if args.serve:
+        if ":" not in args.serve:
+            p.error(f"--serve expects HOST:PORT, got {args.serve!r}")
+        host, port = args.serve.rsplit(":", 1)
+        server = SchedulingServer(op, host, int(port))
+        server.serve_background()
+        _logger.info("scheduling REST API on %s", server.addr)
     if args.once:
         op.reconcile_all()
     else:
