@@ -88,6 +88,12 @@ class KubectlApi:
             out.extend(data.get("items", []))
         return out
 
+    def list_custom(self, plural: str = "persiajobs") -> List[dict]:
+        """PersiaJob custom resources (requires the CRD from
+        ``persia_tpu.k8s_utils gencrd`` to be installed)."""
+        data = json.loads(self._run(["get", plural, "-o", "json"]))
+        return data.get("items", [])
+
 
 class FakeKubeApi:
     """In-memory twin of KubectlApi for unit tests.
@@ -101,6 +107,7 @@ class FakeKubeApi:
         self.objects: Dict[Tuple[str, str], dict] = {}
         self.apply_log: List[str] = []
         self.delete_log: List[str] = []
+        self.custom_resources: List[dict] = []  # PersiaJob CRs
 
     def apply(self, manifest: dict):
         kind = manifest["kind"]
@@ -129,6 +136,9 @@ class FakeKubeApi:
     def kill_pod(self, name: str, phase: str = "Failed"):
         self.objects[("Pod", name)]["status"] = {"phase": phase}
 
+    def list_custom(self, plural: str = "persiajobs") -> List[dict]:
+        return list(self.custom_resources)
+
 
 class Operator:
     """The reconcile loop (reference operator.rs:25-123)."""
@@ -144,14 +154,23 @@ class Operator:
         # the torn-down pods of a no-longer-tracked job — orphans)
         self._lock = threading.RLock()
         self._stop = threading.Event()
+        self._from_cr: set = set()  # jobs sourced from PersiaJob CRs
         for spec in job_specs or []:
             self.track(spec)
 
     # --- job tracking (the CRD add/delete events) -----------------------
 
-    def track(self, spec: dict):
+    def track(self, spec: dict, source: str = "api"):
+        """Track a job. ``source="cr"`` marks it as governed by its
+        PersiaJob custom resource; any other source (YAML argv, REST)
+        claims the job away from CR governance so a later CR sweep
+        cannot tear down a job the user explicitly re-applied."""
         with self._lock:
             self._jobs[spec["jobName"]] = spec
+            if source == "cr":
+                self._from_cr.add(spec["jobName"])
+            else:
+                self._from_cr.discard(spec["jobName"])
 
     def untrack(self, job_name: str):
         """Stop managing a job; its objects are torn down immediately
@@ -163,6 +182,17 @@ class Operator:
     def teardown(self, job_name: str):
         for obj in self.api.list_objects(f"persia-job={job_name}"):
             self.api.delete(obj["kind"], obj["metadata"]["name"])
+
+    # locked snapshots for concurrent readers (the REST handlers run on
+    # their own threads; iterating shared dicts unlocked would race the
+    # reconcile loop)
+    def job_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._jobs)
+
+    def objects_of(self, job_name: str) -> List[dict]:
+        with self._lock:
+            return list(self.api.list_objects(f"persia-job={job_name}"))
 
     # --- reconcile ------------------------------------------------------
 
@@ -203,9 +233,13 @@ class Operator:
             _logger.info("reconciled %s: %s", job, stats)
         return stats
 
-    def reconcile_all(self):
-        with self._lock:
-            specs = list(self._jobs.values())
+    def reconcile_all(self, specs: Optional[List[dict]] = None):
+        """One pass over every tracked job. ``specs`` overrides the
+        snapshot (tests use it to inject a stale one and prove the
+        deleted-while-iterating guard below)."""
+        if specs is None:
+            with self._lock:
+                specs = list(self._jobs.values())
         for spec in specs:
             with self._lock:
                 if spec["jobName"] not in self._jobs:
@@ -218,8 +252,40 @@ class Operator:
                     _logger.error("reconcile %s failed: %s",
                                   spec.get("jobName"), e)
 
-    def run(self):
+    def sync_custom_resources(self):
+        """Poll PersiaJob custom resources and converge the tracked-job
+        set on them (the reference Controller watches the CRD stream,
+        operator.rs:25-123; a poll every reconcile interval gives the
+        same convergence without a watch API). CR spec = the job spec;
+        removed CRs untrack (and tear down) their jobs."""
+        crs = self.api.list_custom()
+        seen = set()
+        for cr in crs:
+            spec = cr.get("spec", cr)
+            name = spec.get("jobName") or cr.get("metadata", {}).get("name")
+            if not name:
+                continue
+            spec = dict(spec, jobName=name)
+            seen.add(name)
+            self.track(spec, source="cr")
+        # only CR-sourced jobs are governed by CR deletion; jobs tracked
+        # from YAML argv or the REST API are untouched. Stale detection
+        # and the untrack run under ONE lock hold — releasing in between
+        # would let a concurrent REST /apply re-track the job only to
+        # have it silently torn down here.
+        with self._lock:
+            for j in list(self._from_cr - seen):
+                _logger.info("PersiaJob %s deleted; tearing down", j)
+                self._from_cr.discard(j)
+                self.untrack(j)
+
+    def run(self, from_crd: bool = False):
         while not self._stop.is_set():
+            if from_crd:
+                try:
+                    self.sync_custom_resources()
+                except Exception as e:
+                    _logger.error("CR sync failed: %s", e)
             self.reconcile_all()
             self._stop.wait(self.interval)
 
@@ -263,19 +329,19 @@ class SchedulingServer:
                 q = self._query()
                 try:
                     if route == "/listjobs":
-                        self._send(200, {"jobs": sorted(op._jobs)})
+                        self._send(200, {"jobs": op.job_names()})
                     elif route == "/listpods":
                         job = q.get("job", "")
                         pods = [
                             {"name": o["metadata"]["name"],
                              "phase": o.get("status", {}).get("phase")}
-                            for o in op.api.list_objects(f"persia-job={job}")
+                            for o in op.objects_of(job)
                             if o["kind"] == "Pod"
                         ]
                         self._send(200, {"pods": pods})
                     elif route == "/podstatus":
                         job, pod = q.get("job", ""), q.get("pod", "")
-                        for o in op.api.list_objects(f"persia-job={job}"):
+                        for o in op.objects_of(job):
                             if (o["kind"] == "Pod"
                                     and o["metadata"]["name"] == pod):
                                 self._send(200, {
@@ -330,9 +396,13 @@ def main(argv=None):
                    help="single reconcile pass, then exit")
     p.add_argument("--serve", default=None, metavar="HOST:PORT",
                    help="also expose the REST scheduling API")
+    p.add_argument("--from-crd", action="store_true",
+                   help="watch PersiaJob custom resources (install the "
+                        "CRD via `python -m persia_tpu.k8s_utils gencrd`)")
     args = p.parse_args(argv)
-    if not args.job_yamls and not args.serve:
-        p.error("give job YAML files, --serve HOST:PORT, or both")
+    if not args.job_yamls and not args.serve and not args.from_crd:
+        p.error("give job YAML files, --serve HOST:PORT, --from-crd, "
+                "or a combination")
     if args.once and args.serve:
         p.error("--once exits immediately and would kill the REST server; "
                 "use one or the other")
@@ -346,9 +416,11 @@ def main(argv=None):
         server.serve_background()
         _logger.info("scheduling REST API on %s", server.addr)
     if args.once:
+        if args.from_crd:
+            op.sync_custom_resources()
         op.reconcile_all()
     else:
-        op.run()
+        op.run(from_crd=args.from_crd)
 
 
 if __name__ == "__main__":

@@ -168,11 +168,92 @@ def gen_manifests(spec: dict) -> List[dict]:
     return manifests
 
 
+def gen_crd() -> dict:
+    """The PersiaJob CustomResourceDefinition (reference: gencrd.rs
+    emitting jobs.persia.com from the Rust CRD types, crd.rs:42-64).
+
+    A PersiaJob resource's spec is exactly the job-spec shape
+    ``gen_manifests`` consumes; the operator (k8s_operator.py
+    ``--from-crd``) watches these resources and reconciles them."""
+    role_schema = {
+        "type": "object",
+        "properties": {
+            "replicas": {"type": "integer", "minimum": 0},
+            "entry": {"type": "string"},
+            "port": {"type": "integer"},
+            "env": {"type": "object",
+                    "additionalProperties": {"type": "string"}},
+            "resources": {"type": "object",
+                          "x-kubernetes-preserve-unknown-fields": True},
+            "tpu": {
+                "type": "object",
+                "properties": {
+                    "type": {"type": "string"},
+                    "topology": {"type": "string"},
+                    "chips": {"type": "integer"},
+                },
+            },
+        },
+    }
+    spec_schema = {
+        "type": "object",
+        "required": ["jobName"],
+        "properties": {
+            "jobName": {"type": "string"},
+            "image": {"type": "string"},
+            "coordinatorPort": {"type": "integer"},
+            "embeddingConfigPath": {"type": "string"},
+            "globalConfigPath": {"type": "string"},
+            "metrics": {
+                "type": "object",
+                "properties": {
+                    "enabled": {"type": "boolean"},
+                    "port": {"type": "integer"},
+                    "image": {"type": "string"},
+                },
+            },
+            "roles": {
+                "type": "object",
+                "additionalProperties": role_schema,
+            },
+        },
+    }
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": "persiajobs.persia.com"},
+        "spec": {
+            "group": "persia.com",
+            "scope": "Namespaced",
+            "names": {
+                "plural": "persiajobs",
+                "singular": "persiajob",
+                "kind": "PersiaJob",
+                "shortNames": ["pj"],
+            },
+            "versions": [{
+                "name": "v1",
+                "served": True,
+                "storage": True,
+                "schema": {"openAPIV3Schema": {
+                    "type": "object",
+                    "properties": {"spec": spec_schema},
+                }},
+            }],
+        },
+    }
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(prog="persia-tpu-k8s")
-    p.add_argument("action", choices=["gen"])
-    p.add_argument("job_yaml")
+    p.add_argument("action", choices=["gen", "gencrd"])
+    p.add_argument("job_yaml", nargs="?")
     args = p.parse_args(argv)
+    if args.action == "gencrd":
+        yaml.safe_dump(gen_crd(), sys.stdout, sort_keys=False)
+        return
+    if not args.job_yaml:
+        p.error("gen requires a job YAML file")
     spec = load_yaml(args.job_yaml)
     yaml.safe_dump_all(gen_manifests(spec), sys.stdout, sort_keys=False)
 

@@ -178,13 +178,112 @@ def test_rest_scheduling_server_lifecycle():
         server.stop()
 
 
-def test_delete_during_reconcile_loop_does_not_resurrect(monkeypatch):
+def test_delete_during_reconcile_loop_does_not_resurrect():
     """A job deleted between reconcile_all's snapshot and its per-job
-    pass must stay deleted (no orphaned pods recreated)."""
+    pass must stay deleted (no orphaned pods recreated): inject the
+    stale snapshot taken BEFORE the delete."""
     api, op = _operator()
     op.reconcile_all()  # create everything
-    # simulate the race: untrack (teardown) after the snapshot would
-    # have been taken, then run the pass
-    op.untrack("testjob")
-    op.reconcile_all()
+    stale_snapshot = [SPEC]  # what the loop saw before the delete
+    op.untrack("testjob")  # REST /delete lands: teardown + untrack
+    op.reconcile_all(stale_snapshot)  # the in-flight pass resumes
     assert api.list_objects("persia-job=testjob") == []
+
+
+def test_gencrd_schema_covers_job_spec():
+    """The emitted CRD (reference gencrd.rs) must accept the job-spec
+    shape gen_manifests consumes."""
+    from persia_tpu.k8s_utils import gen_crd
+
+    crd = gen_crd()
+    assert crd["metadata"]["name"] == "persiajobs.persia.com"
+    assert crd["spec"]["group"] == "persia.com"
+    schema = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+    spec_props = schema["properties"]["spec"]["properties"]
+    for key in SPEC:
+        assert key in spec_props, f"CRD schema missing job-spec key {key}"
+    role_props = spec_props["roles"]["additionalProperties"]["properties"]
+    for key in ("replicas", "entry", "env", "tpu", "resources"):
+        assert key in role_props
+
+
+def test_operator_watches_custom_resources():
+    """CR add -> job reconciled; CR delete -> job torn down; YAML/REST
+    jobs are not governed by CR deletion (reference Controller watch,
+    operator.rs:25-123)."""
+    api = FakeKubeApi()
+    op = Operator(api, interval=0.01)
+    api.custom_resources.append({
+        "metadata": {"name": "crjob"},
+        "spec": dict(SPEC, jobName="crjob"),
+    })
+    op.sync_custom_resources()
+    op.reconcile_all()
+    assert api.list_objects("persia-job=crjob")
+    # a REST/YAML-tracked job alongside
+    op.track(dict(SPEC, jobName="yamljob"))
+    op.reconcile_all()
+    assert api.list_objects("persia-job=yamljob")
+    # CR removed -> crjob torn down, yamljob untouched
+    api.custom_resources.clear()
+    op.sync_custom_resources()
+    op.reconcile_all()
+    assert api.list_objects("persia-job=crjob") == []
+    assert api.list_objects("persia-job=yamljob")
+
+
+def test_system_e2e_rest_plus_loop_recovery():
+    """System-e2e harness analogue (reference k8s/src/bin/e2e.rs submits
+    a job and polls pod phases to completion): submit over REST with the
+    reconcile loop running, poll until all pods Running, kill a PS pod,
+    poll until the loop restores it, delete, poll until gone."""
+    import json
+    import threading as _threading
+    import time as _time
+    import urllib.request
+
+    from persia_tpu.k8s_operator import SchedulingServer
+
+    api = FakeKubeApi()
+    op = Operator(api, interval=0.02)
+    server = SchedulingServer(op)
+    server.serve_background()
+    loop = _threading.Thread(target=op.run, daemon=True)
+    loop.start()
+    base = f"http://{server.addr}"
+
+    def get(path):
+        with urllib.request.urlopen(base + path, timeout=10) as r:
+            return json.loads(r.read())
+
+    def post(path, payload=None):
+        data = json.dumps(payload).encode() if payload is not None else b""
+        req = urllib.request.Request(base + path, data=data, method="POST")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return json.loads(r.read())
+
+    def poll(pred, timeout=10.0):
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            if pred():
+                return True
+            _time.sleep(0.02)
+        return False
+
+    n_pods = sum(1 for m in gen_manifests(SPEC) if m["kind"] == "Pod")
+    try:
+        post("/apply", SPEC)
+        assert poll(lambda: len(get("/listpods?job=testjob")["pods"])
+                    == n_pods
+                    and all(p["phase"] == "Running"
+                            for p in get("/listpods?job=testjob")["pods"]))
+        victim = "testjob-embeddingparameterserver-0"
+        api.kill_pod(victim, phase="Failed")
+        assert poll(lambda: any(
+            p["name"] == victim and p["phase"] == "Running"
+            for p in get("/listpods?job=testjob")["pods"]))
+        post("/delete?job=testjob")
+        assert poll(lambda: get("/listpods?job=testjob")["pods"] == [])
+    finally:
+        op.stop()
+        server.stop()
