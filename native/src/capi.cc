@@ -119,6 +119,11 @@ void ptmw_sum_grad(const float* grad, const int32_t* elem_sample,
                       scale, out);
 }
 
+void ptmw_shard_order(const uint64_t* signs, int64_t n, uint32_t replica,
+                      int32_t* order, uint32_t* starts) {
+  persia::mw_shard_order(signs, n, replica, order, starts);
+}
+
 void ptmw_gather_rows(const float* src, const int32_t* idx, int64_t m,
                       int32_t dim, float filter_scale, int filter,
                       float* dst) {

@@ -152,6 +152,27 @@ inline void mw_sum_grad(const float* grad, const int32_t* elem_sample,
   }
 }
 
+// PS-shard grouping: counting sort of sign indices by
+// farmhash64(sign) % replica (the reference's sign_to_shard_modulo,
+// mod.rs:341-345, fused with the per-shard split of mod.rs:448-484).
+//   order:  (n,) int32 — indices grouped by shard
+//   starts: (replica+1,) uint32 — group boundaries into order
+inline void mw_shard_order(const uint64_t* signs, int64_t n,
+                           uint32_t replica, int32_t* order,
+                           uint32_t* starts) {
+  std::vector<uint32_t> shard_of(n);
+  for (uint32_t s = 0; s <= replica; ++s) starts[s] = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    uint32_t s = static_cast<uint32_t>(farmhash64(signs[i]) % replica);
+    shard_of[i] = s;
+    ++starts[s + 1];
+  }
+  for (uint32_t s = 0; s < replica; ++s) starts[s + 1] += starts[s];
+  std::vector<uint32_t> cursor(starts, starts + replica);
+  for (int64_t i = 0; i < n; ++i)
+    order[cursor[shard_of[i]]++] = static_cast<int32_t>(i);
+}
+
 // Row gather: dst[i, :] = src[idx[i], :], with optional scale and
 // non-finite zeroing (raw-slot gradient path: grad[rows + 1]).
 inline void mw_gather_rows(const float* src, const int32_t* idx, int64_t m,

@@ -267,6 +267,12 @@ class Operator:
                 continue
             spec = dict(spec, jobName=name)
             seen.add(name)
+            with self._lock:
+                # a job the user re-applied via REST/YAML is owned by
+                # them — the CR must not reclaim it (or overwrite their
+                # spec) on the next poll
+                if name in self._jobs and name not in self._from_cr:
+                    continue
             self.track(spec, source="cr")
         # only CR-sourced jobs are governed by CR deletion; jobs tracked
         # from YAML argv or the REST API are untouched. Stale detection

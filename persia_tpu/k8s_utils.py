@@ -137,6 +137,11 @@ def gen_manifests(spec: dict) -> List[dict]:
         gateway_env = {"PERSIA_METRICS_GATEWAY_ADDR": f"{gw_host}:{gw_port}"}
 
     roles = spec.get("roles", {})
+    unknown = set(roles) - set(_ROLE_LAUNCHER)
+    if unknown:
+        raise ValueError(
+            f"unknown role(s) {sorted(unknown)}; valid roles: "
+            f"{sorted(_ROLE_LAUNCHER)}")
     n_ps = int(roles.get("embeddingParameterServer", {}).get("replicas", 0))
     for role, conf in roles.items():
         replicas = int(conf.get("replicas", 1))
@@ -214,7 +219,11 @@ def gen_crd() -> dict:
             },
             "roles": {
                 "type": "object",
-                "additionalProperties": role_schema,
+                # only the four launcher roles exist; an open schema
+                # would admit CRs that can never converge (the manifest
+                # generator has no launcher for unknown roles)
+                "properties": {name: role_schema for name in _ROLE_LAUNCHER},
+                "additionalProperties": False,
             },
         },
     }

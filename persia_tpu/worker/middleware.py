@@ -217,9 +217,21 @@ def shard_split(
     """Group every feature's distinct signs by (PS shard, dim)."""
     from persia_tpu.hashing import sign_to_shard
 
+    native = _mw_native()
     by_key: Dict[Tuple[int, int], List[Tuple[np.ndarray, int]]] = {}
     for fi, feat in enumerate(feats):
         dim = schema.get_slot(feat.name).dim
+        if native is not None:
+            # fused farmhash + counting sort; slice order within a shard
+            # is ascending, identical to the nonzero path below
+            order, starts = native.shard_order(feat.distinct_signs,
+                                               replica_size)
+            for shard in range(replica_size):
+                a, b = int(starts[shard]), int(starts[shard + 1])
+                if a < b:
+                    by_key.setdefault((shard, dim), []).append(
+                        (order[a:b], fi))
+            continue
         shards = sign_to_shard(feat.distinct_signs, replica_size)
         for shard in np.unique(shards):
             sel = np.nonzero(shards == shard)[0].astype(np.int32)

@@ -29,7 +29,13 @@ def _load():
     from persia_tpu.ps.native import load_native_lib
 
     lib = load_native_lib()
-    if lib is None or not hasattr(lib, "ptmw_dedup"):
+    # guard EVERY kernel symbol: a stale prebuilt .so from an older
+    # checkout would otherwise AttributeError here instead of falling
+    # back to numpy
+    required = ("ptmw_dedup", "ptmw_sum_post", "ptmw_sum_grad",
+                "ptmw_shard_order", "ptmw_gather_rows",
+                "ptmw_scatter_rows", "ptmw_scatter_add_rows")
+    if lib is None or not all(hasattr(lib, s) for s in required):
         return None
     i32p = ctypes.POINTER(ctypes.c_int32)
     f32p = ctypes.POINTER(ctypes.c_float)
@@ -41,6 +47,8 @@ def _load():
     lib.ptmw_sum_post.argtypes = [f32p, i32p, i32p, i32, i32, f32p, f32p]
     lib.ptmw_sum_grad.argtypes = [f32p, i32p, i32p, i64, i64, i32,
                                   ctypes.c_float, f32p, f32p]
+    lib.ptmw_shard_order.argtypes = [u64p, i64, ctypes.c_uint32, i32p,
+                                     ctypes.POINTER(ctypes.c_uint32)]
     lib.ptmw_gather_rows.argtypes = [f32p, i32p, i64, i32, ctypes.c_float,
                                      ctypes.c_int, f32p]
     lib.ptmw_scatter_rows.argtypes = [f32p, i32p, i64, i32, f32p]
@@ -107,6 +115,22 @@ def sum_grad(grad: np.ndarray, elem_sample: np.ndarray,
                       num_distinct, dim, inv_loss_scale, sp,
                       _p(out, ctypes.c_float))
     return out
+
+
+def shard_order(signs: np.ndarray, replica: int) -> Tuple[np.ndarray,
+                                                          np.ndarray]:
+    """Counting sort of sign indices by farmhash64 % replica.
+
+    Returns (order int32 (n,), starts uint32 (replica+1,)); signs of
+    shard s are ``signs[order[starts[s]:starts[s+1]]]``."""
+    lib = _load()
+    signs = np.ascontiguousarray(signs, dtype=np.uint64)
+    order = np.empty(len(signs), dtype=np.int32)
+    starts = np.empty(replica + 1, dtype=np.uint32)
+    lib.ptmw_shard_order(_p(signs, ctypes.c_uint64), len(signs), replica,
+                         _p(order, ctypes.c_int32),
+                         _p(starts, ctypes.c_uint32))
+    return order, starts
 
 
 def gather_rows(src: np.ndarray, idx: np.ndarray, dim: int,

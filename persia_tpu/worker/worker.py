@@ -58,13 +58,20 @@ class EmbeddingWorker:
         # per-shard RPC futures, mod.rs:448-484): with N remote replicas
         # over DCN a serial loop costs N x the lookup latency. Each RPC
         # client pools one connection per calling thread, so concurrent
-        # calls to the same replica are safe.
+        # calls to the same replica are safe. In-process holders on a
+        # single-core host gain nothing from threads (pure GIL/context
+        # switch overhead), so fan out only when a client is remote
+        # (has a network address) or real parallelism exists.
+        import os
+
+        remote = any(hasattr(c, "addr") for c in self.ps_clients)
         self._fanout = (
             ThreadPoolExecutor(
                 max_workers=min(2 * self.replica_size, 32),
                 thread_name_prefix="ps-fanout",
             )
-            if self.replica_size > 1 else None
+            if self.replica_size > 1 and (remote or (os.cpu_count() or 1) > 1)
+            else None
         )
         self._lock = threading.Lock()
         self._next_ref_id = 1

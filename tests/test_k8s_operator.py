@@ -202,7 +202,14 @@ def test_gencrd_schema_covers_job_spec():
     spec_props = schema["properties"]["spec"]["properties"]
     for key in SPEC:
         assert key in spec_props, f"CRD schema missing job-spec key {key}"
-    role_props = spec_props["roles"]["additionalProperties"]["properties"]
+    roles_schema = spec_props["roles"]
+    # closed schema: only the launcher's roles are admissible (an open
+    # schema would accept CRs that can never converge)
+    assert roles_schema["additionalProperties"] is False
+    for role in ("embeddingParameterServer", "embeddingWorker",
+                 "nnWorker", "dataloader"):
+        assert role in roles_schema["properties"]
+    role_props = roles_schema["properties"]["nnWorker"]["properties"]
     for key in ("replicas", "entry", "env", "tpu", "resources"):
         assert key in role_props
 
@@ -287,3 +294,34 @@ def test_system_e2e_rest_plus_loop_recovery():
     finally:
         op.stop()
         server.stop()
+
+
+def test_cr_sweep_does_not_reclaim_user_applied_job():
+    """A job re-applied via REST/YAML is owned by the user: the CR poll
+    must neither overwrite their spec nor reclaim it into CR governance
+    (a later CR delete cannot tear it down)."""
+    api = FakeKubeApi()
+    op = Operator(api, interval=0.01)
+    api.custom_resources.append({
+        "metadata": {"name": "j"}, "spec": dict(SPEC, jobName="j")})
+    op.sync_custom_resources()
+    # user re-applies with a scaled-up spec
+    user_spec = dict(SPEC, jobName="j",
+                     roles={**SPEC["roles"],
+                            "embeddingParameterServer": {"replicas": 3}})
+    op.track(user_spec)
+    op.sync_custom_resources()  # next poll must not revert the spec
+    with op._lock:
+        assert op._jobs["j"]["roles"]["embeddingParameterServer"][
+            "replicas"] == 3
+    api.custom_resources.clear()
+    op.sync_custom_resources()  # CR deleted: user-owned job survives
+    assert "j" in op.job_names()
+
+
+def test_gen_manifests_rejects_unknown_role():
+    import pytest as _pytest
+
+    bad = dict(SPEC, roles={"trainer": {"replicas": 1}})
+    with _pytest.raises(ValueError, match="unknown role"):
+        gen_manifests(bad)

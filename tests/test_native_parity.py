@@ -346,3 +346,19 @@ def test_mw_middleware_bit_parity_full_pipeline():
             np.testing.assert_array_equal(on.index, op.index)
     for an, ap in zip(a_nat, a_py):
         np.testing.assert_array_equal(an, ap)
+
+
+def test_mw_shard_order_matches_numpy_split():
+    from persia_tpu.hashing import sign_to_shard
+    from persia_tpu.worker import mw_native
+
+    rng = np.random.default_rng(21)
+    for n, replica in ((0, 2), (1, 1), (4096, 2), (4096, 7)):
+        signs = rng.integers(0, 1 << 63, size=n, dtype=np.uint64)
+        order, starts = mw_native.shard_order(signs, replica)
+        shards = sign_to_shard(signs, replica)
+        assert int(starts[-1]) == n
+        for s in range(replica):
+            sel = order[int(starts[s]):int(starts[s + 1])]
+            ref = np.nonzero(shards == s)[0]
+            np.testing.assert_array_equal(sel, ref.astype(np.int32))
