@@ -84,3 +84,92 @@ class InferenceClient:
             return self.client.call("health") == b"ok"
         except Exception:
             return False
+
+
+def build_state_template(model, schema: EmbeddingSchema,
+                         num_dense: int, seed: int = 0):
+    """A TrainState with the right structure for deserializing a dense
+    checkpoint (flax.serialization.from_bytes needs a target pytree):
+    synthesizes one batch worth of zero inputs from the schema shapes."""
+    import jax
+    import jax.numpy as jnp
+
+    from persia_tpu.parallel.train import create_train_state
+
+    non_id = [jnp.zeros((1, num_dense), jnp.float32)]
+    emb_inputs = []
+    for name in schema.feature_names:
+        slot = schema.get_slot(name)
+        if slot.embedding_summation:
+            emb_inputs.append(jnp.zeros((1, slot.dim), jnp.float32))
+        else:
+            cap = slot.sample_fixed_size + 1
+            emb_inputs.append((
+                jnp.zeros((cap, slot.dim), jnp.float32),
+                jnp.zeros((1, slot.sample_fixed_size), jnp.int32),
+            ))
+    import optax
+
+    return create_train_state(model, optax.sgd(0.0), jax.random.key(seed),
+                              non_id, emb_inputs)
+
+
+def load_dense_state(model, schema: EmbeddingSchema, num_dense: int,
+                     path: str):
+    """Dense checkpoint bytes (checkpoint.DENSE_FILE) -> TrainState.
+
+    Serving never touches optimizer state, and the training optimizer is
+    unknown here (the checkpoint may hold adam/adagrad/... pytrees), so
+    only params/batch_stats/step are restored against the template —
+    the opt_state subtree of the checkpoint is ignored."""
+    import jax.numpy as jnp
+    from flax import serialization
+
+    template = build_state_template(model, schema, num_dense)
+    with open(path, "rb") as f:
+        raw = serialization.msgpack_restore(f.read())
+    params = serialization.from_state_dict(template.params, raw["params"])
+    batch_stats = serialization.from_state_dict(
+        template.batch_stats, raw.get("batch_stats", {}))
+    step = raw.get("step", 0)
+    return template.replace(params=params, batch_stats=batch_stats,
+                            step=jnp.asarray(step, jnp.int32))
+
+
+def main(argv=None):
+    """Serve a trained model (reference: the torchserve handler wiring,
+    examples/src/adult-income/launch_ts.sh + serve_handler.py)."""
+    import argparse
+
+    from persia_tpu.models import DCNv2, DLRM, DNN, DeepFM, WideAndDeep
+
+    zoo = {"dnn": DNN, "dlrm": DLRM, "dcnv2": DCNv2, "deepfm": DeepFM,
+           "wide_deep": WideAndDeep}
+    p = argparse.ArgumentParser(prog="persia-tpu-serving")
+    p.add_argument("--model", choices=sorted(zoo), default="dnn")
+    p.add_argument("--dense-checkpoint", required=True,
+                   help="dense.msgpack from dump_checkpoint")
+    p.add_argument("--embedding-config", required=True)
+    p.add_argument("--num-dense", type=int, default=5,
+                   help="dense feature width the model was trained with")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8501)
+    p.add_argument("--worker-addrs", default=None,
+                   help="comma-separated; default EMBEDDING_WORKER_SERVICE")
+    args = p.parse_args(argv)
+
+    schema = EmbeddingSchema.load(args.embedding_config)
+    model = zoo[args.model]()
+    state = load_dense_state(model, schema, args.num_dense,
+                             args.dense_checkpoint)
+    addrs = None
+    if args.worker_addrs:
+        addrs = [a.strip() for a in args.worker_addrs.split(",")
+                 if a.strip()]
+    server = InferenceServer(model, state, schema, worker_addrs=addrs,
+                             host=args.host, port=args.port)
+    server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

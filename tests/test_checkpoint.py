@@ -181,3 +181,36 @@ def test_ctx_checkpoint_dense_and_sparse(tmp_path):
         ctx.load_checkpoint(str(tmp_path / "ckpt"))
         assert int(ctx.state.step) == step_before
     assert os.path.exists(tmp_path / "ckpt" / "embedding_dump_done")
+
+
+def test_dense_checkpoint_roundtrip_via_state_template(tmp_path):
+    """serving.load_dense_state must rebuild the exact trained dense
+    state from checkpoint bytes using only (model, schema, num_dense) —
+    the serving CLI's boot path."""
+    import jax
+    import optax
+    from flax import serialization
+
+    from persia_tpu.config import EmbeddingSchema, uniform_slots
+    from persia_tpu.models import DNN
+    from persia_tpu.parallel.train import create_train_state
+    from persia_tpu.serving import load_dense_state
+
+    schema = EmbeddingSchema(slots_config=uniform_slots(["a", "b"], dim=8))
+    model = DNN()
+    num_dense = 5
+    non_id = [np.random.default_rng(0).normal(size=(1, num_dense))
+              .astype(np.float32)]
+    emb_inputs = [np.ones((1, 8), np.float32), np.ones((1, 8), np.float32)]
+    # adam, like the examples: its opt_state pytree differs from the
+    # serving template's, which load_dense_state must tolerate (serving
+    # never uses optimizer state)
+    state = create_train_state(model, optax.adam(1e-3), jax.random.key(3),
+                               non_id, emb_inputs)
+    path = tmp_path / "dense.msgpack"
+    path.write_bytes(serialization.to_bytes(state))
+    restored = load_dense_state(model, schema, num_dense, str(path))
+    for a, b in zip(jax.tree_util.tree_leaves(state.params),
+                    jax.tree_util.tree_leaves(restored.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(restored.step) == int(state.step)
