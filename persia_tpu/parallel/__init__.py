@@ -23,11 +23,20 @@ from persia_tpu.parallel.device_embedding import (
     DeviceEmbeddingBag,
     DeviceEmbeddingCollection,
 )
+from persia_tpu.parallel.ring_attention import (
+    ring_attention,
+    ring_self_attention,
+)
+from persia_tpu.parallel.ulysses import (
+    ulysses_attention,
+    ulysses_self_attention,
+)
 
 __all__ = [
     "DATA_AXIS", "MODEL_AXIS", "make_mesh", "batch_sharding", "replicated",
     "table_sharding", "shard_batch_pytree", "TrainState", "bce_loss",
     "create_train_state", "make_train_step", "make_eval_step",
     "split_embedding_inputs", "DeviceEmbeddingBag",
-    "DeviceEmbeddingCollection",
+    "DeviceEmbeddingCollection", "ring_attention", "ring_self_attention",
+    "ulysses_attention", "ulysses_self_attention",
 ]

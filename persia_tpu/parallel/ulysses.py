@@ -1,0 +1,78 @@
+"""Ulysses-style all-to-all sequence parallelism.
+
+The complement to :mod:`persia_tpu.parallel.ring_attention` (the
+reference has neither — SURVEY.md §5 — but long-context machinery is
+first-class here): instead of rotating K/V blocks around a ring, one
+``all_to_all`` re-partitions the sharding from *sequence* to *heads*, so
+every device runs ordinary full attention over the complete sequence for
+its head subset, and a second ``all_to_all`` restores sequence sharding
+(the DeepSpeed-Ulysses formulation). Communication is O(T·D/P) per
+device — the same volume as ring attention but in two bulk collectives
+that XLA schedules over ICI, which wins when heads are plentiful and the
+per-step latency of P ppermutes would dominate.
+
+Trade-off vs ring: Ulysses needs ``heads % axis_size == 0`` and holds
+the full-sequence K/V per device for 1/P of the heads (activations
+O(T·H/P·Dh) vs ring's O(T/P·H·Dh) — same total, different shape); ring
+never holds the full sequence but pays P permute steps. The per-head
+attention itself runs through the blockwise online-softmax kernel
+(``ring_attention`` with no axis = single-block flash attention), so
+score memory stays O(T·block), not O(T²). Pick per topology; both share
+the reference_attention semantics exactly.
+"""
+
+import functools
+
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from persia_tpu.parallel.ring_attention import ring_attention
+
+
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = False):
+    """Inside shard_map: q/k/v (B, H, T_local, Dh) with the sequence
+    sharded over ``axis_name``; H must divide by the axis size.
+
+    all_to_all to (B, H_local, T, Dh), full attention per head subset,
+    all_to_all back to (B, H, T_local, Dh)."""
+    axis_size = lax.psum(1, axis_name)
+    heads = q.shape[1]
+    if heads % axis_size != 0:
+        raise ValueError(
+            f"ulysses needs heads ({heads}) divisible by the sequence "
+            f"axis size ({axis_size}); use ring attention otherwise")
+
+    def seq_to_heads(x):
+        # (B, H, T/P, Dh) -> (B, H/P, T, Dh): split the head axis across
+        # devices, gather the sequence axis
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    q, k, v = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    # single-block flash kernel: O(T·block) score memory, not the O(T²)
+    # matrix a naive softmax(qkᵀ)v would materialize at long context
+    out = ring_attention(q, k, v, axis_name=None, causal=causal)
+    return heads_to_seq(out)
+
+
+def ulysses_self_attention(q, k, v, mesh: Mesh, seq_axis: str = "model",
+                           causal: bool = False):
+    """shard_map wrapper: q/k/v (B, H, T, Dh) with T sharded on
+    ``seq_axis``; returns attention output with the same sharding
+    (drop-in for :func:`ring_self_attention`)."""
+    from jax.experimental.shard_map import shard_map
+
+    spec = P(None, None, seq_axis, None)
+    fn = shard_map(
+        functools.partial(ulysses_attention, axis_name=seq_axis,
+                          causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_rep=False,
+    )
+    return fn(q, k, v)
