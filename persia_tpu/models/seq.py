@@ -17,11 +17,16 @@ from persia_tpu.models.common import MLP, gather_raw_embedding
 
 
 class SequenceSelfAttention(nn.Module):
+    """``context_parallel`` picks the strategy when a mesh is present:
+    "ring" (ppermute K/V rotation; any head count) or "ulysses"
+    (two all_to_all collectives; needs heads % axis_size == 0)."""
+
     num_heads: int = 2
     compute_dtype: Any = jnp.bfloat16
     mesh: Optional[Any] = None
     seq_axis: str = "model"
     causal: bool = False
+    context_parallel: str = "ring"  # "ring" | "ulysses"
 
     @nn.compact
     def __call__(self, x, mask):
@@ -30,6 +35,7 @@ class SequenceSelfAttention(nn.Module):
             reference_attention,
             ring_self_attention,
         )
+        from persia_tpu.parallel.ulysses import ulysses_self_attention
 
         bs, t, d = x.shape
         dh = max(1, d // self.num_heads)
@@ -42,20 +48,26 @@ class SequenceSelfAttention(nn.Module):
             return y.reshape(bs, t, self.num_heads, dh).transpose(0, 2, 1, 3)
 
         q, k, v = heads(q), heads(k), heads(v)
-        # masked positions contribute ~nothing: zero their keys/values and
-        # rely on the zero rows being uniform noise floor under softmax
-        km = mask[:, None, :, None]
-        k = jnp.where(km, k, jnp.asarray(-1e4, k.dtype))
-        v = jnp.where(km, v, 0)
+        # padded positions are masked at SCORE level inside the kernels
+        # (kv_mask); manipulating key vectors instead would shift scores
+        # by q·k_poison, which can be arbitrarily positive
+        if self.context_parallel not in ("ring", "ulysses"):
+            raise ValueError(
+                f"context_parallel must be 'ring' or 'ulysses', got "
+                f"{self.context_parallel!r}")
         if self.mesh is not None and self.mesh.shape[self.seq_axis] > 1:
-            out = ring_self_attention(
+            cp = (ulysses_self_attention
+                  if self.context_parallel == "ulysses"
+                  else ring_self_attention)
+            out = cp(
                 q.astype(jnp.float32), k.astype(jnp.float32),
                 v.astype(jnp.float32),
-                self.mesh, seq_axis=self.seq_axis, causal=self.causal)
+                self.mesh, seq_axis=self.seq_axis, causal=self.causal,
+                kv_mask=mask)
         else:
             out = reference_attention(
                 q.astype(jnp.float32), k.astype(jnp.float32),
-                v.astype(jnp.float32), causal=self.causal)
+                v.astype(jnp.float32), causal=self.causal, kv_mask=mask)
         out = out.transpose(0, 2, 1, 3).reshape(bs, t, self.num_heads * dh)
         return nn.Dense(d, dtype=dt)(out.astype(dt))
 
@@ -71,6 +83,7 @@ class SequenceTower(nn.Module):
     num_heads: int = 2
     compute_dtype: Any = jnp.bfloat16
     mesh: Optional[Any] = None
+    context_parallel: str = "ring"  # "ring" | "ulysses"
 
     @nn.compact
     def __call__(self, non_id_tensors, embedding_tensors, train: bool = False):
@@ -83,6 +96,7 @@ class SequenceTower(nn.Module):
                 attended = SequenceSelfAttention(
                     num_heads=self.num_heads, compute_dtype=dt,
                     mesh=self.mesh,
+                    context_parallel=self.context_parallel,
                 )(x, mask)
                 denom = jnp.maximum(
                     mask.sum(axis=1, keepdims=True), 1).astype(dt)

@@ -10,12 +10,17 @@ then rotates K/V around the ring with ``lax.ppermute`` — compute on the
 current block overlaps the ICI transfer of the next, and no shard ever
 materializes the full sequence.
 
+Every kernel takes an optional ``kv_mask`` (B, T_k) marking valid key
+positions — masking happens at SCORE level (-inf before softmax), the
+only correct place (zeroing/poisoning key vectors changes scores by
+q·k_poison, which can be arbitrarily positive). Fully-masked query rows
+produce zero output.
+
 Use inside ``shard_map`` (see :func:`ring_self_attention`), or directly
 under ``jit`` on one device where it degenerates to single-block flash
 attention.
 """
 
-import functools
 from typing import Optional
 
 import jax
@@ -23,28 +28,65 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+try:  # jax >= 0.8 top-level API; the experimental path is deprecated
+    from jax import shard_map as _jax_shard_map
 
-def reference_attention(q, k, v, causal: bool = False):
+    def _shard_map(f, mesh, in_specs, out_specs):
+        return _jax_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_vma=False)
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def _shard_map(f, mesh, in_specs, out_specs):
+        return _exp_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=False)
+
+
+def reference_attention(q, k, v, causal: bool = False, kv_mask=None):
     """O(T^2)-memory reference: softmax(q kᵀ / sqrt(d)) v.
 
-    q, k, v: (B, H, T, Dh)."""
+    q, k, v: (B, H, T, Dh); kv_mask: optional (B, T_k) bool of valid key
+    positions (scores of invalid keys are -inf; fully-masked query rows
+    yield 0)."""
     scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
     if causal:
         t = q.shape[2]
         mask = jnp.tril(jnp.ones((t, t), bool))
         s = jnp.where(mask[None, None], s, -jnp.inf)
+    if kv_mask is not None:
+        s = jnp.where(kv_mask[:, None, None, :], s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
+    if kv_mask is not None:
+        p = jnp.where(jnp.isnan(p), 0.0, p)  # fully-masked rows -> 0
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
 
+def _flash_update(o, m, l, s, v_blk):
+    """One online-softmax accumulation over a score block ``s`` that is
+    already -inf-masked; numerically guards rows with no visible keys
+    yet (m stays -inf until the first finite score). Shared by the ring
+    scan and the local chunked scan so the delicate guard logic cannot
+    diverge between strategies."""
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(s - safe_m[..., None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    correction = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
+    l = l * correction + p.sum(axis=-1)
+    o = o * correction[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32))
+    return o, m_new, l
+
+
 def ring_attention(q, k, v, axis_name: Optional[str] = None,
-                   causal: bool = False):
+                   causal: bool = False, kv_mask=None):
     """Blockwise attention over a ring-sharded sequence axis.
 
-    q, k, v: (B, H, T_local, Dh) — this shard's sequence block. With
-    ``axis_name=None`` (or axis size 1) this is plain flash attention on
-    the local block.
+    q, k, v: (B, H, T_local, Dh) — this shard's sequence block; kv_mask:
+    optional (B, T_local) bool for this shard's keys (rotates around the
+    ring with K/V). With ``axis_name=None`` (or axis size 1) this is
+    plain flash attention on the local block.
     """
     if axis_name is not None:
         axis_size = lax.psum(1, axis_name)
@@ -56,11 +98,13 @@ def ring_attention(q, k, v, axis_name: Optional[str] = None,
     t_k = k.shape[2]
     scale = 1.0 / jnp.sqrt(jnp.asarray(dh, jnp.float32))
     q32 = q.astype(jnp.float32)
+    if kv_mask is None:
+        kv_mask = jnp.ones((b, t_k), bool)
 
     q_pos = my_idx * t_q + lax.iota(jnp.int32, t_q)  # global query positions
 
     def step(carry, i):
-        o, m, l, k_blk, v_blk = carry
+        o, m, l, k_blk, v_blk, m_blk = carry
         # the block currently held originated on shard (my_idx - i) % size
         src = (my_idx - i) % axis_size
         s = jnp.einsum("bhqd,bhkd->bhqk", q32,
@@ -69,42 +113,99 @@ def ring_attention(q, k, v, axis_name: Optional[str] = None,
             k_pos = src * t_k + lax.iota(jnp.int32, t_k)
             mask = q_pos[:, None] >= k_pos[None, :]
             s = jnp.where(mask[None, None], s, -jnp.inf)
-        m_new = jnp.maximum(m, s.max(axis=-1))
-        # rows with no visible keys yet keep m=-inf; guard the exp
-        safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-        p = jnp.exp(s - safe_m[..., None])
-        p = jnp.where(jnp.isfinite(s), p, 0.0)
-        correction = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
-        l = l * correction + p.sum(axis=-1)
-        o = o * correction[..., None] + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32))
+        s = jnp.where(m_blk[:, None, None, :], s, -jnp.inf)
+        o, m, l = _flash_update(o, m, l, s, v_blk)
         if axis_name is not None and axis_size > 1:
             perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
             k_blk = lax.ppermute(k_blk, axis_name, perm)
             v_blk = lax.ppermute(v_blk, axis_name, perm)
-        return (o, m_new, l, k_blk, v_blk), None
+            m_blk = lax.ppermute(m_blk, axis_name, perm)
+        return (o, m, l, k_blk, v_blk, m_blk), None
 
     o0 = jnp.zeros((b, h, t_q, dh), jnp.float32)
     m0 = jnp.full((b, h, t_q), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((b, h, t_q), jnp.float32)
-    (o, m, l, _, _), _ = lax.scan(
-        step, (o0, m0, l0, k, v), jnp.arange(axis_size))
+    (o, m, l, _, _, _), _ = lax.scan(
+        step, (o0, m0, l0, k, v, kv_mask), jnp.arange(axis_size))
     l = jnp.maximum(l, 1e-20)
     return (o / l[..., None]).astype(q.dtype)
 
 
+def local_flash_attention(q, k, v, causal: bool = False,
+                          chunk_size: int = 512, kv_mask=None):
+    """Single-device blockwise (flash) attention: O(T·chunk) score memory.
+
+    q, k, v: (B, H, T, Dh); kv_mask optional (B, T_k). K/V stream
+    through in ``chunk_size`` blocks with the same online-softmax update
+    :func:`ring_attention` uses across shards — the inner kernel for
+    strategies that hold the full sequence per device (Ulysses) without
+    materializing the (T, T) score matrix."""
+    b, h, t_q, dh = q.shape
+    t_k = k.shape[2]
+    if t_k <= chunk_size:
+        return ring_attention(q, k, v, axis_name=None, causal=causal,
+                              kv_mask=kv_mask)
+    if kv_mask is None:
+        kv_mask = jnp.ones((b, t_k), bool)
+    n_chunks = -(-t_k // chunk_size)
+    pad = n_chunks * chunk_size - t_k
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        kv_mask = jnp.pad(kv_mask, ((0, 0), (0, pad)))  # padding invalid
+    k_chunks = k.reshape(b, h, n_chunks, chunk_size, dh)
+    v_chunks = v.reshape(b, h, n_chunks, chunk_size, dh)
+    m_chunks = kv_mask.reshape(b, n_chunks, chunk_size)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(dh, jnp.float32))
+    q32 = q.astype(jnp.float32)
+    q_pos = lax.iota(jnp.int32, t_q)
+
+    def step(carry, blk):
+        o, m, l = carry
+        k_blk, v_blk, m_blk, ci = blk
+        s = jnp.einsum("bhqd,bhkd->bhqk", q32,
+                       k_blk.astype(jnp.float32)) * scale
+        if causal:
+            k_pos = ci * chunk_size + lax.iota(jnp.int32, chunk_size)
+            cmask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(cmask[None, None], s, -jnp.inf)
+        s = jnp.where(m_blk[:, None, None, :], s, -jnp.inf)
+        o, m, l = _flash_update(o, m, l, s, v_blk)
+        return (o, m, l), None
+
+    o0 = jnp.zeros((b, h, t_q, dh), jnp.float32)
+    m0 = jnp.full((b, h, t_q), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, t_q), jnp.float32)
+    (o, m, l), _ = lax.scan(
+        step, (o0, m0, l0),
+        (k_chunks.transpose(2, 0, 1, 3, 4),
+         v_chunks.transpose(2, 0, 1, 3, 4),
+         m_chunks.transpose(1, 0, 2),
+         jnp.arange(n_chunks)),
+    )
+    l = jnp.maximum(l, 1e-20)
+    return (o / l[..., None]).astype(q.dtype)
+
+
+def seq_sharded(inner, mesh: Mesh, seq_axis: str):
+    """Shared shard_map wrapper for context-parallel attention:
+    ``inner(q_local, k_local, v_local, kv_mask_local)`` runs per shard;
+    q/k/v (B, H, T, Dh) and kv_mask (B, T) shard T over ``seq_axis``;
+    output keeps the q/k/v sharding."""
+    spec = P(None, None, seq_axis, None)
+    mspec = P(None, seq_axis)
+    return _shard_map(inner, mesh, (spec, spec, spec, mspec), spec)
+
+
 def ring_self_attention(q, k, v, mesh: Mesh, seq_axis: str = "model",
-                        causal: bool = False):
+                        causal: bool = False, kv_mask=None):
     """shard_map wrapper: q/k/v (B, H, T, Dh) with T sharded on
     ``seq_axis``; returns attention output with the same sharding."""
-    from jax.experimental.shard_map import shard_map
+    if kv_mask is None:
+        kv_mask = jnp.ones((q.shape[0], k.shape[2]), bool)
 
-    spec = P(None, None, seq_axis, None)
-    fn = shard_map(
-        functools.partial(ring_attention, axis_name=seq_axis, causal=causal),
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
-        check_rep=False,
-    )
-    return fn(q, k, v)
+    def inner(q, k, v, m):
+        return ring_attention(q, k, v, axis_name=seq_axis, causal=causal,
+                              kv_mask=m)
+
+    return seq_sharded(inner, mesh, seq_axis)(q, k, v, kv_mask)

@@ -15,26 +15,30 @@ Trade-off vs ring: Ulysses needs ``heads % axis_size == 0`` and holds
 the full-sequence K/V per device for 1/P of the heads (activations
 O(T·H/P·Dh) vs ring's O(T/P·H·Dh) — same total, different shape); ring
 never holds the full sequence but pays P permute steps. The per-head
-attention itself runs through the blockwise online-softmax kernel
-(``ring_attention`` with no axis = single-block flash attention), so
-score memory stays O(T·block), not O(T²). Pick per topology; both share
-the reference_attention semantics exactly.
+attention itself runs through :func:`local_flash_attention` (chunked
+online-softmax), so score memory stays O(T·chunk), not O(T²). Pick per
+topology; both share the reference_attention semantics exactly.
 """
 
-import functools
-
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
-from persia_tpu.parallel.ring_attention import ring_attention
+from persia_tpu.parallel.ring_attention import (
+    local_flash_attention,
+    seq_sharded,
+)
 
 
-def ulysses_attention(q, k, v, axis_name: str, causal: bool = False):
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
+                      chunk_size: int = 512, kv_mask=None):
     """Inside shard_map: q/k/v (B, H, T_local, Dh) with the sequence
-    sharded over ``axis_name``; H must divide by the axis size.
+    sharded over ``axis_name``; H must divide by the axis size; kv_mask
+    optional (B, T_local) of valid keys on this shard.
 
     all_to_all to (B, H_local, T, Dh), full attention per head subset,
     all_to_all back to (B, H, T_local, Dh)."""
+    import jax.numpy as jnp
+
     axis_size = lax.psum(1, axis_name)
     heads = q.shape[1]
     if heads % axis_size != 0:
@@ -52,27 +56,31 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = False):
         return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
                               tiled=True)
 
+    if kv_mask is None:
+        kv_mask = jnp.ones((q.shape[0], k.shape[2]), bool)
+    # the key mask has no head axis: gather the full sequence mask
+    full_mask = lax.all_gather(kv_mask, axis_name, axis=1, tiled=True)
     q, k, v = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    # single-block flash kernel: O(T·block) score memory, not the O(T²)
+    # chunked flash kernel: O(T·chunk) score memory, not the O(T²)
     # matrix a naive softmax(qkᵀ)v would materialize at long context
-    out = ring_attention(q, k, v, axis_name=None, causal=causal)
+    out = local_flash_attention(q, k, v, causal=causal,
+                                chunk_size=chunk_size, kv_mask=full_mask)
     return heads_to_seq(out)
 
 
 def ulysses_self_attention(q, k, v, mesh: Mesh, seq_axis: str = "model",
-                           causal: bool = False):
+                           causal: bool = False, chunk_size: int = 512,
+                           kv_mask=None):
     """shard_map wrapper: q/k/v (B, H, T, Dh) with T sharded on
     ``seq_axis``; returns attention output with the same sharding
     (drop-in for :func:`ring_self_attention`)."""
-    from jax.experimental.shard_map import shard_map
+    import jax.numpy as jnp
 
-    spec = P(None, None, seq_axis, None)
-    fn = shard_map(
-        functools.partial(ulysses_attention, axis_name=seq_axis,
-                          causal=causal),
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
-        check_rep=False,
-    )
-    return fn(q, k, v)
+    if kv_mask is None:
+        kv_mask = jnp.ones((q.shape[0], k.shape[2]), bool)
+
+    def inner(q, k, v, m):
+        return ulysses_attention(q, k, v, axis_name=seq_axis, causal=causal,
+                                 chunk_size=chunk_size, kv_mask=m)
+
+    return seq_sharded(inner, mesh, seq_axis)(q, k, v, kv_mask)

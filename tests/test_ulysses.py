@@ -65,3 +65,128 @@ def test_ulysses_rejects_indivisible_heads():
     mesh = make_mesh((1, 4), devices=jax.devices()[:4])
     with pytest.raises(ValueError, match="divisible"):
         ulysses_self_attention(q, k, v, mesh, seq_axis="model")
+
+
+def test_sequence_attention_ulysses_matches_ring_strategy():
+    """SequenceSelfAttention produces (near-)identical outputs under
+    either context-parallel strategy on a sharded mesh."""
+    from persia_tpu.models.seq import SequenceSelfAttention
+
+    mesh = make_mesh((1, 4), devices=jax.devices()[:4])
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.normal(size=(2, 32, 16)), jnp.float32)
+    mask = jnp.asarray(rng.random((2, 32)) > 0.2)
+    outs = {}
+    for strategy in ("ring", "ulysses"):
+        m = SequenceSelfAttention(num_heads=4, mesh=mesh,
+                                  context_parallel=strategy)
+        variables = m.init(jax.random.key(0), x, mask)
+        outs[strategy] = np.asarray(m.apply(variables, x, mask))
+    np.testing.assert_allclose(outs["ring"], outs["ulysses"],
+                               rtol=2e-2, atol=2e-2)  # bf16 projections
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_local_flash_chunked_matches_reference(causal):
+    """The chunked local flash kernel (chunk < T, with padding tail)
+    must match full attention exactly — this is what keeps Ulysses'
+    score memory at O(T x chunk)."""
+    from persia_tpu.parallel.ring_attention import local_flash_attention
+
+    q, k, v = _qkv(t=80)  # 80 with chunk 32 -> 3 chunks incl. padding
+    out = local_flash_attention(q, k, v, causal=causal, chunk_size=32)
+    ref = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5)
+
+
+def test_ulysses_chunked_inner_kernel():
+    q, k, v = _qkv(t=64)
+    mesh = make_mesh((1, 4), devices=jax.devices()[:4])
+    out = ulysses_self_attention(q, k, v, mesh, seq_axis="model",
+                                 chunk_size=16)
+    ref = reference_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5)
+
+
+def test_sequence_attention_rejects_bad_strategy():
+    from persia_tpu.models.seq import SequenceSelfAttention
+
+    mesh = make_mesh((1, 4), devices=jax.devices()[:4])
+    x = jnp.ones((1, 8, 16), jnp.float32)
+    mask = jnp.ones((1, 8), bool)
+    m = SequenceSelfAttention(num_heads=4, mesh=mesh,
+                              context_parallel="ulyses")  # typo
+    with pytest.raises(ValueError, match="context_parallel"):
+        m.init(jax.random.key(0), x, mask)
+
+
+def _masked_ref(q, k, v, keep):
+    """Ground truth for kv_mask: run full attention on only the kept
+    key positions (single shared mask across batch)."""
+    return reference_attention(q, k[:, :, keep], v[:, :, keep])
+
+
+@pytest.mark.parametrize("kernel", ["reference", "ring", "local", "ulysses"])
+def test_kv_mask_excludes_keys_at_score_level(kernel):
+    """Masked keys must contribute NOTHING — equivalent to physically
+    removing them. (Regression: poisoning key vectors with -1e4 shifted
+    scores by q.k_poison, which is POSITIVE for negative q sums, letting
+    masked positions dominate.)"""
+    from persia_tpu.parallel.ring_attention import local_flash_attention
+
+    rng = np.random.default_rng(9)
+    b, h, t, dh = 2, 4, 32, 16
+    mk = lambda: jnp.asarray(rng.normal(size=(b, h, t, dh)), jnp.float32)
+    q, k, v = mk(), mk(), mk()
+    keep = np.zeros(t, bool)
+    keep[: t // 2] = True  # mask out the second half everywhere
+    kv_mask = jnp.asarray(np.tile(keep, (b, 1)))
+    ref = _masked_ref(q, k, v, keep)
+    if kernel == "reference":
+        out = reference_attention(q, k, v, kv_mask=kv_mask)
+    elif kernel == "local":
+        out = local_flash_attention(q, k, v, chunk_size=8, kv_mask=kv_mask)
+    elif kernel == "ring":
+        mesh = make_mesh((1, 4), devices=jax.devices()[:4])
+        out = ring_self_attention(q, k, v, mesh, seq_axis="model",
+                                  kv_mask=kv_mask)
+    else:
+        mesh = make_mesh((1, 4), devices=jax.devices()[:4])
+        out = ulysses_self_attention(q, k, v, mesh, seq_axis="model",
+                                     kv_mask=kv_mask, chunk_size=8)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5)
+
+
+def test_fully_masked_rows_produce_zero():
+    q, k, v = _qkv(t=16, h=2)
+    kv_mask = jnp.zeros((2, 16), bool)
+    out = reference_attention(q, k, v, kv_mask=kv_mask)
+    np.testing.assert_array_equal(np.asarray(out), 0.0)
+    from persia_tpu.parallel.ring_attention import ring_attention
+
+    out2 = ring_attention(q, k, v, kv_mask=kv_mask)
+    np.testing.assert_array_equal(np.asarray(out2), 0.0)
+
+
+def test_local_flash_chunked_gradients_match_reference():
+    """Gradient parity through the chunked scan (pad tail + mask): a
+    regression in the backward of the pad/reshape path must not hide
+    behind the unchunked delegation."""
+    from persia_tpu.parallel.ring_attention import local_flash_attention
+
+    q, k, v = _qkv(t=40, h=2)
+    keep = np.ones(40, bool)
+    keep[33:] = False
+    kv_mask = jnp.asarray(np.tile(keep, (2, 1)))
+
+    def loss(q, k, v):
+        return jnp.sum(local_flash_attention(
+            q, k, v, chunk_size=16, kv_mask=kv_mask) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, kv_mask=kv_mask) ** 2)
+
+    g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
