@@ -64,7 +64,9 @@ def main():
     coord = CoordinatorClient(get_coordinator_addr())
     worker = RemoteEmbeddingWorker(
         coord.wait_members(ROLE_WORKER, args.num_workers, timeout=300))
-    receiver = DataflowReceiver()
+    # the stream ends only after EVERY data-loader replica sends EOS
+    receiver = DataflowReceiver(
+        num_senders=int(os.environ.get("PERSIA_NUM_DATALOADERS") or 1))
     coord.register(ROLE_TRAINER, rank, receiver.addr)
 
     schema = EmbeddingSchema(

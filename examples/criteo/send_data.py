@@ -1,0 +1,84 @@
+"""Criteo data-loader role entry: stream batches into the dataflow.
+
+Run under the launcher with the coordinator + workers + trainers up
+(this is the dataloader entry of examples/criteo/job.yml):
+
+    PERSIA_COORDINATOR_ADDR=... python -m persia_tpu.launcher data-loader \
+        examples/criteo/send_data.py --train day_0.tsv.gz
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, __file__.rsplit("/examples/", 1)[0])
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from persia_tpu.ctx import DataCtx
+from persia_tpu.env import get_coordinator_addr
+from persia_tpu.logger import get_default_logger
+from persia_tpu.service.coordinator import (
+    ROLE_TRAINER,
+    ROLE_WORKER,
+    CoordinatorClient,
+)
+from persia_tpu.service.dataflow import DataflowClient
+from persia_tpu.service.worker_service import RemoteEmbeddingWorker
+
+from criteo_data import criteo_batches, synthetic_batches
+
+logger = get_default_logger("criteo_data_loader")
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--train", default=os.environ.get("CRITEO_TRAIN"),
+                   help="Criteo tsv(.gz) (env CRITEO_TRAIN)")
+    p.add_argument("--samples", type=int, default=512_000)
+    p.add_argument("--batch-size", type=int, default=4096)
+    p.add_argument("--vocab", type=int, default=1 << 20)
+    p.add_argument("--seed", type=int, default=1)
+    # fleet sizes come from the manifest generator's env wiring
+    p.add_argument("--num-workers", type=int,
+                   default=int(os.environ.get("PERSIA_NUM_WORKERS") or 1))
+    p.add_argument("--num-trainers", type=int,
+                   default=int(os.environ.get("WORLD_SIZE") or 1))
+    args = p.parse_args()
+    # replica sharding: each loader replica takes every REPLICA_SIZE-th
+    # batch (or a distinct synthetic seed) so N replicas never stream
+    # duplicate data
+    replica_index = int(os.environ.get("REPLICA_INDEX") or 0)
+    replica_size = int(os.environ.get("REPLICA_SIZE") or 1)
+
+    coord = CoordinatorClient(get_coordinator_addr())
+    worker = RemoteEmbeddingWorker(
+        coord.wait_members(ROLE_WORKER, args.num_workers, timeout=300))
+    trainers = coord.wait_members(ROLE_TRAINER, args.num_trainers,
+                                  timeout=300)
+    logger.info("dataflow to %d workers, %d trainers (loader %d/%d)",
+                args.num_workers, len(trainers), replica_index,
+                replica_size)
+    if args.train:
+        batches = (
+            b for b in criteo_batches(args.train, args.batch_size,
+                                      max_samples=args.samples)
+            if b.batch_id % replica_size == replica_index
+        )
+    else:
+        logger.warning("no --train file; streaming synthetic batches")
+        batches = synthetic_batches(args.samples // replica_size,
+                                    args.batch_size,
+                                    seed=args.seed + replica_index,
+                                    vocab_per_slot=args.vocab)
+    sent = 0
+    with DataCtx(DataflowClient(worker, trainers)) as ctx:
+        for batch in batches:
+            batch.batch_id = None  # DataCtx assigns this loader's ids
+            ctx.send_data(batch)
+            sent += len(batch.labels[0].data)
+        ctx.dataflow.send_eos()
+    logger.info("sent %d samples; eos", sent)
+
+
+if __name__ == "__main__":
+    main()

@@ -142,7 +142,16 @@ def gen_manifests(spec: dict) -> List[dict]:
         raise ValueError(
             f"unknown role(s) {sorted(unknown)}; valid roles: "
             f"{sorted(_ROLE_LAUNCHER)}")
-    n_ps = int(roles.get("embeddingParameterServer", {}).get("replicas", 0))
+    def _replica_count(role_name: str) -> int:
+        # same default (1) the pod-rendering loop uses: a role present
+        # without an explicit replicas key is one replica, not zero
+        conf = roles.get(role_name)
+        return int(conf.get("replicas", 1)) if conf is not None else 0
+
+    n_ps = _replica_count("embeddingParameterServer")
+    n_workers = _replica_count("embeddingWorker")
+    n_loaders = _replica_count("dataloader")
+    n_trainers = _replica_count("nnWorker")
     for role, conf in roles.items():
         replicas = int(conf.get("replicas", 1))
         launcher_role = _ROLE_LAUNCHER[role]
@@ -152,9 +161,19 @@ def gen_manifests(spec: dict) -> List[dict]:
                 "REPLICA_SIZE": replicas,
                 "PERSIA_COORDINATOR_ADDR": f"{coord_host}:{coord_port}",
                 "PERSIA_NUM_PS": n_ps,
+                # fleet sizes every role needs for rendezvous waits
+                "PERSIA_NUM_WORKERS": n_workers,
+                "PERSIA_NUM_DATALOADERS": n_loaders,
                 **gateway_env,
                 **conf.get("env", {}),
             }
+            # every role may need the trainer count (data-loaders wait
+            # for all trainers before streaming); trainers additionally
+            # follow the RANK/WORLD_SIZE contract (env.py), matching the
+            # reference's torch.distributed launch env
+            env.setdefault("WORLD_SIZE", n_trainers)
+            if role == "nnWorker":
+                env.setdefault("RANK", i)
             command = ["python", "-m", "persia_tpu.launcher", launcher_role]
             if role == "embeddingWorker":
                 command += ["--embedding-config",

@@ -29,11 +29,21 @@ _EOS = object()
 
 
 class DataflowReceiver:
-    """Trainer-side ingestion endpoint."""
+    """Trainer-side ingestion endpoint.
+
+    ``num_senders`` is the number of data-loader replicas feeding this
+    trainer: the stream ends only after EVERY sender reports
+    end-of-stream, otherwise the fastest loader's EOS would terminate
+    the trainer while slower replicas are still mid-stream."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 buffer_size: int = 128):
+                 buffer_size: int = 128, num_senders: int = 1):
+        import threading
+
         self._q: "queue.Queue" = queue.Queue(maxsize=buffer_size)
+        self.num_senders = max(1, num_senders)
+        self._eos_seen = 0
+        self._eos_lock = threading.Lock()
         self.server = RpcServer(host, port)
         self.server.register("enqueue_batch", self._enqueue)
         self.server.register("end_of_stream", self._eos)
@@ -53,7 +63,11 @@ class DataflowReceiver:
         return b""
 
     def _eos(self, payload: bytes) -> bytes:
-        self._q.put(_EOS)
+        with self._eos_lock:
+            self._eos_seen += 1
+            done = self._eos_seen >= self.num_senders
+        if done:
+            self._q.put(_EOS)
         return b""
 
     def get(self, timeout: Optional[float] = None) -> Optional[PersiaBatch]:
@@ -103,5 +117,8 @@ class DataflowClient:
         trainer.call("enqueue_batch", payload)
 
     def send_eos(self):
+        # dedup id: an ambiguous connection death would otherwise re-send
+        # the EOS, double-counting this sender against the receiver's
+        # num_senders threshold and ending the stream early
         for t in self._trainers:
-            t.call("end_of_stream")
+            t.call("end_of_stream", dedup=True)

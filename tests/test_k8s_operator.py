@@ -325,3 +325,16 @@ def test_gen_manifests_rejects_unknown_role():
     bad = dict(SPEC, roles={"trainer": {"replicas": 1}})
     with _pytest.raises(ValueError, match="unknown role"):
         gen_manifests(bad)
+
+
+def test_manifest_env_wires_fleet_sizes_and_trainer_rank():
+    spec = dict(SPEC, roles={**SPEC["roles"],
+                             "dataloader": {"replicas": 2,
+                                            "entry": "send.py"}})
+    manifests = gen_manifests(spec)
+    nn = next(m for m in manifests
+              if m["metadata"]["name"] == "testjob-nnworker-0")
+    env = {e["name"]: e["value"] for e in nn["spec"]["containers"][0]["env"]}
+    assert env["RANK"] == "0" and env["WORLD_SIZE"] == "1"
+    assert env["PERSIA_NUM_WORKERS"] == "1"
+    assert env["PERSIA_NUM_DATALOADERS"] == "2"

@@ -122,3 +122,25 @@ def test_rpc_request_dedup_at_most_once():
             assert r3 == b"2" and len(calls) == 2
     finally:
         server.stop()
+
+
+def test_dataflow_receiver_waits_for_all_senders_eos():
+    """With N data-loader replicas, the stream must end only after all N
+    report end-of-stream (a fast loader's EOS must not cut off slower
+    ones)."""
+    from persia_tpu.service.dataflow import DataflowReceiver
+
+    r = DataflowReceiver(num_senders=2)
+    try:
+        r._eos(b"")
+        import queue as _q
+
+        try:
+            r._q.get(timeout=0.2)
+            raise AssertionError("stream ended after only one EOS")
+        except _q.Empty:
+            pass
+        r._eos(b"")
+        assert r.get(timeout=2) is None  # now the stream ends
+    finally:
+        r.close()
