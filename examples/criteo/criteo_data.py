@@ -74,11 +74,19 @@ def criteo_batches(
     batch_size: int = 4096,
     max_samples: Optional[int] = None,
     requires_grad: bool = True,
+    replica_index: int = 0,
+    replica_size: int = 1,
 ) -> Iterator[PersiaBatch]:
-    """Stream PersiaBatches from a Criteo tsv(.gz) file."""
+    """Stream PersiaBatches from a Criteo tsv(.gz) file.
+
+    ``replica_index/replica_size`` shard the stream by whole batches of
+    lines BEFORE parsing, so N loader replicas split both the data and
+    the parse/hash cost (filtering built batches afterwards would make
+    every replica pay the full transform cost for 1/N of the output)."""
     labels, dense_rows, cat_rows = [], [], []
     batch_id = 0
     produced = 0
+    line_idx = 0
 
     def flush():
         nonlocal labels, dense_rows, cat_rows, batch_id
@@ -101,6 +109,13 @@ def criteo_batches(
 
     with _open(path) as f:
         for line in f:
+            if max_samples is not None and line_idx >= max_samples:
+                break
+            owned = ((line_idx // batch_size) % replica_size
+                     == replica_index)
+            line_idx += 1
+            if not owned:
+                continue  # another replica's batch: skip before parsing
             parts = line.rstrip("\n").split("\t")
             if len(parts) != 1 + NUM_DENSE + NUM_SLOTS:
                 continue  # malformed line
@@ -111,8 +126,6 @@ def criteo_batches(
             produced += 1                           # per batch in flush()
             if len(labels) == batch_size:
                 yield flush()
-            if max_samples is not None and produced >= max_samples:
-                break
     if labels:
         yield flush()
 

@@ -59,11 +59,10 @@ def main():
                 args.num_workers, len(trainers), replica_index,
                 replica_size)
     if args.train:
-        batches = (
-            b for b in criteo_batches(args.train, args.batch_size,
-                                      max_samples=args.samples)
-            if b.batch_id % replica_size == replica_index
-        )
+        batches = criteo_batches(args.train, args.batch_size,
+                                 max_samples=args.samples,
+                                 replica_index=replica_index,
+                                 replica_size=replica_size)
     else:
         logger.warning("no --train file; streaming synthetic batches")
         batches = synthetic_batches(args.samples // replica_size,

@@ -114,7 +114,10 @@ class DataflowClient:
         trainer = self._trainers[
             (batch.batch_id or 0) % len(self._trainers)
         ]
-        trainer.call("enqueue_batch", payload)
+        # dedup id: a blind retry after an ambiguous connection death
+        # would deliver (and train on) the batch twice, double-consuming
+        # its forward-buffer ref on the embedding worker
+        trainer.call("enqueue_batch", payload, dedup=True)
 
     def send_eos(self):
         # dedup id: an ambiguous connection death would otherwise re-send

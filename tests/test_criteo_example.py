@@ -89,3 +89,24 @@ def test_non_hex_tokens_do_not_crash(tmp_path):
     signs = np.stack([f.signs for f in b.id_type_features], axis=1)
     assert signs.shape == (1, NUM_SLOTS)
     assert (signs != 0).all()  # every present token got a sign
+
+
+def test_replica_sharding_splits_stream_without_overlap(tmp_path):
+    path = tmp_path / "t.tsv"
+    write_synthetic_tsv(str(path), 400, seed=3)
+    full = [b for b in criteo_batches(str(path), 64)]
+    r0 = list(criteo_batches(str(path), 64, replica_index=0,
+                             replica_size=2))
+    r1 = list(criteo_batches(str(path), 64, replica_index=1,
+                             replica_size=2))
+    n_full = sum(len(b.labels[0].data) for b in full)
+    n0 = sum(len(b.labels[0].data) for b in r0)
+    n1 = sum(len(b.labels[0].data) for b in r1)
+    assert n0 + n1 == n_full == 400
+    # no overlap: sign streams are disjoint slices of the full stream
+    s_full = np.concatenate([b.id_type_features[0].signs for b in full])
+    s0 = np.concatenate([b.id_type_features[0].signs for b in r0])
+    s1 = np.concatenate([b.id_type_features[0].signs for b in r1])
+    assert len(s0) + len(s1) == len(s_full)
+    np.testing.assert_array_equal(np.sort(np.concatenate([s0, s1])),
+                                  np.sort(s_full))
