@@ -360,6 +360,15 @@ def main():
         args.batch_size, args.steps, args.warmup = 256, 3, 1
 
     if args.mode not in ("wire", "worker"):  # host-only modes skip jax
+        import os
+
+        forced = os.environ.get("PERSIA_FORCE_JAX_PLATFORM")
+        if forced:  # local verification escape hatch (nn_worker.py honors
+            # the same variable); the driver runs without it and probes
+            # the real accelerator
+            import jax
+
+            jax.config.update("jax_platforms", forced)
         preflight_backend(metric, unit,
                           timeout=max(args.max_seconds // 4, 90))
 

@@ -336,5 +336,58 @@ inline void encode_map_header(std::string& out, size_t n) {
   }
 }
 
+inline void encode_bin(std::string& out, const std::string& b) {
+  if (b.size() <= 0xff) {
+    out.push_back(static_cast<char>(0xc4));
+    write_be(out, b.size(), 1);
+  } else if (b.size() <= 0xffff) {
+    out.push_back(static_cast<char>(0xc5));
+    write_be(out, b.size(), 2);
+  } else {
+    out.push_back(static_cast<char>(0xc6));
+    write_be(out, b.size(), 4);
+  }
+  out += b;
+}
+
+// Re-encode a decoded Value (payload passthrough: e.g. the worker
+// forwarding an optimizer config map to every PS with one key added).
+inline void encode_value(std::string& out, const Value& v) {
+  switch (v.kind) {
+    case Value::kNil:
+      encode_nil(out);
+      break;
+    case Value::kBool:
+      encode_bool(out, v.b);
+      break;
+    case Value::kInt:
+      encode_int(out, v.i);
+      break;
+    case Value::kUInt:
+      encode_uint(out, v.u);
+      break;
+    case Value::kFloat:
+      encode_double(out, v.f);
+      break;
+    case Value::kStr:
+      encode_str(out, v.s);
+      break;
+    case Value::kBin:
+      encode_bin(out, v.s);
+      break;
+    case Value::kArray:
+      encode_array_header(out, v.arr.size());
+      for (const auto& e : v.arr) encode_value(out, e);
+      break;
+    case Value::kMap:
+      encode_map_header(out, v.map.size());
+      for (const auto& kv : v.map) {
+        encode_str(out, kv.first);
+        encode_value(out, kv.second);
+      }
+      break;
+  }
+}
+
 }  // namespace msgpack
 }  // namespace persia

@@ -9,13 +9,20 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
+#include <time.h>
 #include <unistd.h>
 #include <zstd.h>
 
+#include <algorithm>
+#include <atomic>
 #include <cstdint>
 #include <cstring>
+#include <list>
+#include <mutex>
 #include <stdexcept>
 #include <string>
+#include <unordered_map>
+#include <vector>
 
 #include "msgpack_lite.h"
 
@@ -203,29 +210,274 @@ inline void unpack_arrays(const std::string& payload, msgpack::Value* meta,
   }
 }
 
+// General builder for the pack_arrays layout: arbitrary meta map +
+// any number of typed buffers (the multi-array responses the worker
+// tier emits: raw-slot lookups are [f32 matrix, i32 matrix, i32 vec]).
+struct ArraysBuilder {
+  std::string meta;        // msgpack map body (caller encodes pairs)
+  size_t meta_pairs = 0;
+  std::string heads;       // msgpack array elements [[dtype, shape], ...]
+  size_t n_arrays = 0;
+  std::string bufs;
+
+  void meta_str(const std::string& key, const std::string& val) {
+    msgpack::encode_str(meta, key);
+    msgpack::encode_str(meta, val);
+    ++meta_pairs;
+  }
+  void meta_int(const std::string& key, int64_t val) {
+    msgpack::encode_str(meta, key);
+    msgpack::encode_int(meta, val);
+    ++meta_pairs;
+  }
+  void meta_strs(const std::string& key,
+                 const std::vector<std::string>& vals) {
+    msgpack::encode_str(meta, key);
+    msgpack::encode_array_header(meta, vals.size());
+    for (const auto& s : vals) msgpack::encode_str(meta, s);
+    ++meta_pairs;
+  }
+  void meta_value(const std::string& key, const msgpack::Value& v) {
+    msgpack::encode_str(meta, key);
+    msgpack::encode_value(meta, v);
+    ++meta_pairs;
+  }
+
+  void add(const std::string& dtype, const std::vector<int64_t>& shape,
+           const void* data, size_t nbytes) {
+    msgpack::encode_array_header(heads, 2);
+    msgpack::encode_str(heads, dtype);
+    msgpack::encode_array_header(heads, shape.size());
+    for (int64_t d : shape) msgpack::encode_int(heads, d);
+    ++n_arrays;
+    bufs.append(reinterpret_cast<const char*>(data), nbytes);
+  }
+  void add_f32(const std::vector<int64_t>& shape, const float* data) {
+    size_t n = 1;
+    for (int64_t d : shape) n *= static_cast<size_t>(d);
+    add("float32", shape, data, n * 4);
+  }
+  void add_i32(const std::vector<int64_t>& shape, const int32_t* data) {
+    size_t n = 1;
+    for (int64_t d : shape) n *= static_cast<size_t>(d);
+    add("int32", shape, data, n * 4);
+  }
+  void add_u64(const std::vector<int64_t>& shape, const uint64_t* data) {
+    size_t n = 1;
+    for (int64_t d : shape) n *= static_cast<size_t>(d);
+    add("uint64", shape, data, n * 8);
+  }
+
+  std::string finish() const {
+    std::string head;
+    msgpack::encode_map_header(head, 2);
+    msgpack::encode_str(head, "m");
+    msgpack::encode_map_header(head, meta_pairs);
+    head += meta;
+    msgpack::encode_str(head, "a");
+    msgpack::encode_array_header(head, n_arrays);
+    head += heads;
+    std::string out(4, '\0');
+    uint32_t head_len = static_cast<uint32_t>(head.size());
+    std::memcpy(out.data(), &head_len, 4);
+    out += head;
+    out += bufs;
+    return out;
+  }
+};
+
 // Pack a single f32 matrix result (the PS lookup response shape).
 inline std::string pack_f32_array(const float* data, int64_t rows,
                                   int64_t cols) {
-  std::string head;
-  msgpack::encode_map_header(head, 2);
-  msgpack::encode_str(head, "m");
-  msgpack::encode_map_header(head, 0);
-  msgpack::encode_str(head, "a");
-  msgpack::encode_array_header(head, 1);
-  msgpack::encode_array_header(head, 2);
-  msgpack::encode_str(head, "float32");
-  msgpack::encode_array_header(head, 2);
-  msgpack::encode_int(head, rows);
-  msgpack::encode_int(head, cols);
-  std::string out;
-  uint32_t head_len = static_cast<uint32_t>(head.size());
-  out.resize(4);
-  std::memcpy(out.data(), &head_len, 4);
-  out += head;
-  out.append(reinterpret_cast<const char*>(data),
-             sizeof(float) * static_cast<size_t>(rows * cols));
-  return out;
+  ArraysBuilder b;
+  b.add_f32({rows, cols}, data);
+  return b.finish();
 }
+
+// ---- at-most-once dedup (rpc.py RpcServer's request-id LRU) -------------
+// Requests carrying a request id (envelope [method, id, len]) execute at
+// most once; retried deliveries get the cached response.
+
+class DedupCache {
+ public:
+  // Bounded by entry count AND total response bytes (lookup responses
+  // can be megabytes; 8192 of those would not be a cache, it would be
+  // a leak).
+  explicit DedupCache(size_t cap = 8192, size_t max_bytes = 256u << 20)
+      : cap_(cap), max_bytes_(max_bytes) {}
+
+  // Returns true and fills *resp if the id was already served.
+  bool lookup(const std::string& id, std::string* resp) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = index_.find(id);
+    if (it == index_.end()) return false;
+    *resp = it->second->second;
+    return true;
+  }
+
+  void store(const std::string& id, const std::string& resp) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (index_.count(id)) return;
+    order_.emplace_back(id, resp);
+    index_[id] = std::prev(order_.end());
+    bytes_ += resp.size();
+    while (order_.size() > cap_ || (bytes_ > max_bytes_ && order_.size() > 1)) {
+      bytes_ -= order_.front().second.size();
+      index_.erase(order_.front().first);
+      order_.pop_front();
+    }
+  }
+
+ private:
+  size_t cap_, max_bytes_, bytes_ = 0;
+  std::mutex mu_;
+  std::list<std::pair<std::string, std::string>> order_;
+  std::unordered_map<std::string,
+                     std::list<std::pair<std::string, std::string>>::iterator>
+      index_;
+};
+
+// ---- retrying client channel (rpc.py RpcClient semantics) ---------------
+// A pool of connections to one address. acquire()/release() let
+// concurrent fan-out threads share warm sockets without thread_local
+// churn; call() retries transient connection failures with backoff and
+// attaches a random request id when dedup is requested, so retries of
+// non-idempotent methods stay at-most-once server-side.
+
+class RpcChannel {
+ public:
+  explicit RpcChannel(const std::string& addr, int max_retries = 5,
+                      double backoff = 0.2)
+      : max_retries_(max_retries), backoff_(backoff) {
+    size_t colon = addr.rfind(':');
+    if (colon == std::string::npos)
+      throw std::runtime_error("bad address " + addr);
+    host_ = addr.substr(0, colon);
+    port_ = std::atoi(addr.c_str() + colon + 1);
+    addr_ = addr;
+  }
+
+  ~RpcChannel() {
+    for (int fd : pool_) ::close(fd);
+  }
+
+  const std::string& addr() const { return addr_; }
+
+  std::string call(const std::string& method, const std::string& payload,
+                   bool dedup = false) {
+    std::string env_base;
+    std::string req_id;
+    if (dedup) {
+      req_id = random_id();
+      msgpack::encode_array_header(env_base, 3);
+      msgpack::encode_str(env_base, method);
+      msgpack::encode_bin(env_base, req_id);
+    } else {
+      msgpack::encode_array_header(env_base, 2);
+      msgpack::encode_str(env_base, method);
+    }
+    msgpack::encode_uint(env_base, payload.size());
+
+    double delay = backoff_;
+    int attempts_left = max_retries_;
+    for (;;) {
+      bool fresh = false;
+      int fd = acquire(&fresh, &attempts_left, &delay);
+      try {
+        send_msg(fd, env_base, payload, true);
+        Message resp;
+        if (!recv_msg(fd, &resp)) throw std::runtime_error("closed");
+        release(fd);
+        if (resp.env.arr.empty() || resp.env.arr[0].as_str() != "ok")
+          throw RpcAppError(
+              addr_ + " " + method + ": " +
+              (resp.env.arr.size() > 1 ? resp.env.arr[1].as_str() : "?"));
+        return resp.payload;
+      } catch (const RpcAppError&) {
+        throw;  // application error: never retry
+      } catch (const std::exception&) {
+        ::close(fd);
+        if (!fresh) continue;  // stale pooled socket: redial, no sleep
+        if (attempts_left <= 0) throw;
+        --attempts_left;
+        sleep_s(delay);
+        delay = std::min(delay * 2, 5.0);
+      }
+    }
+  }
+
+  struct RpcAppError : std::runtime_error {
+    using std::runtime_error::runtime_error;
+  };
+
+ private:
+  int acquire(bool* fresh, int* attempts_left, double* delay) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (!pool_.empty()) {
+        int fd = pool_.back();
+        pool_.pop_back();
+        *fresh = false;
+        return fd;
+      }
+    }
+    *fresh = true;
+    for (;;) {
+      try {
+        return dial(host_, port_);
+      } catch (const std::exception&) {
+        if (*attempts_left <= 0) throw;
+        --*attempts_left;
+        sleep_s(*delay);
+        *delay = std::min(*delay * 2, 5.0);
+      }
+    }
+  }
+
+  void release(int fd) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (pool_.size() < 16) {
+      pool_.push_back(fd);
+    } else {
+      ::close(fd);
+    }
+  }
+
+  static void sleep_s(double s) {
+    struct timespec ts;
+    ts.tv_sec = static_cast<time_t>(s);
+    ts.tv_nsec = static_cast<long>((s - static_cast<double>(ts.tv_sec)) * 1e9);
+    ::nanosleep(&ts, nullptr);
+  }
+
+  static std::string random_id() {
+    static std::atomic<uint64_t> counter{0};
+    uint64_t a = splitmix_seed() + counter.fetch_add(1);
+    uint64_t x = a * 0x9E3779B97F4A7C15ULL;
+    x ^= x >> 30;
+    x *= 0xBF58476D1CE4E5B9ULL;
+    std::string id(12, '\0');
+    std::memcpy(id.data(), &x, 8);
+    uint32_t lo = static_cast<uint32_t>(a);
+    std::memcpy(id.data() + 8, &lo, 4);
+    return id;
+  }
+
+  static uint64_t splitmix_seed() {
+    struct timespec ts;
+    ::clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (static_cast<uint64_t>(ts.tv_sec) << 32) ^
+           static_cast<uint64_t>(ts.tv_nsec) ^
+           (static_cast<uint64_t>(::getpid()) << 17);
+  }
+
+  std::string host_, addr_;
+  int port_;
+  int max_retries_;
+  double backoff_;
+  std::mutex mu_;
+  std::vector<int> pool_;
+};
 
 }  // namespace net
 }  // namespace persia

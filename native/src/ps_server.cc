@@ -269,6 +269,8 @@ class PsServer {
   std::mutex status_mu_;
 };
 
+net::DedupCache g_dedup;
+
 void serve_conn(PsServer* server, int fd) {
   net::Message msg;
   for (;;) {
@@ -277,15 +279,29 @@ void serve_conn(PsServer* server, int fd) {
     } catch (const std::exception&) {
       break;
     }
-    const std::string method = msg.env.arr.at(0).as_str();
-    if (method == "__shutdown__") {
-      net::send_ok(fd, "");
-      g_running = false;
-      // exit the whole process like RpcServer.stop + shutdown_cb
-      std::exit(0);
-    }
     try {
-      std::string result = server->dispatch(method, msg.payload);
+      // extraction inside the try: a malformed (non-array) envelope must
+      // answer an error, not escape the thread and terminate the process
+      const std::string method = msg.env.arr.at(0).as_str();
+      if (method == "__shutdown__") {
+        net::send_ok(fd, "");
+        g_running = false;
+        // exit the whole process like RpcServer.stop + shutdown_cb
+        std::exit(0);
+      }
+      // envelope [method, req_id, len] => at-most-once execution, matching
+      // rpc.py RpcServer's request-id LRU (clients attach ids on
+      // non-idempotent methods like update_gradients)
+      const std::string* req_id = nullptr;
+      if (msg.env.arr.size() >= 3 &&
+          (msg.env.arr[1].kind == mp::Value::kBin ||
+           msg.env.arr[1].kind == mp::Value::kStr))
+        req_id = &msg.env.arr[1].s;
+      std::string result;
+      if (req_id == nullptr || !g_dedup.lookup(*req_id, &result)) {
+        result = server->dispatch(method, msg.payload);
+        if (req_id != nullptr) g_dedup.store(*req_id, result);
+      }
       net::send_ok(fd, result);
     } catch (const std::exception& e) {
       try {

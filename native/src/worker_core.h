@@ -1,0 +1,521 @@
+// Embedding-worker core: schema + the per-batch middleware pipeline.
+//
+// C++ twin of persia_tpu/worker/middleware.py (itself a re-design of the
+// reference's embedding worker brain, embedding_worker_service/
+// mod.rs:341-872). The Python module stays the source of truth for the
+// algorithm; every transform here matches it bit-for-bit (same
+// accumulation order, same f32 rounding) so a trainer can point at the
+// Python worker tier or this native tier interchangeably —
+// tests/test_native_worker.py asserts byte parity over the wire.
+//
+// Hot loops come from mw_kernels.h; this header adds the orchestration
+// the Python side does in numpy: CSR truncation, hashstack rounds,
+// index-prefix namespacing, (shard, dim) grouping, postprocess to
+// model-ready tensors, and the gradient transpose of all of it.
+#pragma once
+
+#include <cmath>
+#include <cstdint>
+#include <map>
+#include <set>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "hashrng.h"
+#include "msgpack_lite.h"
+#include "mw_kernels.h"
+
+namespace persia {
+namespace worker {
+
+// ---- schema (persia_tpu/config.py EmbeddingSchema) ----------------------
+
+struct HashStackConfig {
+  int rounds = 0;
+  int64_t table_size = 0;
+};
+
+struct SlotConfig {
+  int32_t dim = 0;
+  int32_t sample_fixed_size = 10;
+  bool summation = true;
+  bool sqrt_scaling = false;
+  HashStackConfig hash_stack;
+  uint64_t index_prefix = 0;
+};
+
+struct Schema {
+  std::map<std::string, SlotConfig> slots;
+  int prefix_bit = 0;
+  // sorted, like Python's sorted(feature_groups.items())
+  std::map<std::string, std::vector<std::string>> groups;
+
+  uint64_t feature_spacing() const {
+    if (prefix_bit > 0) return (1ULL << (64 - prefix_bit)) - 1;
+    return ~0ULL;
+  }
+
+  const SlotConfig& slot(const std::string& name) const {
+    auto it = slots.find(name);
+    if (it == slots.end())
+      throw std::runtime_error("feature '" + name +
+                               "' not in embedding schema");
+    return it->second;
+  }
+
+  // Mirrors EmbeddingSchema._assign_index_prefixes (config.py:115-166):
+  // every slot lands in exactly one feature group; groups are numbered
+  // from 1 in sorted-name order and own the top `prefix_bit` bits.
+  void assign_prefixes() {
+    if (prefix_bit <= 0) return;
+    if (prefix_bit >= 64)
+      throw std::runtime_error("feature_index_prefix_bit must be < 64");
+    std::map<std::string, std::string> seen;  // slot -> group
+    for (const auto& g : groups) {
+      for (const auto& s : g.second) {
+        if (seen.count(s))
+          throw std::runtime_error("slot '" + s +
+                                   "' listed in more than one feature group");
+        seen[s] = g.first;
+      }
+    }
+    for (const auto& kv : slots) {
+      if (!seen.count(kv.first)) {
+        if (groups.count(kv.first))
+          throw std::runtime_error(
+              "ungrouped slot '" + kv.first +
+              "' has the same name as a feature group");
+        groups[kv.first] = {kv.first};
+      }
+    }
+    int shift = 64 - prefix_bit;
+    uint64_t group_index = 0;
+    for (const auto& g : groups) {
+      ++group_index;
+      if (group_index >= (1ULL << prefix_bit))
+        throw std::runtime_error("too many feature groups for prefix bit");
+      uint64_t prefix = group_index << shift;
+      for (const auto& slot_name : g.second) {
+        auto it = slots.find(slot_name);
+        if (it == slots.end())
+          throw std::runtime_error("feature group references unknown slot " +
+                                   slot_name);
+        if (it->second.index_prefix != 0)
+          throw std::runtime_error("slot '" + slot_name +
+                                   "' already has index_prefix set");
+        it->second.index_prefix = prefix;
+      }
+    }
+  }
+
+  // Build from a parsed YAML document (config.py EmbeddingSchema.from_dict).
+  static Schema from_doc(const msgpack::Value& raw) {
+    Schema sc;
+    auto num = [](const msgpack::Value* v, int64_t dflt) {
+      return v ? v->as_int() : dflt;
+    };
+    if (const msgpack::Value* b = raw.get("feature_index_prefix_bit"))
+      sc.prefix_bit = static_cast<int>(b->as_int());
+    if (const msgpack::Value* sl = raw.get("slots_config")) {
+      for (const auto& kv : sl->map) {
+        SlotConfig s;
+        s.dim = static_cast<int32_t>(kv.second.at("dim").as_int());
+        s.sample_fixed_size = static_cast<int32_t>(
+            num(kv.second.get("sample_fixed_size"), 10));
+        if (const msgpack::Value* v = kv.second.get("embedding_summation"))
+          s.summation = v->as_bool();
+        if (const msgpack::Value* v = kv.second.get("sqrt_scaling"))
+          s.sqrt_scaling = v->as_bool();
+        if (const msgpack::Value* hs = kv.second.get("hash_stack_config")) {
+          if (!hs->is_nil()) {
+            s.hash_stack.rounds =
+                static_cast<int>(num(hs->get("hash_stack_rounds"), 0));
+            s.hash_stack.table_size = num(hs->get("embedding_size"), 0);
+          }
+        }
+        sc.slots[kv.first] = s;
+      }
+    }
+    if (const msgpack::Value* fg = raw.get("feature_groups")) {
+      if (!fg->is_nil()) {
+        for (const auto& kv : fg->map) {
+          std::vector<std::string> members;
+          for (const auto& m : kv.second.arr) members.push_back(m.as_str());
+          sc.groups[kv.first] = std::move(members);
+        }
+      }
+    }
+    sc.assign_prefixes();
+    return sc;
+  }
+};
+
+// ---- per-batch feature state (middleware.py DedupedFeature) -------------
+
+struct DedupedFeature {
+  std::string name;
+  int32_t batch_size = 0;
+  std::vector<uint64_t> distinct;
+  std::vector<int32_t> elem_sample;
+  std::vector<int32_t> elem_col;
+  std::vector<int32_t> elem_distinct;
+  std::vector<int32_t> sample_num_signs;
+  std::vector<int32_t> raw_row_of_distinct;  // empty = identity
+  int32_t hash_stack_rounds = 0;
+
+  int64_t num_distinct() const {
+    return static_cast<int64_t>(distinct.size());
+  }
+};
+
+// One ID feature as it arrives on the wire: CSR offsets + signs.
+struct WireFeature {
+  std::string name;
+  std::vector<int64_t> offsets;  // (bs+1)
+  std::vector<uint64_t> signs;   // (nnz)
+};
+
+// Keep only the first `sfs` ids of each sample
+// (middleware.py truncate_to_sample_fixed_size).
+inline void truncate_sfs(WireFeature* f, int32_t sfs) {
+  int64_t bs = static_cast<int64_t>(f->offsets.size()) - 1;
+  bool needed = false;
+  for (int64_t s = 0; s < bs; ++s)
+    if (f->offsets[s + 1] - f->offsets[s] > sfs) {
+      needed = true;
+      break;
+    }
+  if (!needed) return;
+  std::vector<int64_t> new_offsets(bs + 1, 0);
+  std::vector<uint64_t> new_signs;
+  new_signs.reserve(f->signs.size());
+  for (int64_t s = 0; s < bs; ++s) {
+    int64_t count = std::min<int64_t>(f->offsets[s + 1] - f->offsets[s], sfs);
+    for (int64_t k = 0; k < count; ++k)
+      new_signs.push_back(f->signs[f->offsets[s] + k]);
+    new_offsets[s + 1] = new_offsets[s] + count;
+  }
+  f->offsets = std::move(new_offsets);
+  f->signs = std::move(new_signs);
+}
+
+// CSR -> distinct signs + back-pointers (middleware.py dedup_feature).
+inline DedupedFeature dedup_feature(const WireFeature& f) {
+  DedupedFeature d;
+  d.name = f.name;
+  d.batch_size = static_cast<int32_t>(f.offsets.size()) - 1;
+  int64_t nnz = static_cast<int64_t>(f.signs.size());
+  d.elem_sample.resize(nnz);
+  d.elem_col.resize(nnz);
+  d.sample_num_signs.resize(d.batch_size);
+  for (int32_t s = 0; s < d.batch_size; ++s) {
+    int64_t a = f.offsets[s], b = f.offsets[s + 1];
+    d.sample_num_signs[s] = static_cast<int32_t>(b - a);
+    for (int64_t e = a; e < b; ++e) {
+      d.elem_sample[e] = s;
+      d.elem_col[e] = static_cast<int32_t>(e - a);
+    }
+  }
+  d.distinct.resize(nnz);
+  d.elem_distinct.resize(nnz);
+  int64_t nd = mw_dedup(f.signs.data(), nnz, d.distinct.data(),
+                        d.elem_distinct.data());
+  d.distinct.resize(nd);
+  return d;
+}
+
+// Multi-round hash compression (middleware.py apply_hashstack): each sign
+// becomes `rounds` bucket signs in a table of rounds*table_size rows.
+inline void apply_hashstack(DedupedFeature* feat, int rounds,
+                            int64_t table_size) {
+  if (rounds <= 0) return;
+  int64_t d = feat->num_distinct();
+  int64_t nnz = static_cast<int64_t>(feat->elem_distinct.size());
+  // buckets laid out (d, rounds) row-major like the numpy array
+  std::vector<uint64_t> buckets(static_cast<size_t>(d) * rounds);
+  std::vector<uint64_t> h = feat->distinct;
+  for (int r = 0; r < rounds; ++r) {
+    for (int64_t i = 0; i < d; ++i) {
+      h[i] = farmhash64(h[i]);
+      buckets[i * rounds + r] =
+          h[i] % static_cast<uint64_t>(table_size) +
+          static_cast<uint64_t>(r) * static_cast<uint64_t>(table_size);
+    }
+  }
+  std::vector<uint64_t> new_distinct(buckets.size());
+  std::vector<int32_t> bucket_of(buckets.size());
+  int64_t nd = mw_dedup(buckets.data(),
+                        static_cast<int64_t>(buckets.size()),
+                        new_distinct.data(), bucket_of.data());
+  new_distinct.resize(nd);
+  // raw-row mapping: every bucket contributes to its original sign's row;
+  // row-major write order matches numpy's raw_row[bucket_of.ravel()] = ...
+  std::vector<int32_t> raw_row(nd, 0);
+  for (int64_t i = 0; i < d; ++i)
+    for (int r = 0; r < rounds; ++r)
+      raw_row[bucket_of[i * rounds + r]] = static_cast<int32_t>(i);
+
+  std::vector<int32_t> elem_sample, elem_col, elem_distinct;
+  elem_sample.reserve(nnz * rounds);
+  elem_col.reserve(nnz * rounds);
+  elem_distinct.reserve(nnz * rounds);
+  for (int64_t e = 0; e < nnz; ++e) {
+    int64_t od = feat->elem_distinct[e];
+    for (int r = 0; r < rounds; ++r) {
+      elem_sample.push_back(feat->elem_sample[e]);
+      elem_col.push_back(feat->elem_col[e]);
+      elem_distinct.push_back(bucket_of[od * rounds + r]);
+    }
+  }
+  feat->distinct = std::move(new_distinct);
+  feat->elem_sample = std::move(elem_sample);
+  feat->elem_col = std::move(elem_col);
+  feat->elem_distinct = std::move(elem_distinct);
+  for (auto& c : feat->sample_num_signs) c *= rounds;
+  feat->raw_row_of_distinct = std::move(raw_row);
+  feat->hash_stack_rounds = rounds;
+}
+
+// Namespace signs under the slot's feature-group prefix
+// (middleware.py apply_index_prefix; u64 wraparound intended).
+inline void apply_prefix(DedupedFeature* feat, const SlotConfig& slot,
+                         uint64_t spacing) {
+  if (slot.index_prefix == 0) return;
+  for (auto& s : feat->distinct) s = s % spacing + slot.index_prefix;
+}
+
+// dedup -> hashstack -> prefix for every feature of a batch
+// (middleware.py preprocess_batch).
+inline std::vector<DedupedFeature> preprocess_batch(
+    std::vector<WireFeature>& wire, const Schema& schema) {
+  std::vector<DedupedFeature> feats;
+  feats.reserve(wire.size());
+  for (auto& f : wire) {
+    const SlotConfig& slot = schema.slot(f.name);
+    if (!slot.summation) truncate_sfs(&f, slot.sample_fixed_size);
+    DedupedFeature d = dedup_feature(f);
+    apply_hashstack(&d, slot.hash_stack.rounds, slot.hash_stack.table_size);
+    apply_prefix(&d, slot, schema.feature_spacing());
+    feats.push_back(std::move(d));
+  }
+  return feats;
+}
+
+// ---- (shard, dim) grouping (middleware.py ShardGroup/shard_split) -------
+
+struct ShardGroup {
+  int32_t shard = 0;
+  int32_t dim = 0;
+  std::vector<uint64_t> signs;
+  std::vector<int32_t> feature_idx;
+  std::vector<int32_t> distinct_idx;
+};
+
+inline std::vector<ShardGroup> shard_split(
+    const std::vector<DedupedFeature>& feats, const Schema& schema,
+    uint32_t replica_size) {
+  // groups keyed (shard, dim), parts appended in feature order — the
+  // same construction (and therefore the same sign order on the wire)
+  // as middleware.py's native path
+  std::map<std::pair<int32_t, int32_t>, ShardGroup> by_key;
+  std::vector<int32_t> order;
+  std::vector<uint32_t> starts(replica_size + 1);
+  for (size_t fi = 0; fi < feats.size(); ++fi) {
+    const DedupedFeature& feat = feats[fi];
+    int32_t dim = schema.slot(feat.name).dim;
+    int64_t n = feat.num_distinct();
+    order.resize(n);
+    mw_shard_order(feat.distinct.data(), n, replica_size, order.data(),
+                   starts.data());
+    for (uint32_t shard = 0; shard < replica_size; ++shard) {
+      uint32_t a = starts[shard], b = starts[shard + 1];
+      if (a >= b) continue;
+      ShardGroup& g = by_key[{static_cast<int32_t>(shard), dim}];
+      g.shard = static_cast<int32_t>(shard);
+      g.dim = dim;
+      for (uint32_t k = a; k < b; ++k) {
+        g.signs.push_back(feat.distinct[order[k]]);
+        g.feature_idx.push_back(static_cast<int32_t>(fi));
+        g.distinct_idx.push_back(order[k]);
+      }
+    }
+  }
+  std::vector<ShardGroup> groups;
+  groups.reserve(by_key.size());
+  for (auto& kv : by_key) groups.push_back(std::move(kv.second));
+  return groups;
+}
+
+// Contiguous (start, end, fi) runs of a group's feature_idx
+// (middleware.py _feature_runs).
+template <typename Fn>
+inline void feature_runs(const std::vector<int32_t>& feature_idx, Fn fn) {
+  size_t n = feature_idx.size();
+  size_t a = 0;
+  while (a < n) {
+    size_t b = a + 1;
+    while (b < n && feature_idx[b] == feature_idx[a]) ++b;
+    fn(a, b, feature_idx[a]);
+    a = b;
+  }
+}
+
+// Assemble per-feature (num_distinct, dim) embedding matrices from the
+// per-shard lookup results (middleware.py scatter_lookup_results).
+inline std::vector<std::vector<float>> scatter_lookup_results(
+    const std::vector<DedupedFeature>& feats, const Schema& schema,
+    const std::vector<ShardGroup>& groups,
+    const std::vector<std::vector<float>>& results) {
+  std::vector<std::vector<float>> mats(feats.size());
+  for (size_t fi = 0; fi < feats.size(); ++fi)
+    mats[fi].assign(static_cast<size_t>(feats[fi].num_distinct()) *
+                        schema.slot(feats[fi].name).dim,
+                    0.0f);
+  for (size_t gi = 0; gi < groups.size(); ++gi) {
+    const ShardGroup& g = groups[gi];
+    const std::vector<float>& res = results[gi];
+    feature_runs(g.feature_idx, [&](size_t a, size_t b, int32_t fi) {
+      mw_scatter_rows(mats[fi].data(), g.distinct_idx.data() + a,
+                      static_cast<int64_t>(b - a), g.dim,
+                      res.data() + a * g.dim);
+    });
+  }
+  return mats;
+}
+
+// ---- postprocess (middleware.py postprocess_feature) --------------------
+
+struct SumEmbedding {
+  std::vector<float> embeddings;  // (bs, dim)
+};
+
+struct RawEmbedding {
+  std::vector<float> embeddings;       // (bs*sfs + 1, dim), row 0 zeros
+  std::vector<int32_t> index;          // (bs, sfs), 0 = padding
+  std::vector<int32_t> sample_id_num;  // (bs,)
+};
+
+struct FeatureResult {
+  bool is_sum = true;
+  SumEmbedding sum;
+  RawEmbedding raw;
+};
+
+inline std::vector<float> sqrt_scale_vec(
+    const std::vector<int32_t>& counts) {
+  std::vector<float> scale(counts.size());
+  for (size_t i = 0; i < counts.size(); ++i)
+    scale[i] = 1.0f / std::sqrt(
+        static_cast<float>(std::max(counts[i], 1)));
+  return scale;
+}
+
+inline FeatureResult postprocess_feature(const DedupedFeature& feat,
+                                         const SlotConfig& slot,
+                                         const std::vector<float>& emb) {
+  FeatureResult out;
+  int32_t bs = feat.batch_size;
+  int32_t dim = slot.dim;
+  if (slot.summation) {
+    out.is_sum = true;
+    out.sum.embeddings.resize(static_cast<size_t>(bs) * dim);
+    std::vector<float> scale;
+    if (slot.sqrt_scaling) scale = sqrt_scale_vec(feat.sample_num_signs);
+    mw_sum_post(emb.data(), feat.elem_distinct.data(),
+                feat.sample_num_signs.data(), bs, dim,
+                slot.sqrt_scaling ? scale.data() : nullptr,
+                out.sum.embeddings.data());
+    return out;
+  }
+  out.is_sum = false;
+  int32_t sfs = slot.sample_fixed_size;
+  int64_t capacity = static_cast<int64_t>(bs) * sfs + 1;
+  RawEmbedding& raw = out.raw;
+  raw.embeddings.assign(static_cast<size_t>(capacity) * dim, 0.0f);
+  int64_t d = feat.num_distinct();
+  std::vector<int32_t> rows_p1(d);
+  const bool has_raw = !feat.raw_row_of_distinct.empty();
+  for (int64_t i = 0; i < d; ++i)
+    rows_p1[i] =
+        (has_raw ? feat.raw_row_of_distinct[i] : static_cast<int32_t>(i)) + 1;
+  mw_scatter_add_rows(raw.embeddings.data(), rows_p1.data(), d, dim,
+                      emb.data());
+  if (slot.sqrt_scaling && feat.hash_stack_rounds > 1) {
+    float factor = static_cast<float>(
+        1.0 / std::sqrt(static_cast<double>(feat.hash_stack_rounds)));
+    for (auto& v : raw.embeddings) v *= factor;
+  }
+  raw.index.assign(static_cast<size_t>(bs) * sfs, 0);
+  int64_t nnz = static_cast<int64_t>(feat.elem_distinct.size());
+  for (int64_t e = 0; e < nnz; ++e) {
+    if (feat.elem_col[e] >= sfs) continue;
+    raw.index[static_cast<size_t>(feat.elem_sample[e]) * sfs +
+              feat.elem_col[e]] = rows_p1[feat.elem_distinct[e]];
+  }
+  raw.sample_id_num.resize(bs);
+  for (int32_t s = 0; s < bs; ++s)
+    raw.sample_id_num[s] = std::min(feat.sample_num_signs[s], sfs);
+  return out;
+}
+
+// ---- gradient transpose (middleware.py aggregate_gradients) -------------
+
+// Model gradients -> per-distinct-sign gradients. `grad` is (bs, dim) for
+// summed slots, (capacity, dim) for raw slots.
+inline std::vector<float> aggregate_gradients(const DedupedFeature& feat,
+                                              const SlotConfig& slot,
+                                              const float* grad,
+                                              float loss_scale) {
+  int32_t dim = slot.dim;
+  int64_t d = feat.num_distinct();
+  std::vector<float> out(static_cast<size_t>(d) * dim);
+  float inv_ls =
+      loss_scale != 1.0f
+          ? static_cast<float>(1.0 / static_cast<double>(loss_scale))
+          : 1.0f;
+  if (slot.summation) {
+    std::vector<float> scale;
+    if (slot.sqrt_scaling) scale = sqrt_scale_vec(feat.sample_num_signs);
+    mw_sum_grad(grad, feat.elem_sample.data(), feat.elem_distinct.data(),
+                static_cast<int64_t>(feat.elem_distinct.size()), d, dim,
+                inv_ls, slot.sqrt_scaling ? scale.data() : nullptr,
+                out.data());
+    return out;
+  }
+  std::vector<int32_t> rows_p1(d);
+  const bool has_raw = !feat.raw_row_of_distinct.empty();
+  for (int64_t i = 0; i < d; ++i)
+    rows_p1[i] =
+        (has_raw ? feat.raw_row_of_distinct[i] : static_cast<int32_t>(i)) + 1;
+  mw_gather_rows(grad, rows_p1.data(), d, dim, inv_ls, true, out.data());
+  if (slot.sqrt_scaling && feat.hash_stack_rounds > 1) {
+    float factor = static_cast<float>(
+        1.0 / std::sqrt(static_cast<double>(feat.hash_stack_rounds)));
+    for (auto& v : out) v *= factor;
+  }
+  return out;
+}
+
+// Per-sign gradients grouped by the forward split's (shard, dim) groups
+// (middleware.py shard_gradients with cached groups). Returns, per
+// group, the (m, dim) gradient matrix matching group.signs order.
+inline std::vector<std::vector<float>> shard_gradients(
+    const std::vector<ShardGroup>& groups,
+    const std::vector<std::vector<float>>& per_feature_grads) {
+  std::vector<std::vector<float>> out;
+  out.reserve(groups.size());
+  for (const ShardGroup& g : groups) {
+    std::vector<float> grads(g.signs.size() * static_cast<size_t>(g.dim));
+    feature_runs(g.feature_idx, [&](size_t a, size_t b, int32_t fi) {
+      mw_gather_rows(per_feature_grads[fi].data(), g.distinct_idx.data() + a,
+                     static_cast<int64_t>(b - a), g.dim, 1.0f, false,
+                     grads.data() + a * g.dim);
+    });
+    out.push_back(std::move(grads));
+  }
+  return out;
+}
+
+}  // namespace worker
+}  // namespace persia

@@ -1,0 +1,843 @@
+// persia-embedding-worker: native embedding-worker service binary.
+//
+// The C++ twin of persia_tpu/service/worker_service.py (reference:
+// src/bin/persia-embedding-worker.rs:40-137 + the RPC surface of
+// embedding_worker_service/mod.rs:1372-1561): speaks the framework RPC
+// protocol over TCP (thread per connection), runs the middleware
+// pipeline (worker_core.h) and the PS fan-out fully native — no Python
+// anywhere between the trainer's socket and the parameter servers —
+// and registers itself with the coordinator.
+//
+// This is the tier the reference compiles to a binary because it fans
+// out to every PS replica per batch; serving it from Python threads
+// GIL-serializes the framing/memcpy on the hottest host-side path.
+//
+// Usage: persia-embedding-worker --embedding-config schema.yml
+//        [--port 0] [--coordinator host:port --num-ps N |
+//         --ps-addrs a:1,b:2] [--replica-index 0]
+#include <getopt.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <memory>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "net.h"
+#include "worker_core.h"
+#include "yaml_lite.h"
+
+namespace w = persia::worker;
+namespace mp = persia::msgpack;
+namespace net = persia::net;
+
+namespace {
+
+double now_s() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+// ---- PS client over the retrying channel --------------------------------
+
+class PsClient {
+ public:
+  explicit PsClient(const std::string& addr) : chan_(addr) {}
+
+  std::vector<float> lookup(const std::vector<uint64_t>& signs, int32_t dim,
+                            bool training) {
+    net::ArraysBuilder b;
+    b.meta_int("dim", dim);
+    mp::encode_str(b.meta, "training");
+    mp::encode_bool(b.meta, training);
+    ++b.meta_pairs;
+    b.add_u64({static_cast<int64_t>(signs.size())}, signs.data());
+    // lookup creates entries server-side in training mode, but replayed
+    // creation is idempotent (deterministic per-sign init), so no dedup id
+    std::string resp = chan_.call("lookup", b.finish());
+    mp::Value meta;
+    std::vector<net::ArrayRef> arrays;
+    net::unpack_arrays(resp, &meta, &arrays);
+    const net::ArrayRef& a = arrays.at(0);
+    std::vector<float> out(a.nbytes / 4);
+    std::memcpy(out.data(), a.data, a.nbytes);
+    return out;
+  }
+
+  void update_gradients(const std::vector<uint64_t>& signs,
+                        const std::vector<float>& grads, int32_t dim) {
+    net::ArraysBuilder b;
+    b.meta_int("dim", dim);
+    b.add_u64({static_cast<int64_t>(signs.size())}, signs.data());
+    b.add_f32({static_cast<int64_t>(signs.size()), dim}, grads.data());
+    // non-idempotent: dedup id makes the retry at-most-once server-side
+    chan_.call("update_gradients", b.finish(), /*dedup=*/true);
+  }
+
+  // Control-plane passthrough: the worker's configure payload is exactly
+  // the PS's configure payload (worker_service.py fans out the same way).
+  void forward(const std::string& method, const std::string& payload) {
+    chan_.call(method, payload);
+  }
+
+  std::string call_map(const std::string& method, const std::string& body,
+                       size_t pairs) {
+    std::string payload;
+    mp::encode_map_header(payload, pairs);
+    payload += body;
+    return chan_.call(method, payload);
+  }
+
+  std::string status() {
+    std::string resp = chan_.call("status", "");
+    return mp::decode_all(resp).at("status").as_str();
+  }
+
+  const std::string& addr() const { return chan_.addr(); }
+
+ private:
+  net::RpcChannel chan_;
+};
+
+// ---- worker state (worker.py EmbeddingWorker) ---------------------------
+
+struct BufferFull : std::runtime_error {
+  using std::runtime_error::runtime_error;
+};
+
+class Worker {
+ public:
+  Worker(w::Schema schema, std::vector<std::string> ps_addrs,
+         int64_t forward_buffer_size, double buffered_data_expired_sec)
+      : schema_(std::move(schema)),
+        forward_buffer_size_(forward_buffer_size),
+        expired_sec_(buffered_data_expired_sec) {
+    for (const auto& a : ps_addrs) ps_.emplace_back(new PsClient(a));
+    if (ps_.empty())
+      throw std::runtime_error("worker needs at least one PS address");
+  }
+
+  const w::Schema& schema() const { return schema_; }
+  size_t num_ps() const { return ps_.size(); }
+  PsClient& ps(size_t i) { return *ps_[i]; }
+
+  int64_t put_batch(std::vector<w::WireFeature>& wire) {
+    expire_stale();
+    int64_t ref_id;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (static_cast<int64_t>(forward_buffer_.size()) >=
+          forward_buffer_size_)
+        throw BufferFull("forward buffer full (" +
+                         std::to_string(forward_buffer_size_) + ")");
+      ref_id = next_ref_id_++;
+    }
+    std::vector<w::DedupedFeature> feats =
+        w::preprocess_batch(wire, schema_);
+    std::lock_guard<std::mutex> lk(mu_);
+    forward_buffer_[ref_id] = {std::move(feats), now_s()};
+    return ref_id;
+  }
+
+  // Shard fan-out: one thread per (shard, dim) group when multiple PS
+  // replicas exist (the reference joins all per-shard RPC futures,
+  // mod.rs:448-484); with remote replicas the threads overlap network
+  // wait even on a single core.
+  std::vector<std::vector<float>> fan_out_lookup(
+      const std::vector<w::ShardGroup>& groups, bool training) {
+    std::vector<std::vector<float>> results(groups.size());
+    if (groups.size() <= 1 || ps_.size() == 1) {
+      for (size_t i = 0; i < groups.size(); ++i)
+        results[i] =
+            ps_[groups[i].shard]->lookup(groups[i].signs, groups[i].dim,
+                                         training);
+      return results;
+    }
+    std::vector<std::thread> threads;
+    std::vector<std::exception_ptr> errs(groups.size());
+    for (size_t i = 0; i < groups.size(); ++i)
+      threads.emplace_back([&, i] {
+        try {
+          results[i] = ps_[groups[i].shard]->lookup(
+              groups[i].signs, groups[i].dim, training);
+        } catch (...) {
+          errs[i] = std::current_exception();
+        }
+      });
+    for (auto& t : threads) t.join();
+    for (auto& e : errs)
+      if (e) std::rethrow_exception(e);
+    return results;
+  }
+
+  struct LookupOut {
+    std::vector<std::string> names;
+    std::vector<w::FeatureResult> results;
+  };
+
+  LookupOut lookup_feats(const std::vector<w::DedupedFeature>& feats,
+                         bool training,
+                         std::vector<w::ShardGroup>* groups_out) {
+    std::vector<w::ShardGroup> groups =
+        w::shard_split(feats, schema_, static_cast<uint32_t>(ps_.size()));
+    std::vector<std::vector<float>> results =
+        fan_out_lookup(groups, training);
+    std::vector<std::vector<float>> mats =
+        w::scatter_lookup_results(feats, schema_, groups, results);
+    LookupOut out;
+    for (size_t i = 0; i < feats.size(); ++i) {
+      out.names.push_back(feats[i].name);
+      out.results.push_back(w::postprocess_feature(
+          feats[i], schema_.slot(feats[i].name), mats[i]));
+    }
+    if (groups_out != nullptr) *groups_out = std::move(groups);
+    return out;
+  }
+
+  LookupOut lookup(int64_t ref_id, bool training) {
+    std::vector<w::DedupedFeature> feats;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      auto it = forward_buffer_.find(ref_id);
+      if (it == forward_buffer_.end())
+        throw std::runtime_error("ref_id " + std::to_string(ref_id) +
+                                 " not in forward buffer");
+      feats = std::move(it->second.feats);
+      forward_buffer_.erase(it);
+    }
+    std::vector<w::ShardGroup> groups;
+    LookupOut out = lookup_feats(feats, training, &groups);
+    if (training) {
+      std::lock_guard<std::mutex> lk(mu_);
+      post_forward_buffer_[ref_id] = {std::move(feats), std::move(groups),
+                                      now_s()};
+      ++staleness_;
+    }
+    return out;
+  }
+
+  void update_gradients(int64_t ref_id,
+                        const std::vector<std::string>& grad_names,
+                        const std::vector<net::ArrayRef>& grad_arrays,
+                        float loss_scale) {
+    PostEntry entry;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      auto it = post_forward_buffer_.find(ref_id);
+      if (it == post_forward_buffer_.end())
+        throw std::runtime_error("ref_id " + std::to_string(ref_id) +
+                                 " not in post-forward buffer");
+      entry = std::move(it->second);
+      post_forward_buffer_.erase(it);
+      --staleness_;
+    }
+    // per-feature aggregation in feats order, like worker.py
+    std::vector<std::vector<float>> per_feature(entry.feats.size());
+    for (size_t i = 0; i < entry.feats.size(); ++i) {
+      const w::DedupedFeature& feat = entry.feats[i];
+      const w::SlotConfig& slot = schema_.slot(feat.name);
+      const net::ArrayRef* grad = nullptr;
+      for (size_t k = 0; k < grad_names.size() && k < grad_arrays.size();
+           ++k)
+        if (grad_names[k] == feat.name) {
+          grad = &grad_arrays.at(k);
+          break;
+        }
+      if (grad == nullptr)
+        throw std::runtime_error("missing gradient for feature '" +
+                                 feat.name + "'");
+      // shape check before the raw-pointer kernels: (bs, dim) for summed
+      // slots, (bs*sfs + 1, dim) for raw slots
+      size_t expect_rows =
+          slot.summation
+              ? static_cast<size_t>(feat.batch_size)
+              : static_cast<size_t>(feat.batch_size) *
+                        slot.sample_fixed_size + 1;
+      if (grad->nbytes != expect_rows * slot.dim * 4)
+        throw std::runtime_error(
+            "gradient for feature '" + feat.name + "' has " +
+            std::to_string(grad->nbytes) + " bytes, expected " +
+            std::to_string(expect_rows * slot.dim * 4));
+      per_feature[i] = w::aggregate_gradients(
+          feat, slot, reinterpret_cast<const float*>(grad->data),
+          loss_scale);
+    }
+    std::vector<std::vector<float>> sharded =
+        w::shard_gradients(entry.groups, per_feature);
+    if (entry.groups.size() <= 1 || ps_.size() == 1) {
+      for (size_t i = 0; i < entry.groups.size(); ++i)
+        ps_[entry.groups[i].shard]->update_gradients(
+            entry.groups[i].signs, sharded[i], entry.groups[i].dim);
+      return;
+    }
+    std::vector<std::thread> threads;
+    std::vector<std::exception_ptr> errs(entry.groups.size());
+    for (size_t i = 0; i < entry.groups.size(); ++i)
+      threads.emplace_back([&, i] {
+        try {
+          ps_[entry.groups[i].shard]->update_gradients(
+              entry.groups[i].signs, sharded[i], entry.groups[i].dim);
+        } catch (...) {
+          errs[i] = std::current_exception();
+        }
+      });
+    for (auto& t : threads) t.join();
+    for (auto& e : errs)
+      if (e) std::rethrow_exception(e);
+  }
+
+  int64_t staleness() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return staleness_;
+  }
+
+  // Expiry of stale pending batches (worker.py _expire_stale,
+  // reference mod.rs:991-1029).
+  void expire_stale() {
+    double horizon = now_s() - expired_sec_;
+    std::lock_guard<std::mutex> lk(mu_);
+    for (auto it = forward_buffer_.begin(); it != forward_buffer_.end();) {
+      if (it->second.enter_time < horizon)
+        it = forward_buffer_.erase(it);
+      else
+        ++it;
+    }
+    for (auto it = post_forward_buffer_.begin();
+         it != post_forward_buffer_.end();) {
+      if (it->second.enter_time < horizon)
+        it = post_forward_buffer_.erase(it);
+      else
+        ++it;
+    }
+  }
+
+ private:
+  struct ForwardEntry {
+    std::vector<w::DedupedFeature> feats;
+    double enter_time;
+  };
+  struct PostEntry {
+    std::vector<w::DedupedFeature> feats;
+    std::vector<w::ShardGroup> groups;
+    double enter_time = 0;
+  };
+
+  w::Schema schema_;
+  std::vector<std::unique_ptr<PsClient>> ps_;
+  int64_t forward_buffer_size_;
+  double expired_sec_;
+  std::mutex mu_;
+  int64_t next_ref_id_ = 1;
+  int64_t staleness_ = 0;
+  std::unordered_map<int64_t, ForwardEntry> forward_buffer_;
+  std::unordered_map<int64_t, PostEntry> post_forward_buffer_;
+};
+
+// ---- wire parsing -------------------------------------------------------
+
+std::vector<w::WireFeature> parse_id_features(
+    const mp::Value& meta, const std::vector<net::ArrayRef>& arrays) {
+  const mp::Value& names = meta.at("names");
+  std::vector<w::WireFeature> wire;
+  wire.reserve(names.arr.size());
+  for (size_t i = 0; i < names.arr.size(); ++i) {
+    const net::ArrayRef& off = arrays.at(2 * i);
+    const net::ArrayRef& sg = arrays.at(2 * i + 1);
+    w::WireFeature f;
+    f.name = names.arr[i].as_str();
+    size_t n_off = off.nbytes / net::dtype_size(off.dtype);
+    f.offsets.resize(n_off);
+    if (off.dtype == "uint32") {
+      const uint32_t* p = reinterpret_cast<const uint32_t*>(off.data);
+      for (size_t k = 0; k < n_off; ++k) f.offsets[k] = p[k];
+    } else if (off.dtype == "int32") {
+      const int32_t* p = reinterpret_cast<const int32_t*>(off.data);
+      for (size_t k = 0; k < n_off; ++k) f.offsets[k] = p[k];
+    } else if (off.dtype == "int64" || off.dtype == "uint64") {
+      const int64_t* p = reinterpret_cast<const int64_t*>(off.data);
+      for (size_t k = 0; k < n_off; ++k) f.offsets[k] = p[k];
+    } else {
+      throw std::runtime_error("unsupported offsets dtype " + off.dtype);
+    }
+    if (sg.dtype != "uint64")
+      throw std::runtime_error("signs must be uint64, got " + sg.dtype);
+    f.signs.resize(sg.nbytes / 8);
+    std::memcpy(f.signs.data(), sg.data, sg.nbytes);
+    wire.push_back(std::move(f));
+  }
+  return wire;
+}
+
+std::string pack_lookup_result(const Worker::LookupOut& out,
+                               const w::Schema& schema, int32_t bs_hint) {
+  (void)bs_hint;
+  net::ArraysBuilder b;
+  std::vector<std::string> kinds;
+  for (const auto& r : out.results)
+    kinds.push_back(r.is_sum ? "sum" : "raw");
+  b.meta_strs("names", out.names);
+  b.meta_strs("kinds", kinds);
+  for (size_t i = 0; i < out.results.size(); ++i) {
+    const w::FeatureResult& r = out.results[i];
+    const w::SlotConfig& slot = schema.slot(out.names[i]);
+    if (r.is_sum) {
+      int64_t bs = static_cast<int64_t>(r.sum.embeddings.size()) / slot.dim;
+      b.add_f32({bs, slot.dim}, r.sum.embeddings.data());
+    } else {
+      int64_t cap = static_cast<int64_t>(r.raw.embeddings.size()) / slot.dim;
+      int64_t bs = static_cast<int64_t>(r.raw.sample_id_num.size());
+      b.add_f32({cap, slot.dim}, r.raw.embeddings.data());
+      b.add_i32({bs, slot.sample_fixed_size}, r.raw.index.data());
+      b.add_i32({bs}, r.raw.sample_id_num.data());
+    }
+  }
+  return b.finish();
+}
+
+// ---- service ------------------------------------------------------------
+
+std::atomic<bool> g_running{true};
+
+class WorkerServer {
+ public:
+  explicit WorkerServer(Worker* worker) : worker_(worker) {}
+
+  std::string dispatch(const std::string& method,
+                       const std::string& payload) {
+    if (method == "forward_batched") return do_forward_batched(payload);
+    if (method == "forward_batch_id") return do_forward_batch_id(payload);
+    if (method == "forward_batched_direct")
+      return do_forward_direct(payload);
+    if (method == "update_gradients") return do_update(payload);
+    if (method == "configure") return do_fanout_passthrough("configure", payload);
+    if (method == "register_optimizer") return do_register_optimizer(payload);
+    if (method == "dump") return do_dump(payload);
+    if (method == "load") return do_load(payload);
+    if (method == "staleness") return do_staleness();
+    if (method == "ready") {
+      std::string out;
+      mp::encode_map_header(out, 1);
+      mp::encode_str(out, "ready");
+      mp::encode_bool(out, true);
+      return out;
+    }
+    throw std::runtime_error("no such method " + method);
+  }
+
+  net::DedupCache dedup;
+
+ private:
+  std::string do_forward_batched(const std::string& payload) {
+    mp::Value meta;
+    std::vector<net::ArrayRef> arrays;
+    net::unpack_arrays(payload, &meta, &arrays);
+    std::vector<w::WireFeature> wire = parse_id_features(meta, arrays);
+    int64_t ref_id = worker_->put_batch(wire);
+    std::string out;
+    mp::encode_map_header(out, 1);
+    mp::encode_str(out, "ref_id");
+    mp::encode_int(out, ref_id);
+    return out;
+  }
+
+  std::string do_forward_batch_id(const std::string& payload) {
+    mp::Value req = mp::decode_all(payload);
+    Worker::LookupOut out = worker_->lookup(
+        req.at("ref_id").as_int(), req.at("training").as_bool());
+    return pack_lookup_result(out, worker_->schema(), 0);
+  }
+
+  std::string do_forward_direct(const std::string& payload) {
+    mp::Value meta;
+    std::vector<net::ArrayRef> arrays;
+    net::unpack_arrays(payload, &meta, &arrays);
+    std::vector<w::WireFeature> wire = parse_id_features(meta, arrays);
+    bool training = false;
+    if (const mp::Value* t = meta.get("training")) training = t->as_bool();
+    std::vector<w::DedupedFeature> feats =
+        w::preprocess_batch(wire, worker_->schema());
+    Worker::LookupOut out = worker_->lookup_feats(feats, training, nullptr);
+    return pack_lookup_result(out, worker_->schema(), 0);
+  }
+
+  std::string do_update(const std::string& payload) {
+    mp::Value meta;
+    std::vector<net::ArrayRef> arrays;
+    net::unpack_arrays(payload, &meta, &arrays);
+    float loss_scale = 1.0f;
+    if (const mp::Value* ls = meta.get("loss_scale"))
+      loss_scale = static_cast<float>(ls->as_double());
+    std::vector<std::string> names;
+    for (const auto& n : meta.at("names").arr) names.push_back(n.as_str());
+    for (const auto& a : arrays)
+      if (a.dtype != "float32")
+        throw std::runtime_error("gradients must be float32, got " + a.dtype);
+    worker_->update_gradients(meta.at("ref_id").as_int(), names, arrays,
+                              loss_scale);
+    return "";
+  }
+
+  // configure fans out the SAME payload to every PS
+  // (worker_service.py _configure -> PsClient.configure round trip).
+  std::string do_fanout_passthrough(const std::string& method,
+                                    const std::string& payload) {
+    for (size_t i = 0; i < worker_->num_ps(); ++i)
+      worker_->ps(i).forward(method, payload);
+    return "";
+  }
+
+  // register_optimizer adds the schema's feature_index_prefix_bit before
+  // forwarding (worker.py register_optimizer).
+  std::string do_register_optimizer(const std::string& payload) {
+    mp::Value req = mp::decode_all(payload);
+    std::string fwd;
+    mp::encode_map_header(fwd, 2);
+    mp::encode_str(fwd, "config");
+    mp::encode_value(fwd, req.at("config"));
+    mp::encode_str(fwd, "feature_index_prefix_bit");
+    mp::encode_int(fwd, worker_->schema().prefix_bit);
+    for (size_t i = 0; i < worker_->num_ps(); ++i)
+      worker_->ps(i).forward("register_optimizer", fwd);
+    return "";
+  }
+
+  // Fan out a dump to every PS replica, then write the done marker
+  // (checkpoint.py dump_sharded; local paths only in the native tier —
+  // hdfs:// staging stays with the Python services).
+  std::string do_dump(const std::string& payload) {
+    mp::Value req = mp::decode_all(payload);
+    const std::string& dir = req.at("path").as_str();
+    if (dir.rfind("hdfs://", 0) == 0)
+      throw std::runtime_error(
+          "native worker dumps to local paths only; use the Python worker "
+          "tier for hdfs:// checkpoints");
+    std::string marker = dir + "/embedding_dump_done";
+    std::remove(marker.c_str());
+    for (size_t i = 0; i < worker_->num_ps(); ++i) {
+      std::string body;
+      mp::encode_str(body, "path");
+      mp::encode_str(body, dir + "/replica_" + std::to_string(i) + ".psd");
+      worker_->ps(i).call_map("dump", body, 1);
+    }
+    wait_for_idle();
+    std::ofstream f(marker);
+    if (!f) throw std::runtime_error("cannot write done marker " + marker);
+    f << "{\"num_shards\": " << worker_->num_ps() << "}";
+    return "";
+  }
+
+  std::string do_load(const std::string& payload) {
+    mp::Value req = mp::decode_all(payload);
+    const std::string& dir = req.at("path").as_str();
+    std::ifstream f(dir + "/embedding_dump_done");
+    if (!f)
+      throw std::runtime_error(dir +
+                               " has no embedding_dump_done; incomplete or "
+                               "missing dump");
+    std::ostringstream os;
+    os << f.rdbuf();
+    int64_t num_shards = parse_num_shards(os.str());
+    if (num_shards != static_cast<int64_t>(worker_->num_ps()))
+      throw std::runtime_error(
+          "checkpoint has " + std::to_string(num_shards) +
+          " shards but cluster has " + std::to_string(worker_->num_ps()) +
+          " PS; resharding loads go through the Python worker tier");
+    for (size_t i = 0; i < worker_->num_ps(); ++i) {
+      std::string body;
+      mp::encode_str(body, "path");
+      mp::encode_str(body, dir + "/replica_" + std::to_string(i) + ".psd");
+      worker_->ps(i).call_map("load", body, 1);
+    }
+    wait_for_idle();
+    return "";
+  }
+
+  void wait_for_idle(double timeout = 600.0) {
+    double deadline = now_s() + timeout;
+    for (size_t i = 0; i < worker_->num_ps(); ++i) {
+      for (;;) {
+        std::string st = worker_->ps(i).status();
+        if (st == "Idle") break;
+        if (st.rfind("Failed", 0) == 0)
+          throw std::runtime_error("PS " + std::to_string(i) + ": " + st);
+        if (now_s() > deadline)
+          throw std::runtime_error("timed out waiting for PS to go Idle");
+        std::this_thread::sleep_for(std::chrono::milliseconds(100));
+      }
+    }
+  }
+
+  static int64_t parse_num_shards(const std::string& json) {
+    size_t pos = json.find("\"num_shards\"");
+    if (pos == std::string::npos)
+      throw std::runtime_error("done marker missing num_shards");
+    pos = json.find(':', pos);
+    if (pos == std::string::npos)
+      throw std::runtime_error("bad done marker");
+    return std::strtoll(json.c_str() + pos + 1, nullptr, 10);
+  }
+
+  std::string do_staleness() {
+    std::string out;
+    mp::encode_map_header(out, 1);
+    mp::encode_str(out, "staleness");
+    mp::encode_int(out, worker_->staleness());
+    return out;
+  }
+
+  Worker* worker_;
+};
+
+void serve_conn(WorkerServer* server, int fd) {
+  net::Message msg;
+  for (;;) {
+    try {
+      if (!net::recv_msg(fd, &msg)) break;
+    } catch (const std::exception&) {
+      break;
+    }
+    try {
+      // extraction inside the try: a malformed (non-array) envelope must
+      // answer an error, not escape the thread and terminate the process
+      const std::string method = msg.env.arr.at(0).as_str();
+      if (method == "__shutdown__") {
+        net::send_ok(fd, "");
+        g_running = false;
+        std::exit(0);
+      }
+      // envelope [method, req_id, len] => at-most-once execution
+      const std::string* req_id = nullptr;
+      if (msg.env.arr.size() >= 3 &&
+          (msg.env.arr[1].kind == mp::Value::kBin ||
+           msg.env.arr[1].kind == mp::Value::kStr))
+        req_id = &msg.env.arr[1].s;
+      std::string result;
+      if (req_id == nullptr || !server->dedup.lookup(*req_id, &result)) {
+        result = server->dispatch(method, msg.payload);
+        if (req_id != nullptr) server->dedup.store(*req_id, result);
+      }
+      net::send_ok(fd, result);
+    } catch (const BufferFull& e) {
+      // the data-loader backpressure contract matches on this name
+      // (dataflow.py:100, reference ForwardBufferFull)
+      try {
+        net::send_err(fd, std::string("ForwardBufferFull: ") + e.what());
+      } catch (const std::exception&) {
+        break;
+      }
+    } catch (const std::exception& e) {
+      try {
+        net::send_err(fd, std::string("WorkerError: ") + e.what());
+      } catch (const std::exception&) {
+        break;
+      }
+    }
+  }
+  ::close(fd);
+}
+
+void register_with_coordinator(const std::string& coordinator,
+                               const std::string& my_addr,
+                               int replica_index) {
+  net::RpcChannel chan(coordinator);
+  std::string payload;
+  mp::encode_map_header(payload, 3);
+  mp::encode_str(payload, "role");
+  mp::encode_str(payload, "embedding-worker");
+  mp::encode_str(payload, "replica_index");
+  mp::encode_int(payload, replica_index);
+  mp::encode_str(payload, "addr");
+  mp::encode_str(payload, my_addr);
+  chan.call("register", payload);
+}
+
+// Poll the coordinator until `count` PS replicas registered
+// (coordinator.py wait_members).
+std::vector<std::string> wait_ps_members(const std::string& coordinator,
+                                         int count, double timeout) {
+  net::RpcChannel chan(coordinator);
+  std::string payload;
+  mp::encode_map_header(payload, 1);
+  mp::encode_str(payload, "role");
+  mp::encode_str(payload, "embedding-parameter-server");
+  double deadline = now_s() + timeout;
+  double delay = 0.05;
+  for (;;) {
+    std::string resp = chan.call("list", payload);
+    mp::Value v = mp::decode_all(resp);
+    std::vector<std::string> addrs;
+    for (const auto& a : v.at("addrs").arr) addrs.push_back(a.as_str());
+    if (static_cast<int>(addrs.size()) >= count) return addrs;
+    if (now_s() > deadline)
+      throw std::runtime_error("timed out waiting for " +
+                               std::to_string(count) + " PS replicas");
+    std::this_thread::sleep_for(
+        std::chrono::milliseconds(static_cast<int>(delay * 1000)));
+    delay = std::min(delay * 2, 1.0);
+  }
+}
+
+void dump_schema(const w::Schema& sc) {
+  // resolved-schema dump for the Python parity test
+  std::printf("{\"feature_index_prefix_bit\": %d, \"slots\": {", sc.prefix_bit);
+  bool first = true;
+  for (const auto& kv : sc.slots) {
+    if (!first) std::printf(", ");
+    first = false;
+    std::printf(
+        "\"%s\": {\"dim\": %d, \"sample_fixed_size\": %d, "
+        "\"embedding_summation\": %s, \"sqrt_scaling\": %s, "
+        "\"hash_stack_rounds\": %d, \"embedding_size\": %lld, "
+        "\"index_prefix\": %llu}",
+        kv.first.c_str(), kv.second.dim, kv.second.sample_fixed_size,
+        kv.second.summation ? "true" : "false",
+        kv.second.sqrt_scaling ? "true" : "false", kv.second.hash_stack.rounds,
+        static_cast<long long>(kv.second.hash_stack.table_size),
+        static_cast<unsigned long long>(kv.second.index_prefix));
+  }
+  std::printf("}}\n");
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string host = "127.0.0.1";
+  int port = 0;
+  int replica_index = 0;
+  std::string coordinator;
+  std::string embedding_config;
+  std::string ps_addrs_csv;
+  int num_ps = 1;
+  int64_t forward_buffer_size = 1000;
+  double expired_sec = 1800;
+  bool do_dump_schema = false;
+  if (const char* env = std::getenv("REPLICA_INDEX"))
+    replica_index = std::atoi(env);
+  if (const char* env = std::getenv("PERSIA_COORDINATOR_ADDR"))
+    coordinator = env;
+  if (const char* env = std::getenv("PERSIA_NUM_PS"))
+    num_ps = std::atoi(env);
+
+  static option longopts[] = {
+      {"host", required_argument, nullptr, 'h'},
+      {"port", required_argument, nullptr, 'p'},
+      {"replica-index", required_argument, nullptr, 'r'},
+      {"coordinator", required_argument, nullptr, 'o'},
+      {"embedding-config", required_argument, nullptr, 'e'},
+      {"ps-addrs", required_argument, nullptr, 'a'},
+      {"num-ps", required_argument, nullptr, 'n'},
+      {"forward-buffer-size", required_argument, nullptr, 'b'},
+      {"buffered-data-expired-sec", required_argument, nullptr, 'x'},
+      {"dump-schema", no_argument, nullptr, 'd'},
+      {nullptr, 0, nullptr, 0},
+  };
+  int opt;
+  while ((opt = getopt_long(argc, argv, "", longopts, nullptr)) != -1) {
+    switch (opt) {
+      case 'h': host = optarg; break;
+      case 'p': port = std::atoi(optarg); break;
+      case 'r': replica_index = std::atoi(optarg); break;
+      case 'o': coordinator = optarg; break;
+      case 'e': embedding_config = optarg; break;
+      case 'a': ps_addrs_csv = optarg; break;
+      case 'n': num_ps = std::atoi(optarg); break;
+      case 'b': forward_buffer_size = std::atoll(optarg); break;
+      case 'x': expired_sec = std::atof(optarg); break;
+      case 'd': do_dump_schema = true; break;
+      default:
+        std::fprintf(stderr, "unknown option\n");
+        return 2;
+    }
+  }
+  if (embedding_config.empty()) {
+    std::fprintf(stderr, "--embedding-config is required\n");
+    return 2;
+  }
+
+  w::Schema schema;
+  try {
+    schema = w::Schema::from_doc(persia::yaml::parse_file(embedding_config));
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "failed to load embedding config: %s\n", e.what());
+    return 1;
+  }
+  if (do_dump_schema) {
+    dump_schema(schema);
+    return 0;
+  }
+
+  std::vector<std::string> ps_addrs;
+  try {
+    if (!ps_addrs_csv.empty()) {
+      std::istringstream is(ps_addrs_csv);
+      std::string part;
+      while (std::getline(is, part, ',')) ps_addrs.push_back(part);
+    } else if (!coordinator.empty()) {
+      ps_addrs = wait_ps_members(coordinator, num_ps, 120.0);
+    } else {
+      std::fprintf(stderr, "need --ps-addrs or --coordinator\n");
+      return 2;
+    }
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "PS discovery failed: %s\n", e.what());
+    return 1;
+  }
+
+  Worker worker(std::move(schema), ps_addrs, forward_buffer_size,
+                expired_sec);
+  WorkerServer server(&worker);
+
+  int listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  ::setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  ::inet_pton(AF_INET, host.c_str(), &addr.sin_addr);
+  if (::bind(listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) !=
+      0) {
+    std::perror("bind");
+    return 1;
+  }
+  ::listen(listen_fd, 128);
+  socklen_t alen = sizeof(addr);
+  ::getsockname(listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  std::string my_addr = host + ":" + std::to_string(ntohs(addr.sin_port));
+  std::fprintf(stderr, "persia-embedding-worker %d listening on %s (%zu PS)\n",
+               replica_index, my_addr.c_str(), ps_addrs.size());
+
+  if (!coordinator.empty()) {
+    try {
+      register_with_coordinator(coordinator, my_addr, replica_index);
+    } catch (const std::exception& e) {
+      std::fprintf(stderr, "coordinator registration failed: %s\n", e.what());
+      return 1;
+    }
+  }
+
+  // periodic expiry sweep (the Python worker piggybacks on put_batch;
+  // a native thread keeps semantics when ingestion stalls)
+  std::thread([&worker] {
+    while (g_running) {
+      std::this_thread::sleep_for(std::chrono::seconds(30));
+      worker.expire_stale();
+    }
+  }).detach();
+
+  while (g_running) {
+    int conn = ::accept(listen_fd, nullptr, nullptr);
+    if (conn < 0) continue;
+    ::setsockopt(conn, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    std::thread(serve_conn, &server, conn).detach();
+  }
+  return 0;
+}

@@ -1,0 +1,306 @@
+// Minimal YAML parser for the persia_tpu config files (the subset
+// PyYAML's safe_dump emits and the repo's hand-written schema/global
+// configs use): block maps, block lists, flow {} / [], plain and quoted
+// scalars, full-line comments. Errors loudly on anything else. Parses
+// into the shared msgpack::Value tree so config code has ONE generic
+// document type.
+//
+// The reference reads these files with serde-yaml in Rust
+// (rust/persia-embedding-config/src/lib.rs:459-475); this is the
+// native-worker-binary equivalent so the C++ tier needs no Python to
+// boot from the same YAML files.
+#pragma once
+
+#include <cctype>
+#include <cstdlib>
+#include <fstream>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "msgpack_lite.h"
+
+namespace persia {
+namespace yaml {
+
+using msgpack::Value;
+
+struct Line {
+  int indent;
+  std::string text;  // content after indentation, comments stripped
+};
+
+inline bool is_blank_or_comment(const std::string& s) {
+  for (char c : s) {
+    if (c == '#') return true;
+    if (!std::isspace(static_cast<unsigned char>(c))) return false;
+  }
+  return true;
+}
+
+inline std::vector<Line> split_lines(const std::string& doc) {
+  std::vector<Line> out;
+  std::istringstream is(doc);
+  std::string raw;
+  while (std::getline(is, raw)) {
+    if (!raw.empty() && raw.back() == '\r') raw.pop_back();
+    if (is_blank_or_comment(raw)) continue;
+    if (raw == "---") continue;  // document start marker
+    int indent = 0;
+    while (indent < static_cast<int>(raw.size()) && raw[indent] == ' ')
+      ++indent;
+    if (indent < static_cast<int>(raw.size()) && raw[indent] == '\t')
+      throw std::runtime_error("yaml: tabs not allowed for indentation");
+    std::string text = raw.substr(indent);
+    while (!text.empty() && std::isspace(static_cast<unsigned char>(text.back())))
+      text.pop_back();
+    out.push_back({indent, text});
+  }
+  return out;
+}
+
+inline Value parse_scalar(const std::string& tok);
+inline bool split_key(const std::string& text, std::string* key,
+                      std::string* rest);
+
+// Flow collections: {k: v, ...} and [a, b, ...], one nesting level of
+// scalars (the shapes the repo's configs use, e.g. `C1: {dim: 16}`).
+inline Value parse_flow(const std::string& tok) {
+  Value v;
+  bool is_map = tok.front() == '{';
+  v.kind = is_map ? Value::kMap : Value::kArray;
+  std::string body = tok.substr(1, tok.size() - 2);
+  // split on top-level commas (no nested flow collections supported)
+  std::vector<std::string> items;
+  std::string cur;
+  int depth = 0;
+  for (char c : body) {
+    if (c == '{' || c == '[') ++depth;
+    if (c == '}' || c == ']') --depth;
+    if (c == ',' && depth == 0) {
+      items.push_back(cur);
+      cur.clear();
+    } else {
+      cur.push_back(c);
+    }
+  }
+  if (!cur.empty()) items.push_back(cur);
+  auto strip = [](std::string s) {
+    while (!s.empty() && s.front() == ' ') s.erase(0, 1);
+    while (!s.empty() && s.back() == ' ') s.pop_back();
+    return s;
+  };
+  for (auto& raw : items) {
+    std::string item = strip(raw);
+    if (item.empty()) continue;
+    if (is_map) {
+      std::string key, rest;
+      if (!split_key(item, &key, &rest))
+        throw std::runtime_error("yaml: bad flow map entry '" + item + "'");
+      v.map.emplace_back(key, parse_scalar(strip(rest)));
+    } else {
+      v.arr.push_back(parse_scalar(item));
+    }
+  }
+  return v;
+}
+
+// Plain scalar -> typed Value (null / bool / int / float / string /
+// flow collection).
+inline Value parse_scalar(const std::string& tok) {
+  Value v;
+  if (tok.empty() || tok == "~" || tok == "null" || tok == "Null" ||
+      tok == "NULL") {
+    return v;  // nil
+  }
+  if ((tok.front() == '{' && tok.back() == '}' && tok != "{}") ||
+      (tok.front() == '[' && tok.back() == ']' && tok != "[]")) {
+    return parse_flow(tok);
+  }
+  if (tok.size() >= 2 &&
+      ((tok.front() == '"' && tok.back() == '"') ||
+       (tok.front() == '\'' && tok.back() == '\''))) {
+    v.kind = Value::kStr;
+    std::string body = tok.substr(1, tok.size() - 2);
+    if (tok.front() == '"') {  // minimal escape handling
+      std::string un;
+      for (size_t i = 0; i < body.size(); ++i) {
+        if (body[i] == '\\' && i + 1 < body.size()) {
+          ++i;
+          switch (body[i]) {
+            case 'n': un.push_back('\n'); break;
+            case 't': un.push_back('\t'); break;
+            default: un.push_back(body[i]);
+          }
+        } else {
+          un.push_back(body[i]);
+        }
+      }
+      body = std::move(un);
+    }
+    v.s = body;
+    return v;
+  }
+  if (tok == "true" || tok == "True") {
+    v.kind = Value::kBool;
+    v.b = true;
+    return v;
+  }
+  if (tok == "false" || tok == "False") {
+    v.kind = Value::kBool;
+    v.b = false;
+    return v;
+  }
+  if (tok == "{}") {
+    v.kind = Value::kMap;
+    return v;
+  }
+  if (tok == "[]") {
+    v.kind = Value::kArray;
+    return v;
+  }
+  // int?
+  {
+    char* end = nullptr;
+    errno = 0;
+    long long iv = std::strtoll(tok.c_str(), &end, 10);
+    if (errno == 0 && end == tok.c_str() + tok.size()) {
+      v.kind = Value::kInt;
+      v.i = iv;
+      return v;
+    }
+  }
+  // float?
+  {
+    char* end = nullptr;
+    errno = 0;
+    double dv = std::strtod(tok.c_str(), &end);
+    if (errno == 0 && end == tok.c_str() + tok.size()) {
+      v.kind = Value::kFloat;
+      v.f = dv;
+      return v;
+    }
+  }
+  v.kind = Value::kStr;
+  v.s = tok;
+  return v;
+}
+
+// "key: rest" split at the first ": " or trailing ":". Returns false if
+// the line is not a mapping entry.
+inline bool split_key(const std::string& text, std::string* key,
+                      std::string* rest) {
+  size_t pos;
+  bool in_quote = false;
+  char quote = 0;
+  for (pos = 0; pos < text.size(); ++pos) {
+    char c = text[pos];
+    if (in_quote) {
+      if (c == quote) in_quote = false;
+      continue;
+    }
+    if (c == '"' || c == '\'') {
+      in_quote = true;
+      quote = c;
+      continue;
+    }
+    if (c == ':' && (pos + 1 == text.size() || text[pos + 1] == ' ')) break;
+  }
+  if (pos >= text.size()) return false;
+  *key = text.substr(0, pos);
+  *rest = pos + 1 < text.size() ? text.substr(pos + 2) : "";
+  // strip whitespace around both
+  while (!rest->empty() && rest->front() == ' ') rest->erase(0, 1);
+  if (!key->empty() && key->front() == '"' && key->back() == '"')
+    *key = key->substr(1, key->size() - 2);
+  else if (!key->empty() && key->front() == '\'' && key->back() == '\'')
+    *key = key->substr(1, key->size() - 2);
+  return true;
+}
+
+inline Value parse_block(const std::vector<Line>& lines, size_t& i,
+                         int indent);
+
+// List block: consecutive "- item" entries at `indent`.
+inline Value parse_list(const std::vector<Line>& lines, size_t& i,
+                        int indent) {
+  Value v;
+  v.kind = Value::kArray;
+  while (i < lines.size() && lines[i].indent == indent &&
+         lines[i].text.rfind("- ", 0) == 0) {
+    std::string item = lines[i].text.substr(2);
+    while (!item.empty() && item.front() == ' ') item.erase(0, 1);
+    std::string key, rest;
+    if (split_key(item, &key, &rest)) {
+      // "- key: value" — an inline one-key map start whose siblings are
+      // indented past the dash; not emitted by our configs
+      throw std::runtime_error("yaml: nested maps inside lists unsupported");
+    }
+    if (item == "-" || item.empty())
+      throw std::runtime_error("yaml: nested lists unsupported");
+    v.arr.push_back(parse_scalar(item));
+    ++i;
+  }
+  return v;
+}
+
+// Map block at `indent`.
+inline Value parse_block(const std::vector<Line>& lines, size_t& i,
+                         int indent) {
+  Value v;
+  v.kind = Value::kMap;
+  while (i < lines.size() && lines[i].indent == indent) {
+    const Line& ln = lines[i];
+    if (ln.text.rfind("- ", 0) == 0)
+      throw std::runtime_error("yaml: unexpected list item in map block");
+    std::string key, rest;
+    if (!split_key(ln.text, &key, &rest))
+      throw std::runtime_error("yaml: expected 'key:' at line '" + ln.text +
+                               "'");
+    ++i;
+    if (!rest.empty()) {
+      v.map.emplace_back(key, parse_scalar(rest));
+      continue;
+    }
+    // Block value: a deeper map, a list (same or deeper indent), or null.
+    if (i < lines.size() && lines[i].text.rfind("- ", 0) == 0 &&
+        lines[i].indent >= indent) {
+      v.map.emplace_back(key, parse_list(lines, i, lines[i].indent));
+    } else if (i < lines.size() && lines[i].indent > indent) {
+      v.map.emplace_back(key, parse_block(lines, i, lines[i].indent));
+    } else {
+      v.map.emplace_back(key, Value{});  // key with no value -> null
+    }
+  }
+  if (i < lines.size() && lines[i].indent > indent)
+    throw std::runtime_error("yaml: inconsistent indentation at '" +
+                             lines[i].text + "'");
+  return v;
+}
+
+inline Value parse(const std::string& doc) {
+  std::vector<Line> lines = split_lines(doc);
+  if (lines.empty()) {
+    Value v;
+    v.kind = Value::kMap;
+    return v;
+  }
+  size_t i = 0;
+  Value v = parse_block(lines, i, lines[0].indent);
+  if (i != lines.size())
+    throw std::runtime_error("yaml: trailing content at '" + lines[i].text +
+                             "'");
+  return v;
+}
+
+inline Value parse_file(const std::string& path) {
+  std::ifstream f(path);
+  if (!f) throw std::runtime_error("cannot open yaml file " + path);
+  std::ostringstream os;
+  os << f.rdbuf();
+  return parse(os.str());
+}
+
+}  // namespace yaml
+}  // namespace persia

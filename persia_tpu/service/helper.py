@@ -73,6 +73,7 @@ class ServiceCtx:
         env: Optional[dict] = None,
         startup_timeout: float = 120.0,
         native_ps: bool = False,
+        native_worker: bool = False,
         ps_capacity: int = 1_000_000_000,
         ps_num_shards: int = 16,
     ):
@@ -80,6 +81,7 @@ class ServiceCtx:
         self.n_workers = n_workers
         self.n_ps = n_ps
         self.native_ps = native_ps
+        self.native_worker = native_worker
         self.ps_capacity = ps_capacity
         self.ps_num_shards = ps_num_shards
         self.global_config_path = global_config_path
@@ -152,6 +154,27 @@ class ServiceCtx:
                 args += ["--global-config", self.global_config_path]
             self._spawn(args, f"ps-{i}", i, self.n_ps)
         for i in range(self.n_workers):
+            if self.native_worker:
+                from persia_tpu.utils import resolve_binary_path
+
+                binary = resolve_binary_path("persia-embedding-worker")
+                cmd = [binary, "--replica-index", str(i),
+                       "--embedding-config", schema_path,
+                       "--coordinator", self.coordinator_addr,
+                       "--num-ps", str(self.n_ps)]
+                if self.global_config_path:
+                    # the binary takes the worker knobs as flags, not the
+                    # global-config YAML; translate so both tiers honor
+                    # the same GlobalConfig
+                    from persia_tpu.config import GlobalConfig
+
+                    gc = GlobalConfig.load(self.global_config_path)
+                    cmd += ["--forward-buffer-size",
+                            str(gc.embedding_worker.forward_buffer_size),
+                            "--buffered-data-expired-sec",
+                            str(gc.embedding_worker.buffered_data_expired_sec)]
+                self._spawn_raw(cmd, f"worker-{i}", i, self.n_workers)
+                continue
             args = ["-m", "persia_tpu.service.worker_service",
                     "--replica-index", str(i),
                     "--replica-size", str(self.n_workers),

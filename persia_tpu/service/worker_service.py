@@ -147,7 +147,11 @@ class RemoteEmbeddingWorker:
         client = self._client_for(ref)
         payload = msgpack.packb({"ref_id": ref[1], "training": training},
                                 use_bin_type=True)
-        return ser.unpack_lookup_result(client.call("forward_batch_id", payload))
+        # non-idempotent: lookup pops the forward buffer and (training)
+        # bumps staleness; the dedup id keeps a blind retry from
+        # double-counting staleness or 404ing on the popped ref_id
+        return ser.unpack_lookup_result(
+            client.call("forward_batch_id", payload, dedup=True))
 
     def lookup_direct(self, id_type_features, training: bool = False):
         addr = self._next_addr()

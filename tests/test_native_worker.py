@@ -1,0 +1,285 @@
+"""The C++ persia-embedding-worker binary: schema parity, wire parity
+against the Python worker tier, and full-cluster training.
+
+The native worker must be a drop-in replacement for
+persia_tpu/service/worker_service.py (reference: the compiled
+persia-embedding-worker binary, src/bin/persia-embedding-worker.rs:40-137).
+Since embedding init is a deterministic function of the sign and the
+middleware kernels are bit-identical across backends, two fresh clusters
+that differ ONLY in the worker tier's language must produce byte-equal
+lookups — before and after gradient updates.
+"""
+
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from persia_tpu.config import (
+    EmbeddingSchema,
+    HashStackConfig,
+    SlotConfig,
+    uniform_slots,
+)
+from persia_tpu.service.helper import ServiceCtx
+from persia_tpu.utils import resolve_binary_path
+
+pytestmark = pytest.mark.filterwarnings("ignore::ResourceWarning")
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _binary():
+    try:
+        return resolve_binary_path("persia-embedding-worker")
+    except FileNotFoundError:
+        pytest.skip("native worker binary not built (run make -C native)")
+
+
+def _rich_schema() -> EmbeddingSchema:
+    """Schema exercising every middleware feature: summed slots, a raw
+    (sequence) slot, sqrt scaling, hashstack compression, feature groups
+    with index-prefix namespacing."""
+    return EmbeddingSchema(
+        slots_config={
+            "clicks": SlotConfig(name="clicks", dim=8),
+            "ads": SlotConfig(name="ads", dim=8, sqrt_scaling=True),
+            "history": SlotConfig(
+                name="history", dim=4, embedding_summation=False,
+                sample_fixed_size=5,
+            ),
+            "huge_vocab": SlotConfig(
+                name="huge_vocab", dim=8,
+                hash_stack_config=HashStackConfig(
+                    hash_stack_rounds=2, embedding_size=1000,
+                ),
+            ),
+        },
+        feature_index_prefix_bit=12,
+        feature_groups={"engagement": ["clicks", "ads"]},
+    )
+
+
+def _batch(seed: int, bs: int = 32):
+    from persia_tpu.data.batch import IDTypeFeature
+
+    rng = np.random.default_rng(seed)
+    feats = []
+    for name, hi in (("clicks", 5000), ("ads", 5000),
+                     ("history", 2000), ("huge_vocab", 10 ** 9)):
+        samples = [
+            rng.integers(0, hi, size=rng.integers(1, 8)).astype(np.uint64)
+            for _ in range(bs)
+        ]
+        feats.append(IDTypeFeature(name, samples))
+    return feats
+
+
+def test_schema_parity_with_python():
+    """--dump-schema must resolve dims/flags/prefixes exactly like
+    EmbeddingSchema (same sorted-group prefix assignment)."""
+    binary = _binary()
+    import yaml
+
+    from persia_tpu.service.helper import _schema_to_yaml_dict
+
+    for schema, tag in [
+        (_rich_schema(), "rich"),
+        (EmbeddingSchema.from_dict(yaml.safe_load(
+            (REPO / "examples" / "criteo" / "config" /
+             "embedding_config.yml").read_text())), "criteo"),
+    ]:
+        import tempfile
+
+        with tempfile.NamedTemporaryFile("w", suffix=".yml") as f:
+            yaml.safe_dump(_schema_to_yaml_dict(schema), f)
+            f.flush()
+            out = subprocess.run(
+                [binary, "--embedding-config", f.name, "--dump-schema"],
+                capture_output=True, text=True, check=True,
+            ).stdout
+        native = json.loads(out)
+        assert native["feature_index_prefix_bit"] == \
+            schema.feature_index_prefix_bit, tag
+        assert set(native["slots"]) == set(schema.slots_config), tag
+        for name, slot in schema.slots_config.items():
+            ns = native["slots"][name]
+            assert ns["dim"] == slot.dim
+            assert ns["sample_fixed_size"] == slot.sample_fixed_size
+            assert ns["embedding_summation"] == slot.embedding_summation
+            assert ns["sqrt_scaling"] == slot.sqrt_scaling
+            assert ns["hash_stack_rounds"] == \
+                slot.hash_stack_config.hash_stack_rounds
+            assert ns["embedding_size"] == slot.hash_stack_config.embedding_size
+            assert ns["index_prefix"] == slot.index_prefix, (tag, name)
+
+
+@pytest.fixture(scope="module")
+def twin_clusters():
+    """Two fresh clusters over the C++ PS tier differing only in the
+    worker tier: Python worker_service vs the native binary."""
+    _binary()
+    schema = _rich_schema()
+    with ServiceCtx(schema, n_workers=1, n_ps=2, native_ps=True,
+                    ps_capacity=200_000, ps_num_shards=4) as py_svc, \
+         ServiceCtx(schema, n_workers=1, n_ps=2, native_ps=True,
+                    native_worker=True, ps_capacity=200_000,
+                    ps_num_shards=4) as cc_svc:
+        py_w = py_svc.remote_worker()
+        cc_w = cc_svc.remote_worker()
+        for w in (py_w, cc_w):
+            w.configure_parameter_servers(
+                "normal", {"mean": 0.0, "standard_deviation": 0.02}, 1.0,
+                10.0)
+            w.register_optimizer({"type": "adagrad", "lr": 0.05})
+        yield py_w, cc_w
+
+
+def _assert_lookup_equal(py_res, cc_res):
+    assert set(py_res) == set(cc_res)
+    for name in py_res:
+        p, c = py_res[name], cc_res[name]
+        assert type(p) is type(c)
+        np.testing.assert_array_equal(p.embeddings, c.embeddings, err_msg=name)
+        if hasattr(p, "index"):
+            np.testing.assert_array_equal(p.index, c.index, err_msg=name)
+            np.testing.assert_array_equal(p.sample_id_num, c.sample_id_num,
+                                          err_msg=name)
+
+
+def test_lookup_wire_parity(twin_clusters):
+    """Inference lookups byte-equal between the two worker tiers."""
+    py_w, cc_w = twin_clusters
+    for seed in (1, 2):
+        feats = _batch(seed)
+        _assert_lookup_equal(py_w.lookup_direct(feats, training=False),
+                             cc_w.lookup_direct(feats, training=False))
+
+
+def test_training_round_trip_parity(twin_clusters):
+    """put_batch -> lookup -> update_gradients: stores must evolve
+    identically, proven by byte-equal post-update lookups."""
+    py_w, cc_w = twin_clusters
+    schema = _rich_schema()
+    for step in range(3):
+        feats = _batch(100 + step)
+        py_ref, py_res = py_w.lookup_direct_training(feats)
+        cc_ref, cc_res = cc_w.lookup_direct_training(feats)
+        _assert_lookup_equal(py_res, cc_res)
+        rng = np.random.default_rng(7 + step)
+        grads = {}
+        for f in feats:
+            slot = schema.get_slot(f.name)
+            shape = py_res[f.name].embeddings.shape
+            grads[f.name] = rng.standard_normal(shape).astype(np.float32)
+        py_w.update_gradients(py_ref, grads, loss_scale=2.0)
+        cc_w.update_gradients(cc_ref, grads, loss_scale=2.0)
+    assert py_w.staleness == 0
+    assert cc_w.staleness == 0
+    feats = _batch(999)
+    _assert_lookup_equal(py_w.lookup_direct(feats, training=False),
+                         cc_w.lookup_direct(feats, training=False))
+
+
+def test_native_worker_train_ctx():
+    """Full TrainCtx training loop against the all-native service tier
+    (C++ worker + C++ PS): losses finite and decreasing-ish, AUC learns."""
+    import optax
+
+    sys.path.insert(0, str(REPO / "examples" / "adult_income"))
+    from data_generator import NUM_SLOTS, batches
+
+    from persia_tpu.ctx import TrainCtx
+    from persia_tpu.embedding import EmbeddingConfig
+    from persia_tpu.embedding.optim import Adagrad
+    from persia_tpu.models import DNN
+
+    schema = EmbeddingSchema(
+        slots_config=uniform_slots(
+            [f"slot_{s}" for s in range(NUM_SLOTS)], dim=8))
+    with ServiceCtx(schema, n_workers=2, n_ps=2, native_ps=True,
+                    native_worker=True, ps_capacity=200_000,
+                    ps_num_shards=4) as svc:
+        w = svc.remote_worker()
+        ctx = TrainCtx(
+            model=DNN(),
+            dense_optimizer=optax.adam(1e-3),
+            embedding_optimizer=Adagrad(lr=1e-2),
+            schema=schema,
+            worker=w,
+            embedding_config=EmbeddingConfig(emb_initialization=(-0.05, 0.05)),
+        )
+        losses = []
+        with ctx:
+            for b in batches(8 * 128, 128, seed=51):
+                loss, _ = ctx.train_step(b)
+                losses.append(float(loss))
+        assert np.isfinite(losses).all() and len(losses) == 8
+        assert w.staleness == 0
+
+
+def test_native_worker_dump_load(twin_clusters, tmp_path):
+    """Checkpoint fan-out through the native worker: dump writes the done
+    marker + per-replica shards; load restores them."""
+    _, cc_w = twin_clusters
+    path = tmp_path / "ckpt"
+    path.mkdir()
+    cc_w.dump(str(path))
+    marker = json.loads((path / "embedding_dump_done").read_text())
+    assert marker["num_shards"] == 2
+    assert (path / "replica_0.psd").exists()
+    assert (path / "replica_1.psd").exists()
+    cc_w.load(str(path))  # round-trips without error
+
+
+def test_native_worker_buffer_full_contract():
+    """A tiny forward buffer must answer ForwardBufferFull (the
+    data-loader backpressure contract, dataflow.py:100)."""
+    binary = _binary()
+    import yaml
+
+    from persia_tpu.rpc import RpcError
+    from persia_tpu.service.helper import _schema_to_yaml_dict
+    from persia_tpu.service.worker_service import RemoteEmbeddingWorker
+
+    schema = EmbeddingSchema(slots_config=uniform_slots(["s0"], dim=4))
+    with ServiceCtx(schema, n_workers=0, n_ps=1, native_ps=True,
+                    ps_capacity=10_000, ps_num_shards=2) as svc:
+        import tempfile
+
+        from persia_tpu.utils import find_free_port
+
+        port = find_free_port()
+        with tempfile.NamedTemporaryFile("w", suffix=".yml",
+                                         delete=False) as f:
+            yaml.safe_dump(_schema_to_yaml_dict(schema), f)
+            schema_path = f.name
+        proc = subprocess.Popen(
+            [binary, "--embedding-config", schema_path,
+             "--port", str(port), "--ps-addrs", svc.ps_addrs[0],
+             "--forward-buffer-size", "2"])
+        try:
+            w = RemoteEmbeddingWorker([f"127.0.0.1:{port}"])
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                try:
+                    if w.staleness == 0:
+                        break
+                except Exception:
+                    time.sleep(0.1)
+            from persia_tpu.data.batch import IDTypeFeature
+
+            feats = [IDTypeFeature(
+                "s0", [np.array([1, 2], np.uint64)])]
+            w.put_batch(feats)
+            w.put_batch(feats)
+            with pytest.raises(RpcError, match="ForwardBufferFull"):
+                w.put_batch(feats)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)

@@ -1,0 +1,16 @@
+#!/bin/bash
+# TPU relay watcher: probe relay ports every 60s, log attempts, exit when one opens.
+LOG=/root/repo/TPU_PROBE.log
+END=$(( $(date +%s) + 41400 ))  # ~11.5h
+while [ "$(date +%s)" -lt "$END" ]; do
+  for p in 8082 8083 8087 8092; do
+    if timeout 2 bash -c "echo > /dev/tcp/127.0.0.1/$p" 2>/dev/null; then
+      echo "$(date -u +%FT%TZ) port $p OPEN — relay up" >> "$LOG"
+      exit 0
+    fi
+  done
+  echo "$(date -u +%FT%TZ) relay ports closed" >> "$LOG"
+  sleep 60
+done
+echo "$(date -u +%FT%TZ) watcher expired, relay never came up" >> "$LOG"
+exit 1
