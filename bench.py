@@ -213,6 +213,56 @@ def bench_worker(batch_size, steps, n_ps=2, dim=DIM):
     return steps * batch_size / elapsed
 
 
+def bench_worker_service(batch_size, steps, native_worker, n_ps=2, dim=DIM):
+    """Service-tier worker cycle over real sockets: this process as the
+    trainer RPC client -> one embedding-worker service (Python tier or
+    the C++ persia-embedding-worker binary) -> C++ PS replicas. The
+    worker-tier language is the only variable, so the delta is the cost
+    of serving the RPC surface from Python threads."""
+    from persia_tpu.config import EmbeddingSchema, uniform_slots
+    from persia_tpu.data.batch import IDTypeFeatureWithSingleID
+    from persia_tpu.service.helper import ServiceCtx
+
+    schema = EmbeddingSchema(slots_config=uniform_slots(
+        [f"slot_{s}" for s in range(NUM_SLOTS)], dim=dim))
+    rng = np.random.default_rng(0)
+
+    def batch():
+        return [
+            IDTypeFeatureWithSingleID(
+                f"slot_{s}",
+                rng.integers(0, 1 << 40, size=batch_size, dtype=np.uint64))
+            for s in range(NUM_SLOTS)
+        ]
+
+    with ServiceCtx(schema, n_workers=1, n_ps=n_ps, native_ps=True,
+                    native_worker=native_worker, ps_capacity=50_000_000,
+                    ps_num_shards=16) as svc:
+        w = svc.remote_worker()
+        w.configure_parameter_servers(
+            "bounded_uniform", {"lower": -0.01, "upper": 0.01}, 1.0, 10.0)
+        w.register_optimizer({
+            "type": "adagrad", "lr": 0.02, "initial_accumulator_value": 0.1,
+            "g_square_momentum": 1.0, "vectorwise_shared": False,
+        })
+
+        def cycle(b):
+            ref, lk = w.lookup_direct_training(b)
+            w.update_gradients(ref, {k: v.embeddings for k, v in lk.items()})
+
+        for _ in range(3):
+            cycle(batch())
+        batches = [batch() for _ in range(steps)]
+        t0 = time.perf_counter()
+        for b in batches:
+            cycle(b)
+        elapsed = time.perf_counter() - t0
+    tier = "native" if native_worker else "python"
+    log(f"worker-svc[{tier}]: {elapsed / steps * 1e3:.1f} ms/batch all-miss "
+        f"(bs={batch_size} x {NUM_SLOTS} slots, {n_ps} C++ PS, RPC)")
+    return steps * batch_size / elapsed
+
+
 def bench_wire(batch_size, steps):
     """Serialization microbench (analogue of the reference's
     persia-common-benchmark criterion suite): PTB2 batch round trip +
@@ -319,7 +369,9 @@ def preflight_backend(metric, unit, timeout=90):
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--mode", choices=["hybrid", "device", "wire", "worker"],
+    p.add_argument("--mode",
+                   choices=["hybrid", "device", "wire", "worker",
+                            "worker-svc"],
                    default="hybrid")
     p.add_argument("--batch-size", type=int, default=4096)
     p.add_argument("--steps", type=int, default=30)
@@ -337,6 +389,7 @@ def main():
         "device": ("dlrm_device_samples_per_sec_chip", "samples/sec"),
         "wire": ("ptb2_serialize_gb_per_sec", "GB/sec"),
         "worker": ("worker_cycle_samples_per_sec_core", "samples/sec"),
+        "worker-svc": ("worker_service_samples_per_sec_core", "samples/sec"),
     }[args.mode]
 
     # Two-tier watchdog. Tier 1 (threading.Timer) emits the diagnostic
@@ -359,7 +412,7 @@ def main():
     if args.smoke:
         args.batch_size, args.steps, args.warmup = 256, 3, 1
 
-    if args.mode not in ("wire", "worker"):  # host-only modes skip jax
+    if args.mode not in ("wire", "worker", "worker-svc"):  # host-only modes skip jax
         import os
 
         forced = os.environ.get("PERSIA_FORCE_JAX_PLATFORM")
@@ -379,7 +432,16 @@ def main():
         vs_baseline = value / BASELINE_SAMPLES_PER_SEC
     elif args.mode == "worker":
         value = bench_worker(args.batch_size, max(args.steps, 5))
-        vs_baseline = value / BASELINE_SAMPLES_PER_SEC
+        # host-side metric: no meaningful ratio against the chip-throughput
+        # baseline constant, so pin 1.0 like wire mode
+        vs_baseline = 1.0
+    elif args.mode == "worker-svc":
+        py = bench_worker_service(args.batch_size, max(args.steps, 5),
+                                  native_worker=False)
+        value = bench_worker_service(args.batch_size, max(args.steps, 5),
+                                     native_worker=True)
+        log(f"worker-svc: native/python speedup {value / py:.2f}x")
+        vs_baseline = 1.0
     elif args.mode == "wire":
         value = bench_wire(args.batch_size, max(args.steps, 5))
         vs_baseline = 1.0  # reference publishes only relative wire numbers

@@ -115,12 +115,32 @@ inline void send_msg(int fd, const std::string& env_body,
   write_all(fd, payload->data(), payload->size());
 }
 
-inline void send_ok(int fd, const std::string& payload) {
+// Compression exists for DCN links; on loopback it is pure CPU overhead
+// (embedding/sign payloads are near-incompressible — rpc.py applies the
+// same gate).
+inline bool fd_is_loopback(int fd) {
+  sockaddr_storage ss{};
+  socklen_t len = sizeof(ss);
+  if (::getpeername(fd, reinterpret_cast<sockaddr*>(&ss), &len) != 0)
+    return false;
+  if (ss.ss_family == AF_INET) {
+    const auto* a = reinterpret_cast<const sockaddr_in*>(&ss);
+    return (ntohl(a->sin_addr.s_addr) >> 24) == 127;
+  }
+  if (ss.ss_family == AF_INET6) {
+    const auto* a = reinterpret_cast<const sockaddr_in6*>(&ss);
+    return IN6_IS_ADDR_LOOPBACK(&a->sin6_addr);
+  }
+  return false;
+}
+
+inline void send_ok(int fd, const std::string& payload,
+                    bool allow_compress = true) {
   std::string env;
   msgpack::encode_array_header(env, 2);
   msgpack::encode_str(env, "ok");
   msgpack::encode_uint(env, payload.size());
-  send_msg(fd, env, payload, true);
+  send_msg(fd, env, payload, allow_compress);
 }
 
 inline void send_err(int fd, const std::string& message) {
@@ -355,6 +375,8 @@ class RpcChannel {
     host_ = addr.substr(0, colon);
     port_ = std::atoi(addr.c_str() + colon + 1);
     addr_ = addr;
+    compress_ = host_.rfind("127.", 0) != 0 && host_ != "::1" &&
+                host_ != "localhost";
   }
 
   ~RpcChannel() {
@@ -384,7 +406,7 @@ class RpcChannel {
       bool fresh = false;
       int fd = acquire(&fresh, &attempts_left, &delay);
       try {
-        send_msg(fd, env_base, payload, true);
+        send_msg(fd, env_base, payload, compress_);
         Message resp;
         if (!recv_msg(fd, &resp)) throw std::runtime_error("closed");
         release(fd);
@@ -475,6 +497,7 @@ class RpcChannel {
   int port_;
   int max_retries_;
   double backoff_;
+  bool compress_ = true;
   std::mutex mu_;
   std::vector<int> pool_;
 };

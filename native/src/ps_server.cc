@@ -272,6 +272,7 @@ class PsServer {
 net::DedupCache g_dedup;
 
 void serve_conn(PsServer* server, int fd) {
+  const bool compress = !net::fd_is_loopback(fd);
   net::Message msg;
   for (;;) {
     try {
@@ -302,7 +303,7 @@ void serve_conn(PsServer* server, int fd) {
         result = server->dispatch(method, msg.payload);
         if (req_id != nullptr) g_dedup.store(*req_id, result);
       }
-      net::send_ok(fd, result);
+      net::send_ok(fd, result, compress);
     } catch (const std::exception& e) {
       try {
         net::send_err(fd, std::string(typeid(e).name()) + ": " + e.what());

@@ -151,24 +151,20 @@ class Worker {
   // Shard fan-out: one thread per (shard, dim) group when multiple PS
   // replicas exist (the reference joins all per-shard RPC futures,
   // mod.rs:448-484); with remote replicas the threads overlap network
-  // wait even on a single core.
-  std::vector<std::vector<float>> fan_out_lookup(
-      const std::vector<w::ShardGroup>& groups, bool training) {
-    std::vector<std::vector<float>> results(groups.size());
-    if (groups.size() <= 1 || ps_.size() == 1) {
-      for (size_t i = 0; i < groups.size(); ++i)
-        results[i] =
-            ps_[groups[i].shard]->lookup(groups[i].signs, groups[i].dim,
-                                         training);
-      return results;
+  // wait even on a single core. fn(i) runs once per group; the first
+  // exception rethrows after all threads joined.
+  template <typename Fn>
+  void fan_out(size_t n_groups, Fn fn) {
+    if (n_groups <= 1 || ps_.size() == 1) {
+      for (size_t i = 0; i < n_groups; ++i) fn(i);
+      return;
     }
     std::vector<std::thread> threads;
-    std::vector<std::exception_ptr> errs(groups.size());
-    for (size_t i = 0; i < groups.size(); ++i)
+    std::vector<std::exception_ptr> errs(n_groups);
+    for (size_t i = 0; i < n_groups; ++i)
       threads.emplace_back([&, i] {
         try {
-          results[i] = ps_[groups[i].shard]->lookup(
-              groups[i].signs, groups[i].dim, training);
+          fn(i);
         } catch (...) {
           errs[i] = std::current_exception();
         }
@@ -176,6 +172,15 @@ class Worker {
     for (auto& t : threads) t.join();
     for (auto& e : errs)
       if (e) std::rethrow_exception(e);
+  }
+
+  std::vector<std::vector<float>> fan_out_lookup(
+      const std::vector<w::ShardGroup>& groups, bool training) {
+    std::vector<std::vector<float>> results(groups.size());
+    fan_out(groups.size(), [&](size_t i) {
+      results[i] = ps_[groups[i].shard]->lookup(groups[i].signs,
+                                                groups[i].dim, training);
+    });
     return results;
   }
 
@@ -273,26 +278,10 @@ class Worker {
     }
     std::vector<std::vector<float>> sharded =
         w::shard_gradients(entry.groups, per_feature);
-    if (entry.groups.size() <= 1 || ps_.size() == 1) {
-      for (size_t i = 0; i < entry.groups.size(); ++i)
-        ps_[entry.groups[i].shard]->update_gradients(
-            entry.groups[i].signs, sharded[i], entry.groups[i].dim);
-      return;
-    }
-    std::vector<std::thread> threads;
-    std::vector<std::exception_ptr> errs(entry.groups.size());
-    for (size_t i = 0; i < entry.groups.size(); ++i)
-      threads.emplace_back([&, i] {
-        try {
-          ps_[entry.groups[i].shard]->update_gradients(
-              entry.groups[i].signs, sharded[i], entry.groups[i].dim);
-        } catch (...) {
-          errs[i] = std::current_exception();
-        }
-      });
-    for (auto& t : threads) t.join();
-    for (auto& e : errs)
-      if (e) std::rethrow_exception(e);
+    fan_out(entry.groups.size(), [&](size_t i) {
+      ps_[entry.groups[i].shard]->update_gradients(
+          entry.groups[i].signs, sharded[i], entry.groups[i].dim);
+    });
   }
 
   int64_t staleness() {
@@ -378,8 +367,7 @@ std::vector<w::WireFeature> parse_id_features(
 }
 
 std::string pack_lookup_result(const Worker::LookupOut& out,
-                               const w::Schema& schema, int32_t bs_hint) {
-  (void)bs_hint;
+                               const w::Schema& schema) {
   net::ArraysBuilder b;
   std::vector<std::string> kinds;
   for (const auto& r : out.results)
@@ -453,7 +441,7 @@ class WorkerServer {
     mp::Value req = mp::decode_all(payload);
     Worker::LookupOut out = worker_->lookup(
         req.at("ref_id").as_int(), req.at("training").as_bool());
-    return pack_lookup_result(out, worker_->schema(), 0);
+    return pack_lookup_result(out, worker_->schema());
   }
 
   std::string do_forward_direct(const std::string& payload) {
@@ -466,7 +454,7 @@ class WorkerServer {
     std::vector<w::DedupedFeature> feats =
         w::preprocess_batch(wire, worker_->schema());
     Worker::LookupOut out = worker_->lookup_feats(feats, training, nullptr);
-    return pack_lookup_result(out, worker_->schema(), 0);
+    return pack_lookup_result(out, worker_->schema());
   }
 
   std::string do_update(const std::string& payload) {
@@ -598,6 +586,7 @@ class WorkerServer {
 };
 
 void serve_conn(WorkerServer* server, int fd) {
+  const bool compress = !net::fd_is_loopback(fd);
   net::Message msg;
   for (;;) {
     try {
@@ -625,7 +614,7 @@ void serve_conn(WorkerServer* server, int fd) {
         result = server->dispatch(method, msg.payload);
         if (req_id != nullptr) server->dedup.store(*req_id, result);
       }
-      net::send_ok(fd, result);
+      net::send_ok(fd, result, compress);
     } catch (const BufferFull& e) {
       // the data-loader backpressure contract matches on this name
       // (dataflow.py:100, reference ForwardBufferFull)

@@ -49,6 +49,17 @@ _FLAG_COMPRESSED = 1
 COMPRESS_THRESHOLD = 1 << 16
 
 
+def _is_loopback(sock: socket.socket) -> bool:
+    """Compression exists for DCN links; on loopback it is pure CPU
+    overhead (embedding/sign payloads are near-incompressible: zstd-3
+    spends ~35 ms per 7 MB for a 7% size win, measured on this host)."""
+    try:
+        peer = sock.getpeername()[0]
+    except OSError:
+        return False
+    return peer.startswith("127.") or peer == "::1"
+
+
 class RpcError(RuntimeError):
     pass
 
@@ -133,12 +144,17 @@ class RpcServer:
     """
 
     DEDUP_CACHE_SIZE = 8192
+    # Byte bound too: lookup responses are multi-MB, and 8192 of those
+    # would not be a cache, it would be a leak (matches the C++
+    # DedupCache in native/src/net.h).
+    DEDUP_CACHE_BYTES = 256 << 20
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         from collections import OrderedDict
 
         self._handlers: Dict[str, Callable[[bytes], bytes]] = {}
         self._dedup: "OrderedDict[bytes, bytes]" = OrderedDict()
+        self._dedup_bytes = 0
         self._dedup_lock = threading.Lock()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -179,6 +195,7 @@ class RpcServer:
                              daemon=True).start()
 
     def _serve_conn(self, conn: socket.socket):
+        compress = not _is_loopback(conn)
         with conn:
             while self._running:
                 try:
@@ -206,9 +223,14 @@ class RpcServer:
                         if req_id is not None:
                             with self._dedup_lock:
                                 self._dedup[req_id] = result
-                                while len(self._dedup) > self.DEDUP_CACHE_SIZE:
-                                    self._dedup.popitem(last=False)
-                    _send_msg(conn, ["ok"], result, True)
+                                self._dedup_bytes += len(result)
+                                while len(self._dedup) > self.DEDUP_CACHE_SIZE or (
+                                    self._dedup_bytes > self.DEDUP_CACHE_BYTES
+                                    and len(self._dedup) > 1
+                                ):
+                                    _, old = self._dedup.popitem(last=False)
+                                    self._dedup_bytes -= len(old)
+                    _send_msg(conn, ["ok"], result, compress)
                 except BaseException as e:
                     try:
                         _send_msg(conn, ["err", f"{type(e).__name__}: {e}"],
@@ -248,6 +270,7 @@ class RpcClient:
     def _dial(self) -> socket.socket:
         conn = socket.create_connection(self._target, timeout=self.timeout)
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._local.compress = not _is_loopback(conn)
         return conn
 
     def call(self, method: str, payload: bytes = b"",
@@ -283,7 +306,8 @@ class RpcClient:
                     delay = min(delay * 2, 5.0)
                     continue
             try:
-                _send_msg(conn, envelope, payload, True)
+                _send_msg(conn, envelope, payload,
+                          getattr(self._local, "compress", True))
                 env, result = _recv_msg(conn)
                 break
             except (ConnectionError, OSError):
