@@ -102,6 +102,11 @@ class PsClient {
     return mp::decode_all(resp).at("status").as_str();
   }
 
+  bool ready_for_serving() {
+    std::string resp = chan_.call("ready_for_serving", "");
+    return mp::decode_all(resp).at("ready").as_bool();
+  }
+
   const std::string& addr() const { return chan_.addr(); }
 
  private:
@@ -115,6 +120,16 @@ struct BufferFull : std::runtime_error {
 };
 
 class Worker {
+  struct ForwardEntry {
+    std::vector<w::DedupedFeature> feats;
+    double enter_time;
+  };
+  struct PostEntry {
+    std::vector<w::DedupedFeature> feats;
+    std::vector<w::ShardGroup> groups;
+    double enter_time = 0;
+  };
+
  public:
   Worker(w::Schema schema, std::vector<std::string> ps_addrs,
          int64_t forward_buffer_size, double buffered_data_expired_sec)
@@ -210,6 +225,7 @@ class Worker {
 
   LookupOut lookup(int64_t ref_id, bool training) {
     std::vector<w::DedupedFeature> feats;
+    double enter_time;
     {
       std::lock_guard<std::mutex> lk(mu_);
       auto it = forward_buffer_.find(ref_id);
@@ -217,10 +233,20 @@ class Worker {
         throw std::runtime_error("ref_id " + std::to_string(ref_id) +
                                  " not in forward buffer");
       feats = std::move(it->second.feats);
+      enter_time = it->second.enter_time;
       forward_buffer_.erase(it);
     }
     std::vector<w::ShardGroup> groups;
-    LookupOut out = lookup_feats(feats, training, &groups);
+    LookupOut out;
+    try {
+      out = lookup_feats(feats, training, &groups);
+    } catch (...) {
+      // restore the entry so a retry after PS recovery can still find
+      // its batch (the client's lookup retry contract, worker.py lookup)
+      std::lock_guard<std::mutex> lk(mu_);
+      forward_buffer_[ref_id] = {std::move(feats), enter_time};
+      throw;
+    }
     if (training) {
       std::lock_guard<std::mutex> lk(mu_);
       post_forward_buffer_[ref_id] = {std::move(feats), std::move(groups),
@@ -245,6 +271,22 @@ class Worker {
       post_forward_buffer_.erase(it);
       --staleness_;
     }
+    try {
+      update_gradients_inner(entry, grad_names, grad_arrays, loss_scale);
+    } catch (...) {
+      // restore so the trainer's retry after PS recovery still finds the
+      // batch (worker.py update_gradients has the same contract)
+      std::lock_guard<std::mutex> lk(mu_);
+      post_forward_buffer_[ref_id] = std::move(entry);
+      ++staleness_;
+      throw;
+    }
+  }
+
+  void update_gradients_inner(const PostEntry& entry,
+                              const std::vector<std::string>& grad_names,
+                              const std::vector<net::ArrayRef>& grad_arrays,
+                              float loss_scale) {
     // per-feature aggregation in feats order, like worker.py
     std::vector<std::vector<float>> per_feature(entry.feats.size());
     for (size_t i = 0; i < entry.feats.size(); ++i) {
@@ -310,16 +352,6 @@ class Worker {
   }
 
  private:
-  struct ForwardEntry {
-    std::vector<w::DedupedFeature> feats;
-    double enter_time;
-  };
-  struct PostEntry {
-    std::vector<w::DedupedFeature> feats;
-    std::vector<w::ShardGroup> groups;
-    double enter_time = 0;
-  };
-
   w::Schema schema_;
   std::vector<std::unique_ptr<PsClient>> ps_;
   int64_t forward_buffer_size_;
@@ -401,23 +433,23 @@ class WorkerServer {
 
   std::string dispatch(const std::string& method,
                        const std::string& payload) {
-    if (method == "forward_batched") return do_forward_batched(payload);
-    if (method == "forward_batch_id") return do_forward_batch_id(payload);
+    // Data-plane methods retry once after re-arming restarted PS
+    // replicas (worker.py _with_ps_retry is the Python twin). All three
+    // are retry-safe: forward_batch_id / update_gradients restore their
+    // buffer entry on failure, forward_batched_direct is stateless.
+    if (method == "forward_batch_id")
+      return with_rearm_retry([&] { return do_forward_batch_id(payload); });
     if (method == "forward_batched_direct")
-      return do_forward_direct(payload);
-    if (method == "update_gradients") return do_update(payload);
-    if (method == "configure") return do_fanout_passthrough("configure", payload);
+      return with_rearm_retry([&] { return do_forward_direct(payload); });
+    if (method == "update_gradients")
+      return with_rearm_retry([&] { return do_update(payload); });
+    if (method == "forward_batched") return do_forward_batched(payload);
+    if (method == "configure") return do_configure(payload);
     if (method == "register_optimizer") return do_register_optimizer(payload);
     if (method == "dump") return do_dump(payload);
     if (method == "load") return do_load(payload);
     if (method == "staleness") return do_staleness();
-    if (method == "ready") {
-      std::string out;
-      mp::encode_map_header(out, 1);
-      mp::encode_str(out, "ready");
-      mp::encode_bool(out, true);
-      return out;
-    }
+    if (method == "ready") return do_ready();
     throw std::runtime_error("no such method " + method);
   }
 
@@ -474,12 +506,65 @@ class WorkerServer {
     return "";
   }
 
+  // Retry a data-plane call once after re-arming any restarted replica:
+  // a PS that came back on its old address serves RPCs again but lost
+  // its store config, so the first failure after a restart is the cue
+  // to re-push the remembered control-plane state.
+  template <typename Fn>
+  std::string with_rearm_retry(Fn fn) {
+    try {
+      return fn();
+    } catch (const BufferFull&) {
+      throw;
+    } catch (const std::exception&) {
+      if (!rearm_unready()) throw;
+      return fn();
+    }
+  }
+
+  // Re-push cached configure/register payloads to replicas reporting
+  // not-ready. Healthy replicas stay untouched (re-registering an
+  // optimizer would reset its server-side state). Returns true if any
+  // replica was re-armed.
+  bool rearm_unready() {
+    std::lock_guard<std::mutex> lk(ctrl_mu_);
+    if (configure_payload_.empty() && register_payload_.empty())
+      return false;
+    bool rearmed = false;
+    for (size_t i = 0; i < worker_->num_ps(); ++i) {
+      bool ready = true;
+      try {
+        ready = worker_->ps(i).ready_for_serving();
+      } catch (const std::exception&) {
+        continue;  // still down: transport recovery handles it
+      }
+      if (ready) continue;
+      try {
+        if (!configure_payload_.empty())
+          worker_->ps(i).forward("configure", configure_payload_);
+        if (!register_payload_.empty())
+          worker_->ps(i).forward("register_optimizer", register_payload_);
+        rearmed = true;
+        std::fprintf(stderr, "re-armed restarted PS %s\n",
+                     worker_->ps(i).addr().c_str());
+      } catch (const std::exception& e) {
+        std::fprintf(stderr, "re-arm of PS %s failed: %s\n",
+                     worker_->ps(i).addr().c_str(), e.what());
+      }
+    }
+    return rearmed;
+  }
+
   // configure fans out the SAME payload to every PS
-  // (worker_service.py _configure -> PsClient.configure round trip).
-  std::string do_fanout_passthrough(const std::string& method,
-                                    const std::string& payload) {
+  // (worker_service.py _configure -> PsClient.configure round trip);
+  // the payload is remembered for re-arming restarted replicas.
+  std::string do_configure(const std::string& payload) {
+    {
+      std::lock_guard<std::mutex> lk(ctrl_mu_);
+      configure_payload_ = payload;
+    }
     for (size_t i = 0; i < worker_->num_ps(); ++i)
-      worker_->ps(i).forward(method, payload);
+      worker_->ps(i).forward("configure", payload);
     return "";
   }
 
@@ -493,6 +578,10 @@ class WorkerServer {
     mp::encode_value(fwd, req.at("config"));
     mp::encode_str(fwd, "feature_index_prefix_bit");
     mp::encode_int(fwd, worker_->schema().prefix_bit);
+    {
+      std::lock_guard<std::mutex> lk(ctrl_mu_);
+      register_payload_ = fwd;
+    }
     for (size_t i = 0; i < worker_->num_ps(); ++i)
       worker_->ps(i).forward("register_optimizer", fwd);
     return "";
@@ -582,7 +671,28 @@ class WorkerServer {
     return out;
   }
 
+  // Ready iff every PS replica is serving (the trainer's recovery wait
+  // polls this; worker_service.py _ready is the Python twin).
+  std::string do_ready() {
+    bool ready = true;
+    for (size_t i = 0; i < worker_->num_ps() && ready; ++i) {
+      try {
+        ready = worker_->ps(i).ready_for_serving();
+      } catch (const std::exception&) {
+        ready = false;
+      }
+    }
+    std::string out;
+    mp::encode_map_header(out, 1);
+    mp::encode_str(out, "ready");
+    mp::encode_bool(out, ready);
+    return out;
+  }
+
   Worker* worker_;
+  std::mutex ctrl_mu_;
+  std::string configure_payload_;
+  std::string register_payload_;
 };
 
 void serve_conn(WorkerServer* server, int fd) {

@@ -44,6 +44,36 @@ _logger = get_default_logger(__name__)
 _SENTINEL = object()
 
 
+def _retry_with_recovery(worker, fn, what: str, max_recoveries: int = 4,
+                         stop: Optional[threading.Event] = None):
+    """Run ``fn`` surviving transient server failures: on RPC/connection
+    errors, wait for the service tier to recover (the reference's
+    forward workers block on wait_for_serving, forward.rs:708-761) and
+    retry, up to ``max_recoveries`` times. Shared by the forward lookup
+    and backward update paths."""
+    import time
+
+    from persia_tpu.rpc import RpcError
+
+    attempts = 0
+    while True:
+        try:
+            return fn()
+        except (RpcError, ConnectionError, OSError) as e:
+            attempts += 1
+            if attempts > max_recoveries or (
+                stop is not None and stop.is_set()
+            ):
+                raise
+            _logger.warning("%s failed (%s); waiting for serving, "
+                            "retry %d/%d", what, e, attempts, max_recoveries)
+            wait = getattr(worker, "wait_for_serving", None)
+            if wait is not None:
+                wait(timeout=120.0)
+            else:
+                time.sleep(min(0.5 * attempts, 2.0))
+
+
 @dataclass
 class LookedUpBatch:
     """A batch whose embeddings have been fetched — ready for the jitted
@@ -136,6 +166,17 @@ class BackwardEngine:
         self.submit(ref_id, _PackedGrads(flat_grads, shapes, names,
                                          slot_dims))
 
+    def _update_with_recovery(self, ref_id, grads):
+        """Ship one gradient batch, surviving server failures like the
+        forward path. The worker restores its post-forward entry on a
+        failed update, so the retry still finds its batch."""
+        return _retry_with_recovery(
+            self.worker,
+            lambda: self.worker.update_gradients(
+                ref_id, grads, loss_scale=self.loss_scale),
+            "gradient update",
+        )
+
     def _run(self):
         import numpy as np
 
@@ -159,8 +200,7 @@ class BackwardEngine:
                             per_slot = unpack_embedding_grads(
                                 np.asarray(grads.flat), grads.shapes)
                         grads = dict(zip(grads.names, per_slot))
-                    self.worker.update_gradients(ref_id, grads,
-                                                 loss_scale=self.loss_scale)
+                    self._update_with_recovery(ref_id, grads)
                 heartbeat()
             except BaseException as e:  # propagate to the training thread
                 _logger.error("backward update failed: %s", e)
@@ -215,18 +255,50 @@ class ForwardEngine:
         self._forward_hist = StageTimer("forward_client_time_cost_sec").hist
         start_deadlock_detection()
 
+    def _lookup_with_recovery(self, batch,
+                              stop: Optional[threading.Event] = None):
+        """One batch's lookup, surviving server failures. The worker
+        restores its forward-buffer entry on a failed lookup, so a retry
+        by ref_id still finds its batch; a put_batch that already
+        succeeded is never re-sent (no orphaned duplicate entries —
+        ``state`` carries the ref across attempts)."""
+        rref = getattr(batch, "remote_ref", None)
+        state = {"ref_id": None}
+
+        def attempt():
+            if rref is not None:
+                # ID features already live in a worker's forward buffer
+                # (sent by a remote data-loader)
+                lookup = self.worker.lookup(rref,
+                                            training=batch.requires_grad)
+                return (rref if batch.requires_grad else None), lookup
+            if batch.requires_grad:
+                if state["ref_id"] is None:
+                    state["ref_id"] = self.worker.put_batch(
+                        batch.id_type_features)
+                return state["ref_id"], self.worker.lookup(
+                    state["ref_id"], training=True)
+            return None, self.worker.lookup_direct(
+                batch.id_type_features, training=False)
+
+        return _retry_with_recovery(self.worker, attempt, "lookup",
+                                    stop=stop)
+
     def run(self, batches: Iterator[PersiaBatch],
             timeout_ms: int = 600_000) -> Iterator[LookedUpBatch]:
         timeout = timeout_ms / 1000.0
         in_q: "queue.Queue" = queue.Queue(maxsize=self.buffer_size)
         out_q: "queue.Queue" = queue.Queue(maxsize=self.buffer_size)
         errors: List[BaseException] = []
+        stop = threading.Event()
         n_workers = 1 if self.reproducible else self.num_workers
         seq_counter = itertools.count()
 
         def feeder():
             try:
                 for batch in batches:
+                    if stop.is_set():
+                        break
                     # Acquire the staleness permit HERE, in sequence order.
                     # Acquiring inside the racing lookup workers can
                     # deadlock with the output reorder buffer: permits all
@@ -248,26 +320,16 @@ class ForwardEngine:
                     out_q.put(_SENTINEL)
                     return
                 seq, batch = item
+                if stop.is_set():
+                    # another worker hit a fatal error: drain, don't process
+                    if batch.requires_grad and self.staleness_sem is not None:
+                        self.staleness_sem.release()
+                    continue
                 work_started()
                 try:
                     with self._forward_hist.timer():
-                        rref = getattr(batch, "remote_ref", None)
-                        if rref is not None:
-                            # ID features already live in a worker's forward
-                            # buffer (sent by a remote data-loader)
-                            ref_id = rref if batch.requires_grad else None
-                            lookup = self.worker.lookup(
-                                rref, training=batch.requires_grad
-                            )
-                        elif batch.requires_grad:
-                            ref_id = self.worker.put_batch(
-                                batch.id_type_features)
-                            lookup = self.worker.lookup(ref_id, training=True)
-                        else:
-                            ref_id = None
-                            lookup = self.worker.lookup_direct(
-                                batch.id_type_features, training=False
-                            )
+                        ref_id, lookup = self._lookup_with_recovery(
+                            batch, stop=stop)
                     staged = None
                     stage = getattr(self.ctx, "stage_batch", None)
                     if stage is not None and batch.requires_grad:
@@ -279,14 +341,20 @@ class ForwardEngine:
                     out_q.put((seq, LookedUpBatch(batch, lookup, ref_id,
                                                   self, staged)))
                 except BaseException as e:
+                    # this batch will never train: its permit must not
+                    # stay captive, and the feeder must stop acquiring
+                    if batch.requires_grad and self.staleness_sem is not None:
+                        self.staleness_sem.release()
+                    stop.set()
                     errors.append(e)
                     out_q.put(_SENTINEL)
                     return
                 finally:
                     work_finished()
 
-        threads = [threading.Thread(target=feeder, daemon=True,
-                                    name="forward-feeder")]
+        feeder_thread = threading.Thread(target=feeder, daemon=True,
+                                         name="forward-feeder")
+        threads = [feeder_thread]
         threads += [
             threading.Thread(target=lookup_worker, daemon=True,
                              name=f"forward-worker-{i}")
@@ -295,19 +363,20 @@ class ForwardEngine:
         for t in threads:
             t.start()
 
+        heap: list = []
         finished_workers = 0
         if self.reproducible:
             # single ordered worker: results arrive in sequence already
-            while True:
+            while finished_workers < n_workers:
                 item = out_q.get(timeout=timeout)
                 if item is _SENTINEL:
-                    break
+                    finished_workers += 1
+                    continue
                 yield item[1]
         else:
             # reorder by seq so iteration order is stable even with
             # concurrent workers (cheap; determinism of *updates* still
             # requires staleness=1)
-            heap: list = []
             next_seq = 0
             while finished_workers < n_workers:
                 item = out_q.get(timeout=timeout)
@@ -319,11 +388,49 @@ class ForwardEngine:
                     _, lb = heapq.heappop(heap)
                     next_seq += 1
                     yield lb
-            while heap:
-                _, lb = heapq.heappop(heap)
-                yield lb
+            if not errors:
+                while heap:
+                    _, lb = heapq.heappop(heap)
+                    yield lb
         if errors:
+            self._release_abandoned_permits(in_q, out_q, heap, feeder_thread)
             raise errors[0]
+
+    def _release_abandoned_permits(self, in_q, out_q, heap, feeder_thread):
+        """After a fatal pipeline error, permits acquired for batches that
+        will never reach a gradient update (queued, looked-up-but-unyielded,
+        or reordered-but-unyielded) are handed back, so an engine that
+        outlives the error is not permanently throttled."""
+        if self.staleness_sem is None:
+            return
+        import time
+
+        def release_for(batch):
+            if batch.requires_grad:
+                self.staleness_sem.release()
+
+        # heap/out_q first: their permits may be the very ones a blocked
+        # feeder is waiting to acquire — releasing them unblocks it so
+        # the in_q drain below terminates instead of timing out
+        for _, lb in heap:
+            release_for(lb.batch)
+        while True:
+            try:
+                item = out_q.get_nowait()
+            except queue.Empty:
+                break
+            if item is not _SENTINEL:
+                release_for(item[1].batch)
+        deadline = time.monotonic() + 10.0
+        while feeder_thread.is_alive() or not in_q.empty():
+            try:
+                item = in_q.get(timeout=0.2)
+            except queue.Empty:
+                if time.monotonic() > deadline:
+                    break
+                continue
+            if item is not _SENTINEL:
+                release_for(item[1])
 
     def flush(self, timeout: Optional[float] = None):
         self.backward.flush(timeout=timeout)

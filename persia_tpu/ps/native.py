@@ -160,6 +160,10 @@ class NativeEmbeddingHolder:
         self._h = lib.ptps_new(capacity, num_internal_shards)
         self.capacity = capacity
         self.num_internal_shards = num_internal_shards
+        # Mirrors EmbeddingHolder.optimizer being None until registered:
+        # readiness checks (PS _ready -> worker recovery re-arm) must see
+        # an unarmed native holder as NOT ready for training.
+        self.optimizer = None
 
     def __del__(self):
         h = getattr(self, "_h", None)
@@ -179,6 +183,7 @@ class NativeEmbeddingHolder:
         wire = optimizer_config_to_wire(config, feature_index_prefix_bit)
         if self._lib.ptps_register_optimizer(self._h, wire.encode()) != 0:
             raise ValueError(f"native optimizer rejected config {config}")
+        self.optimizer = dict(config)
 
     def lookup(self, signs: np.ndarray, dim: int, training: bool) -> np.ndarray:
         signs = np.ascontiguousarray(signs, dtype=np.uint64)

@@ -57,7 +57,7 @@ class WorkerService:
         s.register("dump", self._dump)
         s.register("load", self._load)
         s.register("staleness", self._staleness)
-        s.register("ready", lambda p: msgpack.packb({"ready": True}))
+        s.register("ready", self._ready)
 
     @property
     def addr(self):
@@ -110,6 +110,18 @@ class WorkerService:
 
     def _staleness(self, payload: bytes) -> bytes:
         return msgpack.packb({"staleness": self.worker.staleness})
+
+    def _ready(self, payload: bytes) -> bytes:
+        """Ready iff every PS replica is serving (the trainer's recovery
+        wait polls this; reference forward.rs:708-715 wait_for_serving)."""
+        try:
+            ready = all(
+                c.ready_for_serving() for c in self.worker.ps_clients
+                if hasattr(c, "ready_for_serving")
+            )
+        except Exception:
+            ready = False
+        return msgpack.packb({"ready": bool(ready)})
 
 
 class RemoteEmbeddingWorker:
@@ -195,6 +207,31 @@ class RemoteEmbeddingWorker:
             for c in self._clients.values()
         )
 
+    def ready_for_serving(self) -> bool:
+        """True iff every worker replica (and through them, every PS)
+        is serving."""
+        try:
+            return all(
+                msgpack.unpackb(c.call("ready"), raw=False)["ready"]
+                for c in self._clients.values()
+            )
+        except Exception:
+            return False
+
+    def wait_for_serving(self, timeout: float = 120.0):
+        """Block until the service tier recovers (reference
+        forward.rs:708-715): poll readiness with backoff."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        delay = 0.1
+        while not self.ready_for_serving():
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"service tier not serving after {timeout}s")
+            time.sleep(delay)
+            delay = min(delay * 2, 2.0)
+
     def dump(self, path: str):
         from persia_tpu.pipeline import flush_backward_engines
 
@@ -239,17 +276,23 @@ def main():
 
     schema = EmbeddingSchema.load(args.embedding_config)
     gc = GlobalConfig.load(args.global_config) if args.global_config else GlobalConfig()
+    ps_resolver = None
     if args.ps_addrs:
         ps_addrs = args.ps_addrs.split(",")
     else:
         coord = CoordinatorClient(args.coordinator)
         ps_addrs = coord.wait_members(ROLE_PS, args.num_ps, timeout=120)
+
+        def ps_resolver():
+            return [PsClient(a) for a in
+                    coord.wait_members(ROLE_PS, args.num_ps, timeout=120)]
     ps_clients = [PsClient(a) for a in ps_addrs]
     worker = EmbeddingWorker(
         schema, ps_clients,
         forward_buffer_size=gc.embedding_worker.forward_buffer_size,
         buffered_data_expired_sec=gc.embedding_worker.buffered_data_expired_sec,
         enable_monitor=args.enable_monitor,
+        ps_resolver=ps_resolver,
     )
     service = WorkerService(worker, args.host, args.port)
     _logger.info("embedding worker %d/%d listening on %s (%d PS)",

@@ -46,9 +46,17 @@ class EmbeddingWorker:
         forward_buffer_size: int = 1000,
         buffered_data_expired_sec: int = 1800,
         enable_monitor: bool = False,
+        ps_resolver=None,
     ):
         self.schema = schema
         self.ps_clients = list(ps_clients)
+        # Re-resolve the PS replica list after connection-level failures
+        # (reference: the worker refreshes its PS client list on RpcError,
+        # embedding_worker_service/mod.rs:1320-1333). A PS that restarts
+        # on a NEW port (local mode, no k8s service DNS) re-registers with
+        # the coordinator; the resolver returns the fresh client list.
+        self._ps_resolver = ps_resolver
+        self._ps_lock = threading.Lock()
         self.replica_size = len(self.ps_clients)
         if self.replica_size == 0:
             raise ValueError("EmbeddingWorker needs at least one PS client")
@@ -97,11 +105,15 @@ class EmbeddingWorker:
                                     admit_probability: float,
                                     weight_bound: float,
                                     enable_weight_bound: bool = True):
+        # remembered so a re-resolved (restarted) PS can be re-armed
+        self._last_configure = (init_method, init_params, admit_probability,
+                                weight_bound, enable_weight_bound)
         for c in self.ps_clients:
             c.configure(init_method, init_params, admit_probability,
                         weight_bound, enable_weight_bound)
 
     def register_optimizer(self, config: dict):
+        self._last_optimizer = config
         for c in self.ps_clients:
             c.register_optimizer(
                 config,
@@ -146,8 +158,16 @@ class EmbeddingWorker:
             item = self._forward_id_buffer.pop(ref_id, None)
         if item is None:
             raise KeyError(f"ref_id {ref_id} not in forward buffer")
-        feats, _ = item
-        result, groups = self._lookup_feats(feats, training)
+        feats, enter_time = item
+        try:
+            result, groups = self._lookup_feats(feats, training)
+        except BaseException:
+            # restore the entry so a retry after PS recovery can still
+            # find its batch (the client's lookup retry contract,
+            # reference forward.rs:708-761)
+            with self._lock:
+                self._forward_id_buffer[ref_id] = (feats, enter_time)
+            raise
         if training:
             with self._lock:
                 # cache the shard groups so the gradient path reuses the
@@ -179,18 +199,20 @@ class EmbeddingWorker:
                 self.monitor.observe(f.name, f.distinct_signs)
         with self._t_preprocess.timer():
             groups = mw.shard_split(feats, self.schema, self.replica_size)
-        with self._t_rpc.timer():
+        def do_lookup():
             if self._fanout is None or len(groups) <= 1:
-                results = [
+                return [
                     self.ps_clients[g.shard].lookup(g.signs, g.dim, training)
                     for g in groups
                 ]
-            else:
-                results = list(self._fanout.map(
-                    lambda g: self.ps_clients[g.shard].lookup(
-                        g.signs, g.dim, training),
-                    groups,
-                ))
+            return list(self._fanout.map(
+                lambda g: self.ps_clients[g.shard].lookup(
+                    g.signs, g.dim, training),
+                groups,
+            ))
+
+        with self._t_rpc.timer():
+            results = self._with_ps_retry(do_lookup)
         with self._t_postprocess.timer():
             mats = mw.scatter_lookup_results(feats, self.schema, groups,
                                              results)
@@ -212,6 +234,19 @@ class EmbeddingWorker:
                 self.staleness -= 1
         if item is None:
             raise KeyError(f"ref_id {ref_id} not in post-forward buffer")
+        try:
+            self._update_gradients_inner(ref_id, item, grads, loss_scale)
+        except BaseException:
+            # restore so the trainer's retry after PS recovery still finds
+            # the batch. Shard groups that already applied before the
+            # failure may re-apply on retry (fresh dedup ids per call) —
+            # a rare, bounded imprecision async sparse SGD tolerates.
+            with self._lock:
+                self._post_forward_buffer[ref_id] = item
+                self.staleness += 1
+            raise
+
+    def _update_gradients_inner(self, ref_id, item, grads, loss_scale):
         feats, fwd_groups, _ = item
         per_feature = []
         for feat in feats:
@@ -225,17 +260,108 @@ class EmbeddingWorker:
             feats, self.schema, per_feature, self.replica_size,
             groups=fwd_groups,
         )
-        if self._fanout is None or len(shard_groups) <= 1:
-            for shard, dim, signs, g in shard_groups:
-                self.ps_clients[shard].update_gradients(signs, g, dim)
-        else:
+
+        def do_update():
+            if self._fanout is None or len(shard_groups) <= 1:
+                for shard, dim, signs, g in shard_groups:
+                    self.ps_clients[shard].update_gradients(signs, g, dim)
+                return
             futures = [
                 self._fanout.submit(
-                    self.ps_clients[shard].update_gradients, signs, g, dim)
+                    lambda s, sg, gd, d: self.ps_clients[s].update_gradients(
+                        sg, gd, d),
+                    shard, signs, g, dim)
                 for shard, dim, signs, g in shard_groups
             ]
             for f in futures:
                 f.result()
+
+        self._with_ps_retry(do_update)
+
+    def _with_ps_retry(self, fn):
+        """Run a PS fan-out, recovering from replica failures
+        (reference mod.rs:1320-1333):
+
+        - connection-level failure (client retries already exhausted):
+          re-resolve the replica list from the coordinator when a
+          resolver exists (restart on a NEW port), else re-arm unready
+          replicas in place (a quick restart on the old address that the
+          client silently redialed), then retry once;
+        - application error (RpcError): a restarted PS serves RPCs again
+          but lost its store config — if any replica reports not-ready,
+          re-arm it and retry once; otherwise the error is genuine and
+          propagates.
+        """
+        from persia_tpu.rpc import RpcError
+
+        try:
+            return fn()
+        except (ConnectionError, OSError):
+            if self._ps_resolver is not None:
+                self._refresh_ps_clients()
+            else:
+                self._rearm_unready_clients()
+            return fn()
+        except RpcError:
+            if not self._rearm_unready_clients():
+                raise
+            return fn()
+
+    def _rearm_unready_clients(self) -> bool:
+        """Re-push the remembered store config + optimizer to replicas
+        that report not-ready (fresh restarts). Healthy replicas are left
+        untouched — re-registering an optimizer replaces its server-side
+        state (e.g. SparseAdam's bias-correction powers), which must
+        never happen to a PS that did not fail. Returns True if any
+        replica was re-armed."""
+        rearmed = False
+        for c in list(self.ps_clients):
+            ready_fn = getattr(c, "ready_for_serving", None)
+            if ready_fn is None:
+                continue
+            try:
+                if ready_fn():
+                    continue
+            except Exception:
+                continue  # still down: transport recovery handles it
+            try:
+                cfg = getattr(self, "_last_configure", None)
+                if cfg is not None:
+                    c.configure(*cfg)
+                opt = getattr(self, "_last_optimizer", None)
+                if opt is not None:
+                    c.register_optimizer(
+                        opt,
+                        feature_index_prefix_bit=(
+                            self.schema.feature_index_prefix_bit),
+                    )
+                rearmed = True
+                _logger.warning("re-armed restarted PS %s",
+                                getattr(c, "addr", c))
+            except Exception as e:
+                _logger.warning("re-arm of %s failed: %s",
+                                getattr(c, "addr", c), e)
+        return rearmed
+
+    def _refresh_ps_clients(self):
+        new_clients = list(self._ps_resolver())
+        if len(new_clients) != self.replica_size:
+            raise RuntimeError(
+                f"PS re-resolution returned {len(new_clients)} replicas, "
+                f"expected {self.replica_size} (shard routing would change)"
+            )
+        with self._ps_lock:
+            old_clients = self.ps_clients
+            self.ps_clients = new_clients
+        for c in old_clients:
+            close = getattr(getattr(c, "client", None), "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:
+                    pass
+        _logger.warning("refreshed PS client list after connection failure")
+        self._rearm_unready_clients()
 
     # --- checkpoint fan-out ----------------------------------------------
 

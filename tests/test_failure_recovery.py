@@ -1,0 +1,330 @@
+"""Pipeline failure recovery: the training loop must survive parameter-
+server failures the way the reference does (forward workers block on
+wait_for_serving and retry, forward.rs:708-761; the embedding worker
+refreshes its PS client list on RpcError, mod.rs:1320-1333) — and no
+error path may leak a staleness permit.
+"""
+
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from persia_tpu.config import EmbeddingSchema, uniform_slots
+from persia_tpu.data.batch import IDTypeFeature, PersiaBatch
+from persia_tpu.pipeline import BackwardEngine, ForwardEngine
+from persia_tpu.rpc import RpcError
+
+pytestmark = pytest.mark.filterwarnings("ignore::ResourceWarning")
+
+REPO = Path(__file__).resolve().parent.parent
+DIM = 4
+STALENESS = 2
+
+
+def _batch(seed: int, bs: int = 8, requires_grad: bool = True):
+    rng = np.random.default_rng(seed)
+    feats = [
+        IDTypeFeature(name, [
+            rng.integers(0, 1000, size=2).astype(np.uint64)
+            for _ in range(bs)
+        ])
+        for name in ("slot_a", "slot_b")
+    ]
+    return PersiaBatch(feats, requires_grad=requires_grad)
+
+
+class _FlakyWorker:
+    """In-memory worker double: lookups fail `fail_times` times with a
+    transient error, then serve zeros. Tracks wait_for_serving calls."""
+
+    def __init__(self, fail_times: int = 0, fail_updates: int = 0,
+                 persistent: bool = False):
+        self.fail_times = fail_times
+        self.fail_updates = fail_updates
+        self.persistent = persistent
+        self.waits = 0
+        self.lookups = 0
+        self.updates = 0
+        self._refs = {}
+        self._next = 1
+
+    def wait_for_serving(self, timeout=None):
+        self.waits += 1
+
+    def put_batch(self, feats):
+        ref = self._next
+        self._next += 1
+        self._refs[ref] = feats
+        return ref
+
+    def lookup(self, ref, training=True):
+        self.lookups += 1
+        if self.persistent or self.fail_times > 0:
+            self.fail_times -= 1
+            raise RpcError("synthetic PS outage")
+        feats = self._refs.pop(ref)
+        return {
+            f.name: SimpleNamespace(
+                embeddings=np.zeros((f.batch_size, DIM), np.float32))
+            for f in feats
+        }
+
+    def update_gradients(self, ref, grads, loss_scale=1.0):
+        self.updates += 1
+        if self.fail_updates > 0:
+            self.fail_updates -= 1
+            raise RpcError("synthetic PS outage during update")
+
+
+def test_forward_retry_recovers_after_transient_failure():
+    """Two failed lookups -> wait_for_serving -> retry -> success; the
+    batch trains and no permit is lost."""
+    w = _FlakyWorker(fail_times=2)
+    engine = ForwardEngine(SimpleNamespace(worker=w), num_workers=1,
+                           embedding_staleness=STALENESS)
+    out = list(engine.run(iter([_batch(1)])))
+    assert len(out) == 1
+    assert w.waits == 2
+    engine.backward.submit(out[0].ref_id, {
+        "slot_a": np.zeros((8, DIM), np.float32),
+        "slot_b": np.zeros((8, DIM), np.float32),
+    })
+    engine.flush()
+    assert engine.staleness_sem._value == STALENESS
+    engine.shutdown()
+
+
+def test_forward_engine_releases_permits_on_unrecoverable_error():
+    """A persistent failure aborts the iteration — but every staleness
+    permit (failed batch, queued batches, looked-up-but-unyielded
+    batches) is handed back (round-3 leak: pipeline.py:281-284)."""
+    w = _FlakyWorker(persistent=True)
+    engine = ForwardEngine(SimpleNamespace(worker=w), num_workers=2,
+                           embedding_staleness=STALENESS)
+    batches = [_batch(s) for s in range(6)]
+    with pytest.raises(RpcError):
+        list(engine.run(iter(batches)))
+    deadline = time.monotonic() + 5
+    while engine.staleness_sem._value < STALENESS and \
+            time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert engine.staleness_sem._value == STALENESS
+    engine.shutdown()
+
+
+def test_backward_retry_recovers_and_releases_permit():
+    """Gradient updates retry through recovery; the permit releases
+    exactly once after the update finally lands."""
+    w = _FlakyWorker(fail_updates=2)
+    sem = threading.Semaphore(STALENESS)
+    sem.acquire()
+    engine = BackwardEngine(w, num_workers=1, staleness_sem=sem)
+    engine.submit(1, {"slot_a": np.zeros((8, DIM), np.float32)})
+    engine.flush(timeout=30)
+    assert w.updates == 3  # 2 failures + 1 success
+    assert w.waits == 2
+    assert sem._value == STALENESS
+    engine.shutdown()
+
+
+@pytest.fixture
+def manual_cluster(tmp_path):
+    """Coordinator + 1 Python PS + 1 Python worker as raw subprocesses
+    (no ServiceCtx: its crash monitor would tear the group down on the
+    deliberate PS kill)."""
+    import yaml
+
+    from persia_tpu.service.coordinator import (
+        ROLE_PS,
+        ROLE_WORKER,
+        CoordinatorClient,
+    )
+    from persia_tpu.service.helper import _schema_to_yaml_dict
+    from persia_tpu.utils import find_free_port
+
+    schema = EmbeddingSchema(
+        slots_config=uniform_slots(["slot_a", "slot_b"], dim=DIM))
+    schema_path = tmp_path / "schema.yml"
+    yaml.safe_dump(_schema_to_yaml_dict(schema), schema_path.open("w"))
+
+    env = {"PYTHONPATH": str(REPO)}
+    import os
+
+    env = {**os.environ, **env}
+    coord_port = find_free_port()
+    procs = []
+
+    def spawn(args):
+        p = subprocess.Popen([sys.executable, "-m", *args], env=env)
+        procs.append(p)
+        return p
+
+    def spawn_ps():
+        return spawn(["persia_tpu.service.ps_service",
+                      "--coordinator", f"127.0.0.1:{coord_port}",
+                      "--replica-index", "0"])
+
+    spawn(["persia_tpu.service.coordinator", "--port", str(coord_port)])
+    coord = CoordinatorClient(f"127.0.0.1:{coord_port}")
+    deadline = time.monotonic() + 60
+    while not coord.ping():
+        assert time.monotonic() < deadline
+        time.sleep(0.05)
+    ps_proc = spawn_ps()
+    spawn(["persia_tpu.service.worker_service",
+           "--coordinator", f"127.0.0.1:{coord_port}",
+           "--num-ps", "1",
+           "--embedding-config", str(schema_path)])
+    coord.wait_members(ROLE_PS, 1, timeout=60)
+    worker_addrs = coord.wait_members(ROLE_WORKER, 1, timeout=60)
+    try:
+        yield SimpleNamespace(schema=schema, worker_addrs=worker_addrs,
+                              ps_proc=ps_proc, spawn_ps=spawn_ps,
+                              coord=coord)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            p.wait(timeout=10)
+
+
+def test_native_worker_rearms_ps_restarted_on_same_port(tmp_path):
+    """All-native tier: kill the C++ PS, restart it on the SAME port
+    (the k8s-service DNS case). The C++ worker detects the unready
+    replica on the next data-plane failure, re-pushes the cached
+    configure/register payloads, and retries — the trainer's call
+    succeeds transparently."""
+    import os
+
+    import yaml
+
+    from persia_tpu.service.helper import _schema_to_yaml_dict
+    from persia_tpu.service.worker_service import RemoteEmbeddingWorker
+    from persia_tpu.utils import find_free_port, resolve_binary_path
+
+    try:
+        ps_bin = resolve_binary_path("persia-embedding-ps")
+        w_bin = resolve_binary_path("persia-embedding-worker")
+    except FileNotFoundError:
+        pytest.skip("native binaries not built")
+
+    schema = EmbeddingSchema(
+        slots_config=uniform_slots(["slot_a", "slot_b"], dim=DIM))
+    schema_path = tmp_path / "schema.yml"
+    yaml.safe_dump(_schema_to_yaml_dict(schema), schema_path.open("w"))
+    ps_port = find_free_port()
+    w_port = find_free_port()
+    procs = []
+
+    def spawn_ps():
+        p = subprocess.Popen(
+            [ps_bin, "--port", str(ps_port), "--capacity", "100000",
+             "--num-shards", "2"], env=os.environ)
+        procs.append(p)
+        return p
+
+    def wait_ps_up(timeout=30):
+        from persia_tpu.service.ps_service import PsClient
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                PsClient(f"127.0.0.1:{ps_port}").ready_for_serving()
+                return
+            except Exception:
+                time.sleep(0.1)
+        raise TimeoutError("PS did not come up")
+
+    ps = spawn_ps()
+    wait_ps_up()
+    procs.append(subprocess.Popen(
+        [w_bin, "--port", str(w_port), "--embedding-config",
+         str(schema_path), "--ps-addrs", f"127.0.0.1:{ps_port}"],
+        env=os.environ))
+    try:
+        w = RemoteEmbeddingWorker([f"127.0.0.1:{w_port}"])
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            try:
+                w.staleness
+                break
+            except Exception:
+                time.sleep(0.1)
+        w.configure_parameter_servers(
+            "bounded_uniform", {"lower": -0.1, "upper": 0.1}, 1.0, 10.0)
+        w.register_optimizer({"type": "sgd", "lr": 0.1, "wd": 0.0})
+        feats = [IDTypeFeature("slot_a", [np.array([1, 2], np.uint64)])]
+        ref, res = w.lookup_direct_training(feats)
+        w.update_gradients(ref, {
+            "slot_a": np.ones((1, DIM), np.float32)})
+
+        ps.kill()
+        ps.wait(timeout=10)
+        spawn_ps()
+        wait_ps_up()
+
+        # one client call: the worker re-arms the blank PS and retries
+        ref2, res2 = w.lookup_direct_training(feats)
+        assert res2["slot_a"].embeddings.shape == (1, DIM)
+        w.update_gradients(ref2, {
+            "slot_a": np.ones((1, DIM), np.float32)})
+        assert w.staleness == 0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            p.wait(timeout=10)
+
+
+def test_training_survives_ps_kill_and_restart(manual_cluster):
+    """Kill the only PS mid-training; restart it on a NEW port. The
+    worker re-resolves the replica list from the coordinator, re-arms
+    the store config/optimizer, and the pipeline finishes every batch
+    with zero leaked permits (reference forward.rs:708-761 +
+    mod.rs:1320-1333)."""
+    from persia_tpu.service.worker_service import RemoteEmbeddingWorker
+
+    mc = manual_cluster
+    w = RemoteEmbeddingWorker(mc.worker_addrs)
+    w.configure_parameter_servers(
+        "bounded_uniform", {"lower": -0.1, "upper": 0.1}, 1.0, 10.0)
+    w.register_optimizer({"type": "sgd", "lr": 0.1, "wd": 0.0})
+
+    engine = ForwardEngine(SimpleNamespace(worker=w), num_workers=2,
+                           embedding_staleness=STALENESS)
+    total = 8
+    killed = threading.Event()
+
+    def batches():
+        for s in range(total):
+            if s == 3 and not killed.is_set():
+                mc.ps_proc.kill()
+                mc.ps_proc.wait(timeout=10)
+                # restart on a NEW free port; it re-registers replica 0
+                # with the coordinator
+                mc.spawn_ps()
+                killed.set()
+            yield _batch(100 + s)
+
+    seen = 0
+    for lb in engine.run(batches()):
+        grads = {
+            name: np.ones_like(r.embeddings)
+            for name, r in lb.lookup.items()
+        }
+        engine.backward.submit(lb.ref_id, grads)
+        seen += 1
+    engine.flush(timeout=120)
+    assert killed.is_set()
+    assert seen == total
+    assert engine.staleness_sem._value == STALENESS
+    assert w.staleness == 0
+    engine.shutdown()
