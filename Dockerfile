@@ -8,14 +8,13 @@ RUN apt-get update && apt-get install -y --no-install-recommends \
         g++ make libzstd-dev \
     && rm -rf /var/lib/apt/lists/*
 
-RUN pip install --no-cache-dir \
-        "jax" "flax" "optax" "chex" "einops" \
-        numpy pyyaml msgpack zstandard ml_dtypes
-
 WORKDIR /workspace
+COPY pyproject.toml README.md ./
 COPY persia_tpu/ persia_tpu/
 COPY native/ native/
 COPY examples/ examples/
-RUN make -C native -j"$(nproc)"
-
-ENV PYTHONPATH=/workspace
+# build + stage native binaries into persia_tpu/native_bin, then install
+# the package with pinned deps and console scripts (persia-tpu-launcher,
+# persia-tpu-ps, persia-tpu-worker, ...)
+RUN make -C native -j"$(nproc)" install \
+    && pip install --no-cache-dir .

@@ -263,6 +263,111 @@ def bench_worker_service(batch_size, steps, native_worker, n_ps=2, dim=DIM):
     return steps * batch_size / elapsed
 
 
+def _rss_bytes() -> int:
+    import os
+
+    with open("/proc/self/statm") as f:
+        return int(f.read().split()[1]) * os.sysconf("SC_PAGESIZE")
+
+
+def bench_store(entries: int, dim: int = 16, shards: int = 64,
+                batch: int = 262_144):
+    """DRAM-scale store stress (BASELINE config 5 shape): fill to
+    ``entries`` (== capacity), measuring insert rate as the table grows,
+    bytes/entry at full size, hit-lookup and update ns/sign at scale,
+    then push 20% past capacity to measure LRU-eviction-path inserts and
+    verify eviction correctness (evicted signs eval-read as zeros,
+    survivors keep their updated values).
+
+    Reference default capacity is 1e9 entries
+    (rust/persia-embedding-config/src/lib.rs:417-457); the projection
+    line extrapolates bytes/entry to the 100B-param config-5 target."""
+    from persia_tpu.ps.native import NativeEmbeddingHolder
+
+    h = NativeEmbeddingHolder(capacity=entries, num_internal_shards=shards)
+    h.configure("bounded_uniform", {"lower": -0.01, "upper": 0.01})
+    h.register_optimizer({
+        "type": "adagrad", "lr": 0.02, "initial_accumulator_value": 0.1,
+        "g_square_momentum": 1.0, "vectorwise_shared": False,
+    })
+    rss0 = _rss_bytes()
+    rng = np.random.default_rng(0)
+
+    def fill_chunk(lo, hi):
+        signs = np.arange(lo, hi, dtype=np.uint64)
+        rng.shuffle(signs)
+        t0 = time.perf_counter()
+        for a in range(0, len(signs), batch):
+            h.lookup(signs[a:a + batch], dim, True)
+        return (time.perf_counter() - t0) / len(signs) * 1e9
+
+    marks = [int(entries * f) for f in (0.1, 0.5, 0.9, 1.0)]
+    lo = 1
+    insert_ns = []
+    for m in marks:
+        ns = fill_chunk(lo, m + 1)
+        insert_ns.append(ns)
+        log(f"store: fill to {m:,} entries — insert {ns:.0f} ns/sign")
+        lo = m + 1
+    n_filled = len(h)
+    bytes_per_entry = (_rss_bytes() - rss0) / max(n_filled, 1)
+    log(f"store: {n_filled:,} entries resident, {bytes_per_entry:.0f} "
+        f"bytes/entry (dim={dim} f32 + adagrad state + index/LRU links)")
+
+    # steady-state at scale. Hot traffic stays in the upper half of the
+    # keyspace so the low-range "victim" signs below keep their
+    # oldest-LRU position for the eviction check.
+    hot = rng.integers(entries // 2, entries,
+                       size=min(batch, entries // 4)).astype(np.uint64)
+    h.lookup(hot, dim, True)  # warm
+    t0 = time.perf_counter()
+    h.lookup(hot, dim, True)
+    hit_ns = (time.perf_counter() - t0) / len(hot) * 1e9
+    grads = np.ones((len(hot), dim), np.float32)
+    t0 = time.perf_counter()
+    h.update_gradients(hot, grads, dim)
+    update_ns = (time.perf_counter() - t0) / len(hot) * 1e9
+    del grads
+    log(f"store: at {n_filled:,} entries — hit {hit_ns:.0f} ns/sign, "
+        f"update {update_ns:.0f} ns/sign")
+
+    # eviction: mark victims + survivors, then blow 20% past capacity
+    victims = np.arange(1, 1 + 1024, dtype=np.uint64)
+    survivors = hot[:1024]
+    h.update_gradients(survivors, np.full((1024, dim), 5.0, np.float32), dim)
+    before = h.lookup(survivors, dim, False).copy()
+    extra = np.arange(entries + 1, entries + 1 + entries // 5,
+                      dtype=np.uint64)
+    t0 = time.perf_counter()
+    for a in range(0, len(extra), batch):
+        h.lookup(extra[a:a + batch], dim, True)
+    evict_ns = (time.perf_counter() - t0) / len(extra) * 1e9
+    size_after = len(h)
+    log(f"store: insert-at-capacity (LRU eviction path) {evict_ns:.0f} "
+        f"ns/sign; size {size_after:,} (capacity {entries:,})")
+    if size_after > entries:
+        raise AssertionError("store exceeded capacity — eviction broken")
+    # victims (cold, never touched since fill) must be gone; survivors
+    # (recently updated) must keep their values. Eval lookups zero-fill
+    # missing entries, which discriminates the two.
+    victim_vals = h.lookup(victims, dim, False)
+    survivor_vals = h.lookup(survivors, dim, False)
+    if not (victim_vals == 0).all():
+        raise AssertionError("cold entries not evicted first (LRU broken)")
+    if not np.array_equal(survivor_vals, before):
+        raise AssertionError("recently-used entries were evicted (LRU broken)")
+    log("store: LRU eviction correct (cold evicted, hot retained)")
+
+    # projection to the 100B-param config-5 shape
+    target_entries = 100e9 / dim
+    total_gb = target_entries * bytes_per_entry / 1e9
+    log(f"store: projection — 100B params at dim {dim} = "
+        f"{target_entries / 1e9:.2f}B entries x {bytes_per_entry:.0f} B "
+        f"= {total_gb / 1e3:.1f} TB total; across 32 PS shards = "
+        f"{total_gb / 32:.0f} GB/node resident")
+    return 1e9 / hit_ns  # hit lookups per second per core
+
+
 def bench_wire(batch_size, steps):
     """Serialization microbench (analogue of the reference's
     persia-common-benchmark criterion suite): PTB2 batch round trip +
@@ -371,8 +476,10 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--mode",
                    choices=["hybrid", "device", "wire", "worker",
-                            "worker-svc"],
+                            "worker-svc", "store"],
                    default="hybrid")
+    p.add_argument("--entries", type=int, default=10_000_000,
+                   help="store mode: fill target (== capacity)")
     p.add_argument("--batch-size", type=int, default=4096)
     p.add_argument("--steps", type=int, default=30)
     p.add_argument("--warmup", type=int, default=5)
@@ -390,6 +497,7 @@ def main():
         "wire": ("ptb2_serialize_gb_per_sec", "GB/sec"),
         "worker": ("worker_cycle_samples_per_sec_core", "samples/sec"),
         "worker-svc": ("worker_service_samples_per_sec_core", "samples/sec"),
+        "store": ("store_hit_lookups_per_sec_core", "lookups/sec"),
     }[args.mode]
 
     # Two-tier watchdog. Tier 1 (threading.Timer) emits the diagnostic
@@ -412,7 +520,7 @@ def main():
     if args.smoke:
         args.batch_size, args.steps, args.warmup = 256, 3, 1
 
-    if args.mode not in ("wire", "worker", "worker-svc"):  # host-only modes skip jax
+    if args.mode not in ("wire", "worker", "worker-svc", "store"):  # host-only modes skip jax
         import os
 
         forced = os.environ.get("PERSIA_FORCE_JAX_PLATFORM")
@@ -441,6 +549,9 @@ def main():
         value = bench_worker_service(args.batch_size, max(args.steps, 5),
                                      native_worker=True)
         log(f"worker-svc: native/python speedup {value / py:.2f}x")
+        vs_baseline = 1.0
+    elif args.mode == "store":
+        value = bench_store(100_000 if args.smoke else args.entries)
         vs_baseline = 1.0
     elif args.mode == "wire":
         value = bench_wire(args.batch_size, max(args.steps, 5))

@@ -9,7 +9,10 @@ Run under the launcher with a coordinator + workers + trainers up:
 import argparse
 import sys
 
-sys.path.insert(0, __file__.rsplit("/examples/", 1)[0])
+try:  # prefer the installed package (pip install -e .)
+    import persia_tpu  # noqa: F401
+except ImportError:  # bare checkout fallback
+    sys.path.insert(0, __file__.rsplit("/examples/", 1)[0])
 sys.path.insert(0, __file__.rsplit("/data_loader.py", 1)[0])
 
 from persia_tpu.ctx import DataCtx

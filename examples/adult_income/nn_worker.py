@@ -12,7 +12,10 @@ import argparse
 import os
 import sys
 
-sys.path.insert(0, __file__.rsplit("/examples/", 1)[0])
+try:  # prefer the installed package (pip install -e .)
+    import persia_tpu  # noqa: F401
+except ImportError:  # bare checkout fallback
+    sys.path.insert(0, __file__.rsplit("/examples/", 1)[0])
 sys.path.insert(0, __file__.rsplit("/nn_worker.py", 1)[0])
 
 if os.environ.get("PERSIA_FORCE_JAX_PLATFORM"):

@@ -15,6 +15,7 @@
 
 #include <algorithm>
 #include <atomic>
+#include <condition_variable>
 #include <cstdint>
 #include <cstring>
 #include <list>
@@ -22,6 +23,7 @@
 #include <stdexcept>
 #include <string>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 #include "msgpack_lite.h"
@@ -326,31 +328,62 @@ class DedupCache {
   explicit DedupCache(size_t cap = 8192, size_t max_bytes = 256u << 20)
       : cap_(cap), max_bytes_(max_bytes) {}
 
-  // Returns true and fills *resp if the id was already served.
-  bool lookup(const std::string& id, std::string* resp) {
-    std::lock_guard<std::mutex> lk(mu_);
-    auto it = index_.find(id);
-    if (it == index_.end()) return false;
-    *resp = it->second->second;
-    return true;
+  // At-most-once begin: returns true with *resp filled if the id was
+  // already served. Returns false when the caller must execute the
+  // handler (then call complete() or abort()). A duplicate delivery of
+  // an id whose FIRST execution is still running BLOCKS here until that
+  // execution finishes — running it concurrently would observe
+  // half-updated state (e.g. a popped buffer entry). If the original
+  // errored (abort), nothing is cached and the duplicate executes —
+  // safe, because the failed execution restored what it consumed.
+  bool begin(const std::string& id, std::string* resp) {
+    std::unique_lock<std::mutex> lk(mu_);
+    for (;;) {
+      auto it = index_.find(id);
+      if (it != index_.end()) {
+        *resp = it->second->second;
+        return true;
+      }
+      if (!inflight_.count(id)) {
+        inflight_.insert(id);
+        return false;
+      }
+      cv_.wait(lk);
+    }
   }
 
-  void store(const std::string& id, const std::string& resp) {
-    std::lock_guard<std::mutex> lk(mu_);
-    if (index_.count(id)) return;
-    order_.emplace_back(id, resp);
-    index_[id] = std::prev(order_.end());
-    bytes_ += resp.size();
-    while (order_.size() > cap_ || (bytes_ > max_bytes_ && order_.size() > 1)) {
-      bytes_ -= order_.front().second.size();
-      index_.erase(order_.front().first);
-      order_.pop_front();
+  void complete(const std::string& id, const std::string& resp) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      inflight_.erase(id);
+      if (!index_.count(id)) {
+        order_.emplace_back(id, resp);
+        index_[id] = std::prev(order_.end());
+        bytes_ += resp.size();
+        while (order_.size() > cap_ ||
+               (bytes_ > max_bytes_ && order_.size() > 1)) {
+          bytes_ -= order_.front().second.size();
+          index_.erase(order_.front().first);
+          order_.pop_front();
+        }
+      }
     }
+    cv_.notify_all();
+  }
+
+  void abort(const std::string& id) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      inflight_.erase(id);
+    }
+    cv_.notify_all();
   }
 
  private:
   size_t cap_, max_bytes_, bytes_ = 0;
   std::mutex mu_;
+  std::condition_variable cv_;
+  std::unordered_set<std::string> inflight_;
   std::list<std::pair<std::string, std::string>> order_;
   std::unordered_map<std::string,
                      std::list<std::pair<std::string, std::string>>::iterator>

@@ -299,9 +299,16 @@ void serve_conn(PsServer* server, int fd) {
            msg.env.arr[1].kind == mp::Value::kStr))
         req_id = &msg.env.arr[1].s;
       std::string result;
-      if (req_id == nullptr || !g_dedup.lookup(*req_id, &result)) {
+      if (req_id == nullptr) {
         result = server->dispatch(method, msg.payload);
-        if (req_id != nullptr) g_dedup.store(*req_id, result);
+      } else if (!g_dedup.begin(*req_id, &result)) {
+        try {
+          result = server->dispatch(method, msg.payload);
+        } catch (...) {
+          g_dedup.abort(*req_id);
+          throw;
+        }
+        g_dedup.complete(*req_id, result);
       }
       net::send_ok(fd, result, compress);
     } catch (const std::exception& e) {

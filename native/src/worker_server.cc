@@ -720,9 +720,16 @@ void serve_conn(WorkerServer* server, int fd) {
            msg.env.arr[1].kind == mp::Value::kStr))
         req_id = &msg.env.arr[1].s;
       std::string result;
-      if (req_id == nullptr || !server->dedup.lookup(*req_id, &result)) {
+      if (req_id == nullptr) {
         result = server->dispatch(method, msg.payload);
-        if (req_id != nullptr) server->dedup.store(*req_id, result);
+      } else if (!server->dedup.begin(*req_id, &result)) {
+        try {
+          result = server->dispatch(method, msg.payload);
+        } catch (...) {
+          server->dedup.abort(*req_id);
+          throw;
+        }
+        server->dedup.complete(*req_id, result);
       }
       net::send_ok(fd, result, compress);
     } catch (const BufferFull& e) {

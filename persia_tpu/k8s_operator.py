@@ -368,6 +368,16 @@ class SchedulingServer:
                     if route == "/apply":
                         n = int(self.headers.get("Content-Length", 0))
                         spec = json.loads(self.rfile.read(n))
+                        # validate BEFORE track: an invalid spec must not
+                        # stay tracked, or the reconcile loop re-raises on
+                        # every interval until a manual /delete
+                        from persia_tpu.k8s_utils import validate_spec
+
+                        try:
+                            validate_spec(spec)
+                        except Exception as e:
+                            self._send(400, {"error": repr(e)})
+                            return
                         op.track(spec)
                         stats = op.reconcile_job(spec)
                         self._send(200, {"job": spec["jobName"],

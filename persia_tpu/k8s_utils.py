@@ -272,18 +272,123 @@ def gen_crd() -> dict:
     }
 
 
+def _validate_structural(manifest: dict) -> List[str]:
+    """Fallback schema checks when kubectl is absent: the structural
+    invariants `kubectl apply --dry-run=client` would reject."""
+    errs = []
+    name = manifest.get("metadata", {}).get("name", "?")
+    where = f"{manifest.get('kind', '?')}/{name}"
+    for key in ("apiVersion", "kind"):
+        if not manifest.get(key):
+            errs.append(f"{where}: missing {key}")
+    meta = manifest.get("metadata")
+    if not isinstance(meta, dict) or not meta.get("name"):
+        errs.append(f"{where}: missing metadata.name")
+    elif not all(c.isalnum() or c in "-." for c in meta["name"]) or \
+            meta["name"] != meta["name"].lower():
+        errs.append(f"{where}: invalid DNS-1123 name {meta['name']!r}")
+    kind = manifest.get("kind")
+    spec = manifest.get("spec", {})
+    if kind == "Pod":
+        containers = spec.get("containers")
+        if not isinstance(containers, list) or not containers:
+            errs.append(f"{where}: Pod needs spec.containers")
+        else:
+            for c in containers:
+                if not c.get("name") or not c.get("image"):
+                    errs.append(f"{where}: container needs name + image")
+                if "command" in c and not isinstance(c["command"], list):
+                    errs.append(f"{where}: command must be a list")
+                for e in c.get("env", []):
+                    if not isinstance(e.get("value", ""), str):
+                        errs.append(
+                            f"{where}: env {e.get('name')} value must be a "
+                            f"string, got {type(e.get('value')).__name__}")
+    elif kind == "Service":
+        if not spec.get("ports"):
+            errs.append(f"{where}: Service needs spec.ports")
+        if not spec.get("selector"):
+            errs.append(f"{where}: Service needs spec.selector")
+    elif kind == "CustomResourceDefinition":
+        names = spec.get("names", {})
+        if not (spec.get("group") and spec.get("versions") and
+                names.get("plural") and names.get("kind")):
+            errs.append(f"{where}: CRD needs group/versions/names")
+        if meta and meta.get("name") != \
+                f"{names.get('plural')}.{spec.get('group')}":
+            errs.append(f"{where}: CRD name must be <plural>.<group>")
+    return errs
+
+
+def _validate_all_structural(manifests: List[dict]) -> None:
+    errs = [e for m in manifests for e in _validate_structural(m)]
+    if errs:
+        raise ValueError("manifest validation failed:\n" +
+                         "\n".join(f"  - {e}" for e in errs))
+
+
+def validate_manifests(manifests: List[dict],
+                       kubectl: str = "kubectl") -> None:
+    """Validate rendered manifests before they near a cluster: through
+    ``kubectl apply --dry-run=client`` when the CLI exists (the intent of
+    the reference's e2e harness, k8s/src/bin/e2e.rs:13-17), else through
+    the structural checks. Raises ValueError with every problem found.
+
+    kubectl with no reachable cluster/kubeconfig fails for connectivity
+    reasons, not manifest reasons — that case falls back to the
+    structural checks instead of rejecting valid manifests."""
+    import shutil
+    import subprocess
+
+    if shutil.which(kubectl):
+        doc = yaml.safe_dump_all(manifests, sort_keys=False)
+        proc = subprocess.run(
+            [kubectl, "apply", "--dry-run=client", "--validate=true",
+             "-o", "name", "-f", "-"],
+            input=doc, capture_output=True, text=True,
+        )
+        if proc.returncode == 0:
+            return
+        stderr = proc.stderr.strip()
+        connectivity = any(tok in stderr.lower() for tok in (
+            "connection refused", "unable to connect", "dial tcp",
+            "no configuration has been provided", "missing or incomplete",
+            "failed to download openapi", "cluster unreachable",
+            "no such host",
+        ))
+        if not connectivity:
+            raise ValueError(
+                f"kubectl client dry-run rejected manifests:\n{stderr}")
+        # fall through: kubectl present but no cluster — structural checks
+    _validate_all_structural(manifests)
+
+
+def validate_spec(spec: dict) -> List[dict]:
+    """Render a job spec and structurally validate every manifest (no
+    kubectl/cluster dependence — what the REST /apply pre-check needs).
+    Returns the rendered manifests; raises on any problem."""
+    manifests = gen_manifests(spec)
+    _validate_all_structural(manifests)
+    return manifests
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(prog="persia-tpu-k8s")
-    p.add_argument("action", choices=["gen", "gencrd"])
+    p.add_argument("action", choices=["gen", "gencrd", "validate"])
     p.add_argument("job_yaml", nargs="?")
     args = p.parse_args(argv)
     if args.action == "gencrd":
         yaml.safe_dump(gen_crd(), sys.stdout, sort_keys=False)
         return
     if not args.job_yaml:
-        p.error("gen requires a job YAML file")
+        p.error(f"{args.action} requires a job YAML file")
     spec = load_yaml(args.job_yaml)
-    yaml.safe_dump_all(gen_manifests(spec), sys.stdout, sort_keys=False)
+    manifests = gen_manifests(spec)
+    if args.action == "validate":
+        validate_manifests(manifests + [gen_crd()])
+        print(f"ok: {len(manifests)} manifests + CRD valid")
+        return
+    yaml.safe_dump_all(manifests, sys.stdout, sort_keys=False)
 
 
 if __name__ == "__main__":

@@ -222,8 +222,9 @@ def make_packed_train_step_ddp(
     label) -> (state, loss, flat_grads, pred)`` with ``flat_grads``
     batch-major ``(batch, sum(slot_dims))`` in the wire dtype.
     """
-    from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from persia_tpu.parallel.ring_attention import _shard_map
 
     bounds = np.concatenate([[0], np.cumsum(slot_dims)]).tolist()
     data_spec = P("data")
@@ -283,11 +284,10 @@ def make_packed_train_step_ddp(
         flat_grads = jnp.concatenate(emb_grads, axis=1).astype(wire_dtype)
         return new_state, loss, flat_grads, pred
 
-    sharded = shard_map(
-        local_step, mesh=mesh,
+    sharded = _shard_map(
+        local_step, mesh,
         in_specs=(rep, data_spec, data_spec, data_spec),
         out_specs=(rep, rep, data_spec, data_spec),
-        check_rep=False,
     )
     return jax.jit(sharded, donate_argnums=(0,))
 

@@ -141,6 +141,12 @@ class RpcServer:
     the cached response for retried deliveries, so non-idempotent methods
     (gradient updates, forward-buffer ingestion) survive client retries
     without double-applying.
+
+    Caveat: the id cache is in-memory per server process. A retry that
+    lands after the server restarted re-executes the method — dedup is
+    at-most-once per server incarnation, NOT exactly-once across
+    restarts. Restart recovery instead relies on the worker tiers'
+    restore-on-failure + re-arm paths (worker.py / worker_server.cc).
     """
 
     DEDUP_CACHE_SIZE = 8192
@@ -156,6 +162,12 @@ class RpcServer:
         self._dedup: "OrderedDict[bytes, bytes]" = OrderedDict()
         self._dedup_bytes = 0
         self._dedup_lock = threading.Lock()
+        # ids whose FIRST execution is still running: a client whose
+        # socket timed out re-sends the same id on a fresh connection
+        # while the original handler is still working; the duplicate
+        # must wait for that execution, not run concurrently (it would
+        # observe half-updated state, e.g. a popped buffer entry)
+        self._inflight: Dict[bytes, threading.Event] = {}
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -182,7 +194,10 @@ class RpcServer:
         self._accept_loop()
 
     def _accept_loop(self):
-        self._sock.settimeout(0.5)
+        try:
+            self._sock.settimeout(0.5)
+        except OSError:
+            return  # stop() closed the socket before the loop started
         while self._running:
             try:
                 conn, _ = self._sock.accept()
@@ -214,22 +229,10 @@ class RpcServer:
                     handler = self._handlers.get(method)
                     if handler is None:
                         raise RpcError(f"no such method {method!r}")
-                    result = None
-                    if req_id is not None:
-                        with self._dedup_lock:
-                            result = self._dedup.get(req_id)
-                    if result is None:
+                    if req_id is None:
                         result = handler(payload)
-                        if req_id is not None:
-                            with self._dedup_lock:
-                                self._dedup[req_id] = result
-                                self._dedup_bytes += len(result)
-                                while len(self._dedup) > self.DEDUP_CACHE_SIZE or (
-                                    self._dedup_bytes > self.DEDUP_CACHE_BYTES
-                                    and len(self._dedup) > 1
-                                ):
-                                    _, old = self._dedup.popitem(last=False)
-                                    self._dedup_bytes -= len(old)
+                    else:
+                        result = self._execute_once(handler, payload, req_id)
                     _send_msg(conn, ["ok"], result, compress)
                 except BaseException as e:
                     try:
@@ -237,6 +240,42 @@ class RpcServer:
                                   b"", False)
                     except OSError:
                         return
+
+    def _execute_once(self, handler, payload: bytes, req_id: bytes) -> bytes:
+        """At-most-once execution for an id, including the concurrent
+        window: a duplicate delivery waits for the in-flight original
+        and returns its cached result. If the original ERRORED, nothing
+        is cached and the duplicate executes itself — safe, because the
+        failed execution restored any state it consumed."""
+        while True:
+            with self._dedup_lock:
+                cached = self._dedup.get(req_id)
+                if cached is not None:
+                    return cached
+                ev = self._inflight.get(req_id)
+                if ev is None:
+                    self._inflight[req_id] = mine = threading.Event()
+                    break
+            ev.wait(timeout=600.0)
+        try:
+            result = handler(payload)
+        except BaseException:
+            with self._dedup_lock:
+                self._inflight.pop(req_id, None)
+            mine.set()
+            raise
+        with self._dedup_lock:
+            self._dedup[req_id] = result
+            self._dedup_bytes += len(result)
+            while len(self._dedup) > self.DEDUP_CACHE_SIZE or (
+                self._dedup_bytes > self.DEDUP_CACHE_BYTES
+                and len(self._dedup) > 1
+            ):
+                _, old = self._dedup.popitem(last=False)
+                self._dedup_bytes -= len(old)
+            self._inflight.pop(req_id, None)
+        mine.set()
+        return result
 
     def stop(self):
         self._running = False
@@ -266,11 +305,29 @@ class RpcClient:
         self.max_retries = max_retries
         self.retry_backoff = retry_backoff
         self._local = threading.local()
+        # one pooled conn per calling thread, keyed by the Thread object,
+        # so close() (and GC via __del__) can release every socket
+        # deterministically and conns of exited threads are swept instead
+        # of leaking fds for the client's lifetime
+        self._conn_by_thread: Dict[threading.Thread, socket.socket] = {}
+        self._conns_lock = threading.Lock()
 
     def _dial(self) -> socket.socket:
         conn = socket.create_connection(self._target, timeout=self.timeout)
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._local.compress = not _is_loopback(conn)
+        me = threading.current_thread()
+        dead = []
+        with self._conns_lock:
+            self._conn_by_thread[me] = conn
+            for t in list(self._conn_by_thread):
+                if not t.is_alive() and t is not me:
+                    dead.append(self._conn_by_thread.pop(t))
+        for c in dead:
+            try:
+                c.close()
+            except OSError:
+                pass
         return conn
 
     def call(self, method: str, payload: bytes = b"",
@@ -283,7 +340,11 @@ class RpcClient:
         an orphaned forward-buffer entry. With the id attached, retries
         are safe, so every call keeps the full retry-with-backoff
         resilience (the reference's forward workers block on
-        wait_for_serving until servers recover, forward.rs:708-715)."""
+        wait_for_serving until servers recover, forward.rs:708-715).
+
+        The server's id cache does not survive its restart: a retry
+        that lands on a restarted process re-executes the method (see
+        RpcServer docstring)."""
         import os
         import time
 
@@ -311,6 +372,14 @@ class RpcClient:
                 env, result = _recv_msg(conn)
                 break
             except (ConnectionError, OSError):
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                with self._conns_lock:
+                    me = threading.current_thread()
+                    if self._conn_by_thread.get(me) is conn:
+                        del self._conn_by_thread[me]
                 self._local.conn = None
                 if not fresh:
                     continue  # stale pooled socket: redial once, no sleep
@@ -335,10 +404,21 @@ class RpcClient:
             pass
 
     def close(self):
-        conn = getattr(self._local, "conn", None)
-        if conn is not None:
+        """Close every pooled connection (all threads). Safe to call from
+        teardown while worker threads are gone; a racing caller simply
+        redials."""
+        with self._conns_lock:
+            conns = list(self._conn_by_thread.values())
+            self._conn_by_thread.clear()
+        for conn in conns:
             try:
                 conn.close()
             except OSError:
                 pass
-            self._local.conn = None
+        self._local.conn = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -57,6 +57,10 @@ class EmbeddingWorker:
         # the coordinator; the resolver returns the fresh client list.
         self._ps_resolver = ps_resolver
         self._ps_lock = threading.Lock()
+        # serializes recovery passes: two RPC threads failing concurrently
+        # must not both re-arm a restarted PS (the second register would
+        # wipe optimizer state the first retry already built on)
+        self._rearm_lock = threading.Lock()
         self.replica_size = len(self.ps_clients)
         if self.replica_size == 0:
             raise ValueError("EmbeddingWorker needs at least one PS client")
@@ -314,6 +318,10 @@ class EmbeddingWorker:
         state (e.g. SparseAdam's bias-correction powers), which must
         never happen to a PS that did not fail. Returns True if any
         replica was re-armed."""
+        with self._rearm_lock:
+            return self._rearm_unready_locked()
+
+    def _rearm_unready_locked(self) -> bool:
         rearmed = False
         for c in list(self.ps_clients):
             ready_fn = getattr(c, "ready_for_serving", None)

@@ -9,7 +9,10 @@ import sys
 import pytest
 
 REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
-sys.path.insert(0, str(REPO_ROOT))
+try:  # prefer the installed package (pip install -e .)
+    import persia_tpu  # noqa: F401
+except ImportError:  # bare checkout fallback
+    sys.path.insert(0, str(REPO_ROOT))
 
 # Hard-override: the surrounding environment may point JAX at the real TPU
 # (JAX_PLATFORMS=axon, set again in jax.config by the platform plugin's

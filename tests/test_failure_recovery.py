@@ -20,7 +20,6 @@ from persia_tpu.data.batch import IDTypeFeature, PersiaBatch
 from persia_tpu.pipeline import BackwardEngine, ForwardEngine
 from persia_tpu.rpc import RpcError
 
-pytestmark = pytest.mark.filterwarnings("ignore::ResourceWarning")
 
 REPO = Path(__file__).resolve().parent.parent
 DIM = 4

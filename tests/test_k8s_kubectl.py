@@ -1,0 +1,194 @@
+"""The kubectl-facing surface without a cluster: manifest validation
+(client dry-run plumbing + structural fallback) and KubectlApi's exact
+command construction against a recording stub kubectl on PATH
+(the intent of the reference's e2e harness, k8s/src/bin/e2e.rs:13-17).
+"""
+
+import json
+import os
+import stat
+
+import pytest
+import yaml
+
+from persia_tpu.k8s_operator import KubectlApi, Operator
+from persia_tpu.k8s_utils import gen_crd, gen_manifests, validate_manifests
+
+SPEC = {
+    "jobName": "demo",
+    "image": "persia-tpu-runtime:latest",
+    "roles": {
+        "nnWorker": {"replicas": 2, "script": "train.py"},
+        "embeddingWorker": {"replicas": 1},
+        "embeddingParameterServer": {"replicas": 2},
+        "dataloader": {"replicas": 1, "script": "loader.py"},
+    },
+    "metrics": {"enabled": True},
+    "embeddingConfigPath": "config/embedding_config.yml",
+    "globalConfigPath": "config/global_config.yml",
+}
+
+
+def _stub_kubectl(tmp_path, rc: int = 0, stderr: str = ""):
+    """A kubectl that records argv + stdin and answers canned JSON."""
+    log = tmp_path / "kubectl.log"
+    stdin_log = tmp_path / "kubectl.stdin"
+    script = tmp_path / "kubectl"
+    script.write_text(f"""#!/bin/bash
+printf '%s\\n' "$*" >> {log}
+case "$*" in
+  *apply*) cat >> {stdin_log} ;;
+esac
+if [ {rc} -ne 0 ]; then echo "{stderr}" >&2; exit {rc}; fi
+case "$*" in
+  *"-o json"*) echo '{{"items": []}}' ;;
+  *apply*) echo "applied (dry run)" ;;
+esac
+""")
+    script.chmod(script.stat().st_mode | stat.S_IEXEC)
+    return log, stdin_log
+
+
+@pytest.fixture
+def on_path(tmp_path, monkeypatch):
+    monkeypatch.setenv("PATH", f"{tmp_path}:{os.environ['PATH']}")
+    return tmp_path
+
+
+def test_structural_validation_accepts_rendered_manifests(monkeypatch,
+                                                          tmp_path):
+    monkeypatch.setenv("PATH", str(tmp_path))  # no kubectl anywhere
+    validate_manifests(gen_manifests(SPEC) + [gen_crd()])
+
+
+def test_structural_validation_rejects_drift(monkeypatch, tmp_path):
+    monkeypatch.setenv("PATH", str(tmp_path))
+    bad = [
+        {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": "Bad_Name"},
+         "spec": {}},
+        {"apiVersion": "v1", "kind": "Service",
+         "metadata": {"name": "svc"}, "spec": {}},
+    ]
+    with pytest.raises(ValueError) as e:
+        validate_manifests(bad)
+    msg = str(e.value)
+    assert "DNS-1123" in msg
+    assert "spec.containers" in msg
+    assert "spec.ports" in msg
+
+
+def test_structural_validation_rejects_non_string_env(monkeypatch, tmp_path):
+    """The classic drift bug: an int env value renders fine as YAML but
+    the API server rejects it."""
+    monkeypatch.setenv("PATH", str(tmp_path))
+    manifests = gen_manifests(SPEC)
+    pod = next(m for m in manifests if m["kind"] == "Pod"
+               and m["spec"]["containers"][0].get("env"))
+    pod["spec"]["containers"][0]["env"].append(
+        {"name": "REPLICA_SIZE", "value": 2})  # int, not str
+    with pytest.raises(ValueError, match="must be a string"):
+        validate_manifests(manifests)
+
+
+def test_validate_via_kubectl_dry_run(on_path):
+    log, stdin_log = _stub_kubectl(on_path)
+    validate_manifests(gen_manifests(SPEC))
+    assert "apply --dry-run=client --validate=true -o name -f -" in \
+        log.read_text()
+    docs = list(yaml.safe_load_all(stdin_log.read_text()))
+    assert {d["kind"] for d in docs} >= {"Pod", "Service"}
+
+
+def test_validate_via_kubectl_dry_run_failure(on_path):
+    _stub_kubectl(on_path, rc=1, stderr="error validating data")
+    with pytest.raises(ValueError, match="error validating data"):
+        validate_manifests(gen_manifests(SPEC))
+
+
+def test_kubectl_api_command_construction(on_path):
+    log, stdin_log = _stub_kubectl(on_path)
+    api = KubectlApi(namespace="prod")
+    api.apply({"apiVersion": "v1", "kind": "Pod",
+               "metadata": {"name": "p0"}})
+    api.delete("Pod", "p0")
+    api.list_objects("persia-job=demo")
+    api.list_custom()
+    lines = log.read_text().splitlines()
+    assert lines[0] == "-n prod apply -f -"
+    assert lines[1] == "-n prod delete pod p0 --ignore-not-found --wait=false"
+    assert lines[2] == "-n prod get pods -l persia-job=demo -o json"
+    assert lines[3] == "-n prod get services -l persia-job=demo -o json"
+    assert lines[4] == "-n prod get persiajobs -o json"
+    assert json.loads(stdin_log.read_text())["metadata"]["name"] == "p0"
+
+
+def test_rest_apply_rejects_invalid_spec_without_tracking():
+    """An invalid spec gets a 400 and is NOT tracked, so the reconcile
+    loop does not re-raise on every interval until a manual /delete."""
+    import json as _json
+    import urllib.request
+
+    from persia_tpu.k8s_operator import FakeKubeApi, SchedulingServer
+
+    op = Operator(FakeKubeApi())
+    server = SchedulingServer(op)
+    server.serve_background()
+    try:
+        bad = {"jobName": "badjob", "roles": {"nonsenseRole": {}}}
+        req = urllib.request.Request(
+            f"http://{server.addr}/apply",
+            data=_json.dumps(bad).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req)
+        assert e.value.code == 400
+        assert op.job_names() == []
+    finally:
+        server.stop()
+
+
+def test_rest_apply_rejects_renderable_but_invalid_spec():
+    """A spec that renders but produces invalid manifests (bad DNS-1123
+    job name) must also 400 without being tracked."""
+    import json as _json
+    import urllib.request
+
+    from persia_tpu.k8s_operator import FakeKubeApi, SchedulingServer
+
+    op = Operator(FakeKubeApi())
+    server = SchedulingServer(op)
+    server.serve_background()
+    try:
+        bad = dict(SPEC, jobName="My_Job")
+        req = urllib.request.Request(
+            f"http://{server.addr}/apply",
+            data=_json.dumps(bad).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req)
+        assert e.value.code == 400
+        assert op.job_names() == []
+    finally:
+        server.stop()
+
+
+def test_validate_falls_back_when_kubectl_has_no_cluster(on_path):
+    """kubectl present but no reachable cluster: connectivity failures
+    must fall back to structural checks, not reject valid manifests."""
+    _stub_kubectl(on_path, rc=1,
+                  stderr="The connection to the server localhost:8080 was "
+                         "refused - connection refused")
+    validate_manifests(gen_manifests(SPEC))  # must not raise
+
+
+def test_operator_reconcile_through_kubectl_stub(on_path):
+    """A full reconcile pass driven through the real KubectlApi shell-out
+    path (previously only FakeKubeApi ever executed)."""
+    log, stdin_log = _stub_kubectl(on_path)
+    op = Operator(KubectlApi(namespace="default"), [SPEC])
+    op.reconcile_job(SPEC)
+    applied = [ln for ln in log.read_text().splitlines()
+               if "apply" in ln]
+    # every rendered manifest applied (stub lists no existing objects)
+    assert len(applied) == len(gen_manifests(SPEC))

@@ -28,7 +28,6 @@ from persia_tpu.config import (
 from persia_tpu.service.helper import ServiceCtx
 from persia_tpu.utils import resolve_binary_path
 
-pytestmark = pytest.mark.filterwarnings("ignore::ResourceWarning")
 
 REPO = Path(__file__).resolve().parent.parent
 

@@ -144,3 +144,55 @@ def test_dataflow_receiver_waits_for_all_senders_eos():
         assert r.get(timeout=2) is None  # now the stream ends
     finally:
         r.close()
+
+
+def test_dedup_concurrent_duplicate_waits_for_inflight():
+    """A duplicate delivery of a request id whose first execution is
+    still running must wait for it and return the SAME result — not run
+    the handler a second time (the socket-timeout re-send race that
+    double-consumed buffer entries)."""
+    import threading
+    import time as _time
+
+    from persia_tpu.rpc import RpcClient, RpcServer, _send_msg, _recv_msg
+    import socket as _socket
+
+    calls = []
+    release = threading.Event()
+
+    def slow_handler(payload: bytes) -> bytes:
+        calls.append(payload)
+        release.wait(timeout=10)
+        return b"result-%d" % len(calls)
+
+    server = RpcServer()
+    server.register("slow", slow_handler)
+    server.serve_background()
+    try:
+        host, port = server.addr.rsplit(":", 1)
+        req_id = b"x" * 12
+        results = []
+
+        def raw_call():
+            conn = _socket.create_connection((host, int(port)), timeout=30)
+            try:
+                _send_msg(conn, ["slow", req_id], b"p", False)
+                env, payload = _recv_msg(conn)
+                assert env[0] == "ok"
+                results.append(payload)
+            finally:
+                conn.close()
+
+        t1 = threading.Thread(target=raw_call)
+        t2 = threading.Thread(target=raw_call)
+        t1.start()
+        _time.sleep(0.2)  # first delivery is now in-flight
+        t2.start()
+        _time.sleep(0.2)
+        release.set()
+        t1.join(timeout=15)
+        t2.join(timeout=15)
+        assert len(calls) == 1  # executed exactly once
+        assert results == [b"result-1", b"result-1"]
+    finally:
+        server.stop()

@@ -27,7 +27,6 @@ from persia_tpu.models import DNN  # noqa: E402
 from persia_tpu.service.dataflow import DataflowClient, DataflowReceiver  # noqa: E402
 from persia_tpu.service.helper import ServiceCtx  # noqa: E402
 
-pytestmark = pytest.mark.filterwarnings("ignore::ResourceWarning")
 
 
 def _schema():
