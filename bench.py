@@ -523,10 +523,14 @@ def main():
     if args.mode not in ("wire", "worker", "worker-svc", "store"):  # host-only modes skip jax
         import os
 
-        forced = os.environ.get("PERSIA_FORCE_JAX_PLATFORM")
-        if forced:  # local verification escape hatch (nn_worker.py honors
-            # the same variable); the driver runs without it and probes
-            # the real accelerator
+        # local verification escape hatch (nn_worker.py honors the same
+        # variable); plain JAX_PLATFORMS=cpu also counts — the axon
+        # platform plugin re-pins jax.config via sitecustomize, so the
+        # standard env var alone is silently ignored without this. The
+        # driver runs with neither and probes the real accelerator.
+        forced = os.environ.get("PERSIA_FORCE_JAX_PLATFORM") or (
+            "cpu" if os.environ.get("JAX_PLATFORMS") == "cpu" else None)
+        if forced:
             import jax
 
             jax.config.update("jax_platforms", forced)

@@ -28,7 +28,7 @@ from persia_tpu.service.coordinator import (
 from persia_tpu.service.dataflow import DataflowClient
 from persia_tpu.service.worker_service import RemoteEmbeddingWorker
 
-from criteo_data import criteo_batches, synthetic_batches
+from criteo_data import criteo_batches, learnable_batches, synthetic_batches
 
 logger = get_default_logger("criteo_data_loader")
 
@@ -41,6 +41,9 @@ def main():
     p.add_argument("--batch-size", type=int, default=4096)
     p.add_argument("--vocab", type=int, default=1 << 20)
     p.add_argument("--seed", type=int, default=1)
+    p.add_argument("--learnable", action="store_true",
+                   help="stream learnable_batches (hidden-weight labels) "
+                        "instead of noise-label synthetic_batches")
     # fleet sizes come from the manifest generator's env wiring
     p.add_argument("--num-workers", type=int,
                    default=int(os.environ.get("PERSIA_NUM_WORKERS") or 1))
@@ -66,6 +69,11 @@ def main():
                                  max_samples=args.samples,
                                  replica_index=replica_index,
                                  replica_size=replica_size)
+    elif args.learnable:
+        batches = learnable_batches(args.samples // replica_size,
+                                    args.batch_size,
+                                    seed=args.seed + replica_index,
+                                    vocab_per_slot=args.vocab)
     else:
         logger.warning("no --train file; streaming synthetic batches")
         batches = synthetic_batches(args.samples // replica_size,
@@ -78,7 +86,9 @@ def main():
             batch.batch_id = None  # DataCtx assigns this loader's ids
             ctx.send_data(batch)
             sent += len(batch.labels[0].data)
-        ctx.dataflow.send_eos()
+        # identified EOS: lets a liveness monitor's abort_sender() for
+        # this replica dedupe against the EOS we actually sent
+        ctx.dataflow.send_eos(sender_id=replica_index)
     logger.info("sent %d samples; eos", sent)
 
 

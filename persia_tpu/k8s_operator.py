@@ -196,18 +196,21 @@ class Operator:
 
     # --- reconcile ------------------------------------------------------
 
-    def reconcile_job(self, spec: dict) -> Dict[str, int]:
+    def reconcile_job(self, spec: dict, manifests=None) -> Dict[str, int]:
         """Drive one job toward its desired manifest set. Returns action
-        counts (created/restarted/removed) for observability."""
+        counts (created/restarted/removed) for observability. Callers
+        that already rendered the spec (e.g. /apply's validation pass)
+        hand the manifests in to avoid a second gen_manifests()."""
         with self._lock:
-            return self._reconcile_job_locked(spec)
+            return self._reconcile_job_locked(spec, manifests)
 
-    def _reconcile_job_locked(self, spec: dict) -> Dict[str, int]:
+    def _reconcile_job_locked(self, spec: dict, manifests=None) -> Dict[str, int]:
         job = spec["jobName"]
         stats = {"created": 0, "restarted": 0, "removed": 0}
         desired = {
             (m["kind"], m["metadata"]["name"]): m
-            for m in gen_manifests(spec)
+            for m in (manifests if manifests is not None
+                      else gen_manifests(spec))
         }
         observed = {
             (o["kind"], o["metadata"]["name"]): o
@@ -374,12 +377,12 @@ class SchedulingServer:
                         from persia_tpu.k8s_utils import validate_spec
 
                         try:
-                            validate_spec(spec)
+                            manifests = validate_spec(spec)
                         except Exception as e:
                             self._send(400, {"error": repr(e)})
                             return
                         op.track(spec)
-                        stats = op.reconcile_job(spec)
+                        stats = op.reconcile_job(spec, manifests)
                         self._send(200, {"job": spec["jobName"],
                                          "reconcile": stats})
                     elif route == "/delete":

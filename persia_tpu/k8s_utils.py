@@ -276,12 +276,12 @@ def _validate_structural(manifest: dict) -> List[str]:
     """Fallback schema checks when kubectl is absent: the structural
     invariants `kubectl apply --dry-run=client` would reject."""
     errs = []
-    name = manifest.get("metadata", {}).get("name", "?")
+    meta = manifest.get("metadata")
+    name = meta.get("name", "?") if isinstance(meta, dict) else "?"
     where = f"{manifest.get('kind', '?')}/{name}"
     for key in ("apiVersion", "kind"):
         if not manifest.get(key):
             errs.append(f"{where}: missing {key}")
-    meta = manifest.get("metadata")
     if not isinstance(meta, dict) or not meta.get("name"):
         errs.append(f"{where}: missing metadata.name")
     elif not all(c.isalnum() or c in "-." for c in meta["name"]) or \
@@ -289,17 +289,29 @@ def _validate_structural(manifest: dict) -> List[str]:
         errs.append(f"{where}: invalid DNS-1123 name {meta['name']!r}")
     kind = manifest.get("kind")
     spec = manifest.get("spec", {})
+    if not isinstance(spec, dict):
+        errs.append(f"{where}: spec must be a mapping, "
+                    f"got {type(spec).__name__}")
+        return errs
     if kind == "Pod":
         containers = spec.get("containers")
         if not isinstance(containers, list) or not containers:
             errs.append(f"{where}: Pod needs spec.containers")
         else:
             for c in containers:
+                if not isinstance(c, dict):
+                    errs.append(f"{where}: container entries must be "
+                                f"mappings, got {type(c).__name__}")
+                    continue
                 if not c.get("name") or not c.get("image"):
                     errs.append(f"{where}: container needs name + image")
                 if "command" in c and not isinstance(c["command"], list):
                     errs.append(f"{where}: command must be a list")
-                for e in c.get("env", []):
+                env = c.get("env", [])
+                for e in (env if isinstance(env, list) else []):
+                    if not isinstance(e, dict):
+                        errs.append(f"{where}: env entries must be mappings")
+                        continue
                     if not isinstance(e.get("value", ""), str):
                         errs.append(
                             f"{where}: env {e.get('name')} value must be a "
@@ -310,12 +322,15 @@ def _validate_structural(manifest: dict) -> List[str]:
         if not spec.get("selector"):
             errs.append(f"{where}: Service needs spec.selector")
     elif kind == "CustomResourceDefinition":
-        names = spec.get("names", {})
+        names = spec.get("names")
+        names = names if isinstance(names, dict) else {}
         if not (spec.get("group") and spec.get("versions") and
                 names.get("plural") and names.get("kind")):
             errs.append(f"{where}: CRD needs group/versions/names")
-        if meta and meta.get("name") != \
-                f"{names.get('plural')}.{spec.get('group')}":
+        elif isinstance(meta, dict) and meta.get("name") != \
+                f"{names['plural']}.{spec['group']}":
+            # only meaningful once group+names exist; otherwise it's a
+            # spurious cascade comparing against the literal "None.None"
             errs.append(f"{where}: CRD name must be <plural>.<group>")
     return errs
 

@@ -43,6 +43,7 @@ class DataflowReceiver:
         self._q: "queue.Queue" = queue.Queue(maxsize=buffer_size)
         self.num_senders = max(1, num_senders)
         self._eos_seen = 0
+        self._eos_ids: set = set()  # identified senders already counted
         self._eos_lock = threading.Lock()
         self.server = RpcServer(host, port)
         self.server.register("enqueue_batch", self._enqueue)
@@ -63,7 +64,19 @@ class DataflowReceiver:
         return b""
 
     def _eos(self, payload: bytes) -> bytes:
+        # Identified EOS (payload = msgpack {"sender": id}) is counted at
+        # most once per sender, so a monitor aborting a replica that
+        # already sent its own EOS cannot double-count it and cut the
+        # stream while other replicas are mid-send. Empty payload keeps
+        # the legacy anonymous count-only behavior.
+        sender = None
+        if payload:
+            sender = msgpack.unpackb(payload, raw=False).get("sender")
         with self._eos_lock:
+            if sender is not None:
+                if sender in self._eos_ids:
+                    return b""
+                self._eos_ids.add(sender)
             self._eos_seen += 1
             done = self._eos_seen >= self.num_senders
         if done:
@@ -73,6 +86,18 @@ class DataflowReceiver:
     def get(self, timeout: Optional[float] = None) -> Optional[PersiaBatch]:
         item = self._q.get(timeout=timeout)
         return None if item is _EOS else item
+
+    def abort_sender(self, sender_id=None):
+        """Count a dead sender as end-of-stream: the hook for whatever
+        watches loader liveness (tests/test_flagship_e2e.py's watchdog
+        today; a deployment monitor in production wiring) to call when a
+        data-loader replica dies without sending EOS, so the trainer
+        drains what arrived and exits instead of blocking on the queue
+        forever. Pass the same ``sender_id`` the replica uses for
+        ``send_eos`` — then an abort racing the replica's own EOS counts
+        once, not twice."""
+        self._eos(msgpack.packb({"sender": sender_id})
+                  if sender_id is not None else b"")
 
     def close(self):
         self.server.stop()
@@ -119,9 +144,14 @@ class DataflowClient:
         # its forward-buffer ref on the embedding worker
         trainer.call("enqueue_batch", payload, dedup=True)
 
-    def send_eos(self):
+    def send_eos(self, sender_id=None):
         # dedup id: an ambiguous connection death would otherwise re-send
         # the EOS, double-counting this sender against the receiver's
-        # num_senders threshold and ending the stream early
+        # num_senders threshold and ending the stream early. sender_id
+        # additionally lets the receiver dedupe this EOS against an
+        # abort_sender() from a liveness monitor (process-level dedup,
+        # not just retry-level).
+        payload = (msgpack.packb({"sender": sender_id})
+                   if sender_id is not None else b"")
         for t in self._trainers:
-            t.call("end_of_stream", dedup=True)
+            t.call("end_of_stream", payload, dedup=True)

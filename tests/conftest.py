@@ -17,9 +17,14 @@ except ImportError:  # bare checkout fallback
 # Hard-override: the surrounding environment may point JAX at the real TPU
 # (JAX_PLATFORMS=axon, set again in jax.config by the platform plugin's
 # sitecustomize), but tests always run on the virtual 8-device CPU mesh.
+# PERSIA_TEST_TPU=1 opts out so the TPU-gated hardware-validation tests
+# (e.g. the compiled Pallas kernel check) can reach the real chip.
+import os  # noqa: E402
+
 from persia_tpu.utils import force_cpu_platform  # noqa: E402
 
-force_cpu_platform(8)
+if os.environ.get("PERSIA_TEST_TPU") != "1":
+    force_cpu_platform(8)
 
 
 @pytest.fixture(scope="session")
