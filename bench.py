@@ -122,6 +122,84 @@ def bench_hybrid(batch_size, steps, warmup, n_ps=2, staleness=8):
     return steps * batch_size / elapsed
 
 
+def make_zipf_batches(num, batch_size, vocab=1 << 20, a=1.2, seed=0):
+    """Skewed id traffic — the device cache's target distribution (real
+    CTR id streams are heavily Zipf; uniform make_batches is the cache's
+    worst case and stays the default for the other modes)."""
+    from persia_tpu.data.batch import (
+        IDTypeFeatureWithSingleID,
+        Label,
+        NonIDTypeFeature,
+        PersiaBatch,
+    )
+
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(num):
+        ids = rng.zipf(a, size=(batch_size, NUM_SLOTS)) % vocab
+        signs = (ids + np.arange(NUM_SLOTS, dtype=np.uint64) * vocab
+                 + 1).astype(np.uint64)
+        out.append(PersiaBatch(
+            [IDTypeFeatureWithSingleID(
+                f"slot_{s}", np.ascontiguousarray(signs[:, s]))
+             for s in range(NUM_SLOTS)],
+            non_id_type_features=[NonIDTypeFeature(
+                rng.normal(size=(batch_size, NUM_DENSE)).astype(np.float32))],
+            labels=[Label(
+                rng.integers(0, 2, size=(batch_size, 1)).astype(np.float32))],
+            batch_id=i,
+        ))
+    return out
+
+
+def bench_cached(batch_size, steps, warmup, n_ps=2,
+                 cache_capacity=2_000_000):
+    """Device-resident hot-row cache on Zipf traffic: hits never cross
+    the host<->device wire (the hybrid mode's bottleneck both on slow
+    relays and host-bound deployments). Prints hit rate and wire bytes
+    saved alongside throughput."""
+    import optax
+
+    from persia_tpu.config import EmbeddingSchema, uniform_slots
+    from persia_tpu.ctx import TrainCtx
+    from persia_tpu.embedding import EmbeddingConfig
+    from persia_tpu.embedding.optim import Adagrad
+    from persia_tpu.models import DLRM
+    from persia_tpu.ps.native import make_holder
+    from persia_tpu.worker.worker import EmbeddingWorker
+
+    schema = EmbeddingSchema(
+        slots_config=uniform_slots(
+            [f"slot_{s}" for s in range(NUM_SLOTS)], dim=DIM))
+    holders = [make_holder(50_000_000, 16) for _ in range(n_ps)]
+    worker = EmbeddingWorker(schema, holders)
+    ctx = TrainCtx(
+        model=DLRM(embedding_dim=DIM),
+        dense_optimizer=optax.adagrad(0.02),
+        embedding_optimizer=Adagrad(lr=0.02),
+        schema=schema,
+        worker=worker,
+        embedding_config=EmbeddingConfig(),
+        device_cache_capacity=cache_capacity,
+    )
+    batches = make_zipf_batches(warmup + steps, batch_size)
+    import jax
+
+    with ctx:
+        for i, b in enumerate(batches):
+            loss, _ = ctx.train_step(b)
+            if i + 1 == warmup:
+                jax.block_until_ready(loss)
+                t0 = time.perf_counter()
+        jax.block_until_ready(loss)
+        elapsed = time.perf_counter() - t0
+        eng = ctx._cache_engine
+        log(f"bench: cache hit rate {eng.hit_rate:.3f}, "
+            f"wire bytes saved {eng.wire_bytes_saved / 1e6:.1f} MB over "
+            f"{warmup + steps} steps")
+    return steps * batch_size / elapsed
+
+
 def bench_device(batch_size, steps, warmup, vocab=1 << 20):
     import jax
     import optax
@@ -475,7 +553,7 @@ def preflight_backend(metric, unit, timeout=90):
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--mode",
-                   choices=["hybrid", "device", "wire", "worker",
+                   choices=["hybrid", "device", "cached", "wire", "worker",
                             "worker-svc", "store"],
                    default="hybrid")
     p.add_argument("--entries", type=int, default=10_000_000,
@@ -498,6 +576,7 @@ def main():
         "worker": ("worker_cycle_samples_per_sec_core", "samples/sec"),
         "worker-svc": ("worker_service_samples_per_sec_core", "samples/sec"),
         "store": ("store_hit_lookups_per_sec_core", "lookups/sec"),
+        "cached": ("dlrm_cached_samples_per_sec_chip", "samples/sec"),
     }[args.mode]
 
     # Two-tier watchdog. Tier 1 (threading.Timer) emits the diagnostic
@@ -541,6 +620,9 @@ def main():
     t0 = time.perf_counter()
     if args.mode == "hybrid":
         value = bench_hybrid(args.batch_size, args.steps, args.warmup)
+        vs_baseline = value / BASELINE_SAMPLES_PER_SEC
+    elif args.mode == "cached":
+        value = bench_cached(args.batch_size, args.steps, args.warmup)
         vs_baseline = value / BASELINE_SAMPLES_PER_SEC
     elif args.mode == "worker":
         value = bench_worker(args.batch_size, max(args.steps, 5))

@@ -82,6 +82,8 @@ class PsServer {
     if (method == "len") return do_len();
     if (method == "get_entry") return do_get_entry(payload);
     if (method == "set_entry") return do_set_entry(payload);
+    if (method == "get_entries") return do_get_entries(payload);
+    if (method == "set_entries") return do_set_entries(payload);
     if (method == "clear") {
       store_.clear();
       return "";
@@ -218,6 +220,76 @@ class PsServer {
                      static_cast<uint32_t>(meta.at("dim").as_int()),
                      reinterpret_cast<const float*>(vec.data),
                      static_cast<uint32_t>(vec.nbytes / 4));
+    return "";
+  }
+
+  // Batched entry read (value + opt state): ONE round trip for the
+  // device cache's miss import instead of one RPC per sign. Uniform
+  // width; absent or differently-sized entries report found=0.
+  std::string do_get_entries(const std::string& payload) {
+    mp::Value meta;
+    std::vector<net::ArrayRef> arrays;
+    net::unpack_arrays(payload, &meta, &arrays);
+    const uint64_t width = meta.at("width").as_uint();
+    const net::ArrayRef& signs_ref = arrays.at(0);
+    const size_t n = signs_ref.nbytes / 8;
+    const uint64_t* signs =
+        reinterpret_cast<const uint64_t*>(signs_ref.data);
+    std::vector<uint8_t> found(n, 0);
+    std::vector<float> vecs(n * width, 0.0f);
+    uint32_t dim = 0;
+    for (size_t i = 0; i < n; ++i) {
+      float* row = vecs.data() + i * width;
+      int64_t len = store_.get_entry(signs[i], row,
+                                     static_cast<uint32_t>(width), &dim);
+      if (len == static_cast<int64_t>(width)) {
+        found[i] = 1;
+      } else if (len > 0 && len < static_cast<int64_t>(width)) {
+        std::fill(row, row + width, 0.0f);  // partial write: scrub
+      }
+    }
+    std::string head;
+    mp::encode_map_header(head, 2);
+    mp::encode_str(head, "m");
+    mp::encode_map_header(head, 0);
+    mp::encode_str(head, "a");
+    mp::encode_array_header(head, 2);
+    mp::encode_array_header(head, 2);
+    mp::encode_str(head, "uint8");
+    mp::encode_array_header(head, 1);
+    mp::encode_int(head, static_cast<int64_t>(n));
+    mp::encode_array_header(head, 2);
+    mp::encode_str(head, "float32");
+    mp::encode_array_header(head, 2);
+    mp::encode_int(head, static_cast<int64_t>(n));
+    mp::encode_int(head, static_cast<int64_t>(width));
+    std::string out(4, '\0');
+    uint32_t hl = static_cast<uint32_t>(head.size());
+    std::memcpy(out.data(), &hl, 4);
+    out += head;
+    out.append(reinterpret_cast<const char*>(found.data()), found.size());
+    out.append(reinterpret_cast<const char*>(vecs.data()),
+               sizeof(float) * vecs.size());
+    return out;
+  }
+
+  std::string do_set_entries(const std::string& payload) {
+    mp::Value meta;
+    std::vector<net::ArrayRef> arrays;
+    net::unpack_arrays(payload, &meta, &arrays);
+    const uint32_t dim = static_cast<uint32_t>(meta.at("dim").as_int());
+    const net::ArrayRef& signs_ref = arrays.at(0);
+    const net::ArrayRef& vecs_ref = arrays.at(1);
+    const size_t n = signs_ref.nbytes / 8;
+    if (n == 0) return "";
+    const uint64_t* signs =
+        reinterpret_cast<const uint64_t*>(signs_ref.data);
+    const float* vecs = reinterpret_cast<const float*>(vecs_ref.data);
+    const size_t width = (vecs_ref.nbytes / 4) / n;
+    for (size_t i = 0; i < n; ++i) {
+      store_.set_entry(signs[i], dim, vecs + i * width,
+                       static_cast<uint32_t>(width));
+    }
     return "";
   }
 

@@ -194,6 +194,7 @@ class TrainCtx(EmbeddingCtx):
         grad_update_interval: int = 1,
         seed: int = 0,
         grad_reduce_dtype: Optional[str] = None,
+        device_cache_capacity: int = 0,
     ):
         super().__init__(model=model, schema=schema, worker=worker,
                          embedding_config=embedding_config,
@@ -214,6 +215,12 @@ class TrainCtx(EmbeddingCtx):
         self._eval_step = None
         self._emb_shapes = None
         self._ddp = False
+        # device-resident hot-row cache (TPU-first, beyond the reference:
+        # hits never cross the host<->device wire; see
+        # persia_tpu/parallel/cached_engine.py for the consistency model)
+        self.device_cache_capacity = int(device_cache_capacity)
+        self._cache_engine = None
+        self._cached_step = None
 
     def __enter__(self):
         super().__enter__()
@@ -361,6 +368,14 @@ class TrainCtx(EmbeddingCtx):
         from persia_tpu.parallel.train import unpack_embedding_grads
         from persia_tpu.pipeline import LookedUpBatch
 
+        if self.device_cache_capacity:
+            if isinstance(batch, LookedUpBatch):
+                raise NotImplementedError(
+                    "device_cache_capacity + DataLoader pipeline: the "
+                    "cache path does its own (cheaper) miss lookups; "
+                    "feed raw PersiaBatch objects")
+            return self._cached_train_step(batch)
+
         engine = None
         staged = None
         if isinstance(batch, LookedUpBatch):
@@ -422,6 +437,105 @@ class TrainCtx(EmbeddingCtx):
         emb_values, emb_indices = split_embedding_inputs(emb_inputs)
         return self._eval_step(self.state, non_id, emb_values, emb_indices)
 
+    # --- device-resident cache path --------------------------------------
+
+    def _ensure_cache(self, batch: PersiaBatch):
+        """First-batch validation + lazy build of the cache engine and
+        the fused cached step. The v1 envelope: single chip (no mesh),
+        single-id slots, uniform dim, non-shared Adagrad — exactly the
+        flagship DLRM/Criteo shape; anything else raises with the reason
+        rather than silently degrading."""
+        if self._cache_engine is not None:
+            return
+        from persia_tpu.embedding.optim import Adagrad as ClientAdagrad
+
+        if self.mesh is not None:
+            raise NotImplementedError(
+                "device cache v1 is single-chip (mesh=None): replicated "
+                "per-trainer caches would fork hot rows' optimizer state")
+        opt = self.embedding_optimizer
+        if not isinstance(opt, ClientAdagrad) or opt.vectorwise_shared:
+            raise NotImplementedError(
+                "device cache v1 mirrors non-shared Adagrad on device; "
+                f"got {type(opt).__name__}")
+        dims = set()
+        for f in batch.id_type_features:
+            # exactly one sign per sample: offsets must be 0,1,2,...,B
+            # (a total-count check alone false-passes multi-id bags whose
+            # sign count happens to equal the batch size)
+            if not np.array_equal(
+                    f.offsets,
+                    np.arange(len(f.offsets), dtype=f.offsets.dtype)):
+                raise NotImplementedError(
+                    "device cache v1 needs single-id slots "
+                    f"({f.name} is multi-id)")
+            dims.add(self.schema.get_slot(f.name).dim)
+        if len(dims) != 1:
+            raise NotImplementedError(
+                f"device cache v1 needs one uniform slot dim, got {dims}")
+        dim = dims.pop()
+        num_slots = len(batch.id_type_features)
+        from persia_tpu.parallel.cached_engine import DeviceCacheEngine
+        from persia_tpu.parallel.cached_train import make_cached_train_step
+
+        self._cache_engine = DeviceCacheEngine(
+            self.worker, self.device_cache_capacity, num_slots, dim,
+            acc_init=opt.initial_accumulator_value)
+        self._cached_step = make_cached_train_step(
+            self.model, self.dense_optimizer, num_slots, dim,
+            lr=opt.lr, eps=opt.eps,
+            g_square_momentum=opt.g_square_momentum,
+            loss_fn=self.loss_fn,
+            weight_bound=self.embedding_config.weight_bound)
+        if self.state is None:
+            from persia_tpu.parallel.train import create_train_state
+
+            batch_size = len(batch.labels[0].data)
+            non_id = [jnp.asarray(f.data)
+                      for f in batch.non_id_type_features]
+            dummy_emb = [np.zeros((batch_size, dim), np.float32)
+                         for _ in range(num_slots)]
+            self.state = create_train_state(
+                self.model, self.dense_optimizer,
+                jax.random.key(self.seed), non_id, dummy_emb)
+            from persia_tpu.parallel.train import make_eval_step
+
+            self._eval_step = make_eval_step(self.model)
+
+    def _cached_train_step(self, batch: PersiaBatch):
+        self._ensure_cache(batch)
+        eng = self._cache_engine
+        slot_idx, cold_idx, cold_vals, cold_acc, evicted = eng.prepare(
+            batch.id_type_features)
+        non_id = [jnp.asarray(f.data) for f in batch.non_id_type_features]
+        label = jnp.asarray(batch.labels[0].data)
+        (self.state, eng.cache_vals, eng.cache_acc, loss, pred,
+         ev_vals, ev_acc) = self._cached_step(
+            self.state, eng.cache_vals, eng.cache_acc, non_id,
+            jnp.asarray(slot_idx), jnp.asarray(cold_idx),
+            jnp.asarray(cold_vals), jnp.asarray(cold_acc), label)
+        eng.finish(evicted, ev_vals, ev_acc)
+        return loss, pred
+
+    def flush_device_cache(self) -> int:
+        """Write every cached row back to the PS (eval/checkpoint entry
+        points call this; the cache stays valid for more training)."""
+        if self._cache_engine is None:
+            return 0
+        return self._cache_engine.flush_all()
+
+    def dump_checkpoint(self, dst_dir: str, with_dense: bool = True):
+        self.flush_device_cache()
+        super().dump_checkpoint(dst_dir, with_dense=with_dense)
+
+    def load_checkpoint(self, src_dir: str, with_dense: bool = True):
+        # invalidate (NOT flush) first: cached rows predate the restore;
+        # flushing them — or serving further hits from them — would
+        # clobber the loaded values
+        if self._cache_engine is not None:
+            self._cache_engine.invalidate()
+        super().load_checkpoint(src_dir, with_dense=with_dense)
+
 
 class InferCtx(EmbeddingCtx):
     """Inference: fixed worker addresses, eval-mode lookups
@@ -451,6 +565,9 @@ class _EvalCtx(EmbeddingCtx):
                          embedding_config=parent.embedding_config)
         self._parent = parent
         self._configured_servers = True  # already configured by parent
+        # cached rows train on device; make the PS authoritative before
+        # eval lookups read it
+        parent.flush_device_cache()
 
     def _apply_model(self, non_id, emb_inputs):
         return self._parent._apply_model(non_id, emb_inputs)

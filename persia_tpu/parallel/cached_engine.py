@@ -1,0 +1,227 @@
+"""Orchestration for the device-resident embedding cache.
+
+Ties together the host-side LRU sign->slot map + victim buffer
+(persia_tpu/worker/device_cache.py) and the fused device step
+(persia_tpu/parallel/cached_train.py), and owns the async write-back of
+evicted rows to the parameter server. TrainCtx delegates here when
+``device_cache_capacity`` is set.
+
+Consistency model (documented trade, bounded like the reference's
+staleness-based hybrid algorithm): cached rows train exclusively on
+device; the PS copy of a cached sign is stale until the row is evicted
+(write-back) or ``flush_all`` runs (eval/checkpoint entry points call
+it). A cache miss reads the victim buffer first, so an evicted row
+re-entering the cache never loses its in-flight update. Single-trainer
+only: replicated per-trainer caches would fork hot rows' optimizer
+state across trainers with no reconciliation.
+"""
+
+import queue
+import threading
+from typing import List, Tuple
+
+import numpy as np
+
+from persia_tpu.parallel.cached_train import pad_to_bucket
+from persia_tpu.worker.device_cache import SignSlotMap, VictimBuffer
+
+_BUCKETS = (64, 256, 1024, 4096, 16384, 65536)
+
+
+class DeviceCacheEngine:
+    def __init__(self, worker, capacity: int, num_slots: int, dim: int,
+                 acc_init: float):
+        self.worker = worker
+        self.capacity = int(capacity)
+        self.num_slots = int(num_slots)
+        self.dim = int(dim)
+        self.acc_init = float(acc_init)
+        self.mapper = SignSlotMap(capacity)
+        self.victims = VictimBuffer()
+        from persia_tpu.parallel.cached_train import init_cache_arrays
+
+        self.cache_vals, self.cache_acc = init_cache_arrays(
+            capacity, dim, acc_init)
+        self._flush_q: "queue.Queue" = queue.Queue()
+        self._flush_token = 0
+        self._flush_err: List[BaseException] = []
+        self._flush_thread = threading.Thread(
+            target=self._flush_loop, daemon=True,
+            name="device-cache-flush")
+        self._flush_thread.start()
+        self.wire_bytes_saved = 0  # vs the packed upload+download path
+
+    # --- per-batch host work --------------------------------------------
+
+    def prepare(self, id_type_features) -> Tuple[np.ndarray, np.ndarray,
+                                                 np.ndarray, np.ndarray,
+                                                 np.ndarray]:
+        """Map this batch's signs and fetch its miss rows.
+
+        Returns (slot_idx (B,S) i32, cold_idx (Mpad,) i32, cold_vals
+        (Mpad, D) f32, cold_acc (Mpad, D) f32, evicted_signs (Mpad,)
+        u64). Runs on the ordered training path — batch order IS the
+        LRU order.
+        """
+        # single-id slots: f.signs is exactly one sign per sample (the
+        # ctx-level guard verified this before building the engine)
+        signs = np.stack([f.signs for f in id_type_features], axis=1)
+        batch, num_slots = signs.shape
+        flat_signs = signs.reshape(-1)
+        slots, miss_pos, evicted = self.mapper.assign(flat_signs)
+        slot_idx = slots.reshape(batch, num_slots)
+        miss_signs = flat_signs[miss_pos]
+        m = len(miss_signs)
+        mpad = pad_to_bucket(max(m, 1), _BUCKETS)
+        cold_idx = np.full(mpad, self.capacity, np.int32)  # pad -> dummy
+        cold_vals = np.zeros((mpad, self.dim), np.float32)
+        cold_acc = np.full((mpad, self.dim), self.acc_init, np.float32)
+        evicted_signs = np.zeros(mpad, np.uint64)
+        if m:
+            cold_idx[:m] = slots[miss_pos]
+            evicted_signs[:m] = evicted
+            # victim buffer first: an evicted row still in flight is the
+            # authoritative copy (the PS write-back may not have landed).
+            # Entries are (ev_vals, ev_acc, row) with possibly-device
+            # arrays; np.asarray blocks until the step that produced
+            # them finished, so the value read here is never stale.
+            need_ps = []
+            for i, s in enumerate(miss_signs):
+                v = self.victims.take(int(s))
+                if v is not None:
+                    vvals, vacc, row = v
+                    cold_vals[i] = np.asarray(vvals)[row]
+                    cold_acc[i] = np.asarray(vacc)[row]
+                else:
+                    need_ps.append(i)
+            if need_ps:
+                idx = np.asarray(need_ps)
+                vals, state = self.worker.lookup_rows_with_state(
+                    miss_signs[idx], self.dim,
+                    default_state=self.acc_init)
+                cold_vals[idx] = vals
+                if state.shape[1] == self.dim:
+                    cold_acc[idx] = state
+                # (space != dim would mean a non-matching optimizer; the
+                # ctx-level guard rejects that before the engine exists)
+        # bookkeeping: what the packed path would have moved for this
+        # batch (bf16 both ways) minus what the cached path moves
+        packed = batch * num_slots * self.dim * 2 * 2
+        moved = (slot_idx.nbytes + cold_idx.nbytes + cold_vals.nbytes
+                 + cold_acc.nbytes + (2 * mpad * self.dim * 4))
+        self.wire_bytes_saved += max(0, packed - moved)
+        return slot_idx, cold_idx, cold_vals, cold_acc, evicted_signs
+
+    def finish(self, evicted_signs: np.ndarray, ev_vals, ev_acc) -> None:
+        """Queue evicted rows for async PS write-back. ``ev_vals`` /
+        ``ev_acc`` may be jax device arrays; the d2h materialization
+        happens on the flush thread."""
+        if self._flush_err:
+            raise self._flush_err[0]
+        real = [i for i, s in enumerate(evicted_signs) if s]
+        if not real:
+            return
+        self._flush_token += 1
+        token = self._flush_token
+        for i in real:
+            # the buffered entry holds the device arrays themselves: a
+            # miss racing the write-back materializes its row directly,
+            # so there is no window where the PS copy (stale) is the only
+            # readable one
+            self.victims.put(int(evicted_signs[i]),
+                             (ev_vals, ev_acc, i), token=token)
+        self._flush_q.put((token, evicted_signs, real, ev_vals, ev_acc))
+
+    # --- write-back -------------------------------------------------------
+
+    def _flush_loop(self):
+        while True:
+            job = self._flush_q.get()
+            if job is None:
+                self._flush_q.task_done()
+                return
+            try:
+                self._flush_job(*job)
+            except BaseException as e:  # surfaced on the next finish()
+                self._flush_err.append(e)
+            finally:
+                self._flush_q.task_done()
+
+    def _flush_job(self, token, evicted_signs, real, ev_vals, ev_acc):
+        vals = np.asarray(ev_vals)  # d2h here, off the training thread
+        acc = np.asarray(ev_acc)
+        todo_signs, todo_vecs = [], []
+        for i in real:
+            sign = int(evicted_signs[i])
+            # token-matched take: consume only THIS job's entry. Absent
+            # or different token => the miss path reclaimed the row (the
+            # cache copy is authoritative again) or a newer eviction owns
+            # the sign — either way writing our older value would clobber
+            # fresher state, so skip.
+            if self.victims.take_if(sign, token) is None:
+                continue
+            todo_signs.append(sign)
+            todo_vecs.append(np.concatenate([vals[i], acc[i]]))
+        if todo_signs:
+            self.worker.set_rows(
+                np.asarray(todo_signs, np.uint64),
+                np.stack(todo_vecs), self.dim)
+
+    def flush_all(self) -> int:
+        """Write every cached row (+ the victim buffer) back to the PS.
+        Called before eval/checkpoint so the PS is authoritative. The
+        cache stays valid for continued training. Returns rows written."""
+        self._drain_flush_queue()
+        signs, slots = self.mapper.signs_and_slots()
+        n = len(signs)
+        if n:
+            vals = np.asarray(self.cache_vals)[slots]
+            acc = np.asarray(self.cache_acc)[slots]
+            vecs = np.concatenate([vals, acc], axis=1)
+            self.worker.set_rows(signs, vecs, self.dim)
+        while True:
+            item = self.victims.pop_any()
+            if item is None:
+                break
+            # payloads are always (ev_vals, ev_acc, row) triples; after
+            # the queue drain this loop is normally empty, but a row left
+            # behind (e.g. flush after close()) must still write back
+            sign, (vvals, vacc, row) = item
+            vec = np.concatenate(
+                [np.asarray(vvals)[row], np.asarray(vacc)[row]])
+            self.worker.set_rows(
+                np.asarray([sign], np.uint64), vec[None, :], self.dim)
+            n += 1
+        return n
+
+    def invalidate(self) -> None:
+        """Drop every cached row WITHOUT writing back — checkpoint
+        restore: the cache predates the loaded values, so both serving
+        further hits from it and flushing it would clobber the restore.
+        Queued write-backs are drained first and their PS writes land
+        BEFORE the restore overwrites them (load happens after this
+        returns), which is the correct order."""
+        self._drain_flush_queue()
+        while self.victims.pop_any() is not None:
+            pass
+        self.mapper = SignSlotMap(self.capacity)
+        from persia_tpu.parallel.cached_train import init_cache_arrays
+
+        self.cache_vals, self.cache_acc = init_cache_arrays(
+            self.capacity, self.dim, self.acc_init)
+
+    def _drain_flush_queue(self):
+        """Block until queued write-backs complete (order matters: a
+        flush_all snapshot must not be overwritten by an older queued
+        eviction landing later). task_done bookkeeping in _flush_loop
+        makes join() cover the in-progress job too."""
+        self._flush_q.join()
+        if self._flush_err:
+            raise self._flush_err[0]
+
+    def close(self):
+        self._flush_q.put(None)
+
+    @property
+    def hit_rate(self) -> float:
+        return self.mapper.hit_rate

@@ -1,0 +1,161 @@
+"""Jitted train step for the device-resident embedding cache.
+
+One compiled XLA program per step does ALL of: import this batch's
+cache-miss rows (scatter), read back the rows they evict (gather, for
+host write-back to the PS), embedding gather, dense forward/backward,
+dense optimizer update, AND the sparse Adagrad update applied directly
+to the cached rows on device. Nothing but miss rows and slot indices
+crosses the host<->device wire — the hybrid path's per-step packed
+upload/download (persia_tpu/parallel/train.py make_packed_train_step)
+disappears for cache hits.
+
+The sparse update mirrors the parameter server's decayed Adagrad
+bit-for-bit in structure (persia_tpu/ps/optim.py SparseAdagrad,
+non-shared; reference optim.rs:246-307): the step uses the accumulator
+value from BEFORE this batch's gradient is accumulated, duplicate signs
+within a batch contribute a summed gradient exactly like the
+middleware's dedup+sum, and untouched rows keep their accumulator
+(no decay without a gradient — same as rows the PS never sees).
+
+Host-side mapping/eviction policy lives in
+persia_tpu/worker/device_cache.py; the orchestration tying both to
+TrainCtx is persia_tpu/parallel/cached_engine.py.
+"""
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from persia_tpu.parallel.train import (
+    TrainState,
+    _rebuild_embedding_inputs,
+    bce_loss,
+)
+
+
+def init_cache_arrays(capacity: int, dim: int, acc_init: float):
+    """(capacity+1, dim) value + accumulator arrays; the extra row is the
+    dummy slot that padded miss entries target (writes land there and are
+    never read)."""
+    vals = jnp.zeros((capacity + 1, dim), jnp.float32)
+    acc = jnp.full((capacity + 1, dim), acc_init, jnp.float32)
+    return vals, acc
+
+
+def make_cached_train_step(
+    model,
+    optimizer: optax.GradientTransformation,
+    num_slots: int,
+    dim: int,
+    lr: float,
+    eps: float,
+    g_square_momentum: float,
+    loss_fn: Callable = bce_loss,
+    weight_bound: float = 0.0,
+) -> Callable:
+    """step(state, cache_vals, cache_acc, non_id, slot_idx, cold_idx,
+    cold_vals, cold_acc, label) -> (state, cache_vals, cache_acc, loss,
+    pred, evicted_vals, evicted_acc)
+
+    - slot_idx: (B, S) int32 — cache slot per (sample, slot) position;
+    - cold_idx: (M,) int32 — slots receiving this batch's miss rows
+      (padded entries point at the dummy slot);
+    - cold_vals/cold_acc: (M, D) — miss rows (+ Adagrad state) fetched
+      from the PS / victim buffer;
+    - evicted_vals/evicted_acc: (M, D) — the PREVIOUS contents of
+      cold_idx slots, read before the overwrite; the host writes these
+      back to the PS keyed by the evicted signs.
+    """
+
+    def step(state: TrainState, cache_vals, cache_acc, non_id_tensors,
+             slot_idx, cold_idx, cold_vals, cold_acc, label):
+        # read rows being evicted BEFORE their slots are reused
+        evicted_vals = cache_vals[cold_idx]
+        evicted_acc = cache_acc[cold_idx]
+        # write-allocate this batch's misses (pads target the dummy row)
+        cache_vals = cache_vals.at[cold_idx].set(cold_vals)
+        cache_acc = cache_acc.at[cold_idx].set(cold_acc)
+
+        gathered = cache_vals[slot_idx]  # (B, S, D)
+
+        def compute_loss(params, gathered):
+            variables = {"params": params}
+            if state.batch_stats:
+                variables["batch_stats"] = state.batch_stats
+            emb_values = [gathered[:, i, :] for i in range(num_slots)]
+            emb_inputs = _rebuild_embedding_inputs(
+                emb_values, [None] * num_slots)
+            out = model.apply(
+                variables, non_id_tensors, emb_inputs, train=True,
+                mutable=["batch_stats"] if state.batch_stats else [],
+            )
+            pred, mutated = out if isinstance(out, tuple) else (out, {})
+            return loss_fn(pred, label), (pred, mutated)
+
+        grad_fn = jax.value_and_grad(compute_loss, argnums=(0, 1),
+                                     has_aux=True)
+        (loss, (pred, mutated)), (param_grads, emb_grad) = grad_fn(
+            state.params, gathered)
+
+        updates, new_opt_state = optimizer.update(
+            param_grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(
+            params=new_params,
+            batch_stats=mutated.get("batch_stats", state.batch_stats),
+            opt_state=new_opt_state,
+            step=state.step + 1,
+        )
+
+        # sparse Adagrad on device. scatter-add sums duplicate signs'
+        # gradients (== middleware dedup+sum), then one optimizer step
+        # per touched row with the PRE-update accumulator.
+        flat_idx = slot_idx.reshape(-1)
+        gsum = jnp.zeros_like(cache_vals).at[flat_idx].add(
+            emb_grad.reshape(-1, dim))
+        touched = jnp.zeros((cache_vals.shape[0], 1), jnp.bool_).at[
+            flat_idx].set(True)
+        cache_vals = cache_vals - lr * gsum * jax.lax.rsqrt(cache_acc + eps)
+        if weight_bound > 0:
+            # the PS clamps after every update (ps/optim.py
+            # apply_weight_bound; reference persia-simd lib.rs:231-251) —
+            # mirror it or cached and uncached training diverge for hot
+            # rows near the bound
+            cache_vals = jnp.where(
+                touched,
+                jnp.clip(cache_vals, -weight_bound, weight_bound),
+                cache_vals)
+        cache_acc = jnp.where(
+            touched, cache_acc * g_square_momentum + gsum * gsum, cache_acc)
+        return (new_state, cache_vals, cache_acc, loss, pred,
+                evicted_vals, evicted_acc)
+
+    # donate the cache arrays: they are carried state, updated in place
+    return jax.jit(step, donate_argnums=(1, 2))
+
+
+def make_cached_eval_step(model, num_slots: int) -> Callable:
+    """Pure gather + forward for signs fully resident in the cache."""
+
+    def step(state: TrainState, cache_vals, non_id_tensors, slot_idx):
+        variables = {"params": state.params}
+        if state.batch_stats:
+            variables["batch_stats"] = state.batch_stats
+        gathered = cache_vals[slot_idx]
+        emb_values = [gathered[:, i, :] for i in range(num_slots)]
+        emb_inputs = _rebuild_embedding_inputs(emb_values, [None] * num_slots)
+        return model.apply(variables, non_id_tensors, emb_inputs, train=False)
+
+    return jax.jit(step)
+
+
+def pad_to_bucket(n: int, buckets: Sequence[int]) -> int:
+    """Pad a miss count to a fixed size so jit reuses a few compiled
+    geometries instead of recompiling per distinct count."""
+    for b in buckets:
+        if n <= b:
+            return b
+    return int(np.ceil(n / buckets[-1]) * buckets[-1])

@@ -224,6 +224,33 @@ class NativeEmbeddingHolder:
         vec = np.ascontiguousarray(vec, dtype=np.float32)
         self._lib.ptps_set_entry(self._h, sign, dim, _f32_ptr(vec), len(vec))
 
+    def get_entries(self, signs: np.ndarray, width: int):
+        """Batched get_entry (uniform width; absent/mismatched width =>
+        not found). One ctypes call per sign locally — the point of the
+        batch shape is the RPC twin, where it collapses to ONE round
+        trip (ps_service get_entries)."""
+        signs = np.ascontiguousarray(signs, dtype=np.uint64)
+        n = len(signs)
+        found = np.zeros(n, dtype=bool)
+        vecs = np.zeros((n, width), dtype=np.float32)
+        dim_out = ctypes.c_uint32(0)
+        buf = np.empty(width, dtype=np.float32)
+        for i in range(n):
+            length = self._lib.ptps_get_entry(
+                self._h, int(signs[i]), _f32_ptr(buf), width,
+                ctypes.byref(dim_out))
+            if length == width:
+                found[i] = True
+                vecs[i] = buf
+        return found, vecs
+
+    def set_entries(self, signs: np.ndarray, dim: int, vecs: np.ndarray):
+        signs = np.ascontiguousarray(signs, dtype=np.uint64)
+        vecs = np.ascontiguousarray(vecs, dtype=np.float32)
+        for i in range(len(signs)):
+            self._lib.ptps_set_entry(self._h, int(signs[i]), dim,
+                                     _f32_ptr(vecs[i]), vecs.shape[1])
+
     def clear(self):
         self._lib.ptps_clear(self._h)
 

@@ -273,6 +273,40 @@ class EmbeddingHolder:
                 sign, dim, np.ascontiguousarray(vec, dtype=np.float32)
             )
 
+    def get_entries(self, signs: np.ndarray, width: int):
+        """Batched ``get_entry`` for uniform-width entries (value + opt
+        state): one call — and on the RPC twin ONE round trip — instead
+        of n. Entries absent or of a different width read as not-found.
+        Returns (found (n,) bool, vecs (n, width) f32)."""
+        signs = np.ascontiguousarray(signs, dtype=np.uint64)
+        n = len(signs)
+        found = np.zeros(n, dtype=bool)
+        vecs = np.zeros((n, width), dtype=np.float32)
+        shard_ids = internal_shard_of(signs, self.num_internal_shards)
+        for shard_idx in np.unique(shard_ids):
+            sel = np.nonzero(shard_ids == shard_idx)[0]
+            with self._locks[shard_idx]:
+                shard = self._shards[shard_idx]
+                for pos in sel:
+                    entry = shard.get(int(signs[pos]))
+                    if entry is not None and len(entry[1]) == width:
+                        found[pos] = True
+                        vecs[pos] = entry[1]
+        return found, vecs
+
+    def set_entries(self, signs: np.ndarray, dim: int, vecs: np.ndarray):
+        """Batched ``set_entry`` (uniform dim): the device cache's
+        write-back path."""
+        signs = np.ascontiguousarray(signs, dtype=np.uint64)
+        vecs = np.ascontiguousarray(vecs, dtype=np.float32)
+        shard_ids = internal_shard_of(signs, self.num_internal_shards)
+        for shard_idx in np.unique(shard_ids):
+            sel = np.nonzero(shard_ids == shard_idx)[0]
+            with self._locks[shard_idx]:
+                shard = self._shards[shard_idx]
+                for pos in sel:
+                    shard.insert(int(signs[pos]), dim, vecs[pos].copy())
+
     def clear(self):
         for lock, shard in zip(self._locks, self._shards):
             with lock:

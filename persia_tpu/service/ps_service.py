@@ -47,6 +47,8 @@ class PsService:
         s.register("len", self._len)
         s.register("get_entry", self._get_entry)
         s.register("set_entry", self._set_entry)
+        s.register("get_entries", self._get_entries)
+        s.register("set_entries", self._set_entries)
         s.register("clear", self._clear)
         s.register("dump", self._dump)
         s.register("load", self._load)
@@ -101,6 +103,21 @@ class PsService:
     def _set_entry(self, payload: bytes) -> bytes:
         meta, (vec,) = unpack_arrays(payload)
         self.holder.set_entry(meta["sign"], meta["dim"], vec)
+        return b""
+
+    def _get_entries(self, payload: bytes) -> bytes:
+        """Batched entry read (value + opt state) — ONE round trip for
+        the device cache's miss import instead of one per sign."""
+        meta, (signs,) = unpack_arrays(payload)
+        found, vecs = self.holder.get_entries(
+            signs, meta["width"])
+        return pack_arrays({}, [found.astype(np.uint8), vecs])
+
+    def _set_entries(self, payload: bytes) -> bytes:
+        meta, (signs, vecs) = unpack_arrays(payload)
+        self.holder.set_entries(
+            signs, meta["dim"],
+            vecs.reshape(len(signs), -1))
         return b""
 
     def _clear(self, payload: bytes) -> bytes:
@@ -209,6 +226,20 @@ class PsClient:
             {"sign": int(sign), "dim": int(dim)},
             [np.ascontiguousarray(vec, np.float32)],
         ))
+
+    def get_entries(self, signs: np.ndarray, width: int):
+        payload = pack_arrays({"width": int(width)}, [
+            np.ascontiguousarray(signs, np.uint64)])
+        _, (found, vecs) = unpack_arrays(
+            self.client.call("get_entries", payload))
+        return (found.astype(bool),
+                vecs.reshape(len(signs), width).astype(np.float32))
+
+    def set_entries(self, signs: np.ndarray, dim: int, vecs: np.ndarray):
+        self.client.call("set_entries", pack_arrays({"dim": int(dim)}, [
+            np.ascontiguousarray(signs, np.uint64),
+            np.ascontiguousarray(vecs, np.float32),
+        ]), dedup=True)
 
     def clear(self):
         self.client.call("clear")

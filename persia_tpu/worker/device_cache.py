@@ -1,0 +1,178 @@
+"""Device-resident embedding cache: host-side sign->slot mapping.
+
+The hybrid path's ceiling is the host<->device wire: every step uploads
+the full packed embedding matrix and downloads the full gradient matrix
+(~3.4 MB each way at bs 4096 x 26 x dim 16 bf16). Real CTR traffic is
+heavily Zipf-skewed, so a device-resident cache of hot rows with a
+device-side sparse optimizer removes both transfers for hits — only
+cache-miss rows and their (slot-index) metadata cross the wire, and
+evicted rows trickle back to the parameter server off the training
+thread. This is a TPU-first capability beyond the reference (PERSIA
+keeps all sparse state PS-side and pays the full wire every step;
+cf. rust/persia-core/src/forward.rs h2d + backward.rs d2h paths).
+
+This module is the HOST side: an LRU sign->slot map with
+current-batch pinning, and the victim buffer that makes eviction
+write-back async-safe. The device side (cache arrays + fused
+gather/train/scatter step) lives in persia_tpu/parallel/cached_train.py.
+"""
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class SignSlotMap:
+    """LRU map from embedding sign -> device cache slot.
+
+    ``assign`` is called once per training batch, on the ordered path
+    (batch order defines LRU order). Slots are integers in [0, capacity).
+    Eviction picks the least-recently-used sign NOT part of the current
+    batch: a victim that reappeared later in the same batch would be
+    re-fetched from the PS before its in-flight device value ever got
+    flushed, silently losing updates — so current-batch signs are pinned.
+    """
+
+    def __init__(self, capacity: int):
+        if capacity <= 0:
+            raise ValueError("cache capacity must be positive")
+        self.capacity = int(capacity)
+        # sign -> slot; dict preserves insertion order, and moving a key
+        # to the end on touch gives an O(1) LRU (python-native; the C++
+        # mapper in native/src can replace this loop if it ever dominates)
+        self._map: Dict[int, int] = {}
+        self._free: List[int] = list(range(capacity - 1, -1, -1))
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    def assign(self, signs: np.ndarray
+               ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Map a batch of signs to slots, allocating on miss.
+
+        Returns ``(slots, miss_pos, evicted_signs)``:
+        - slots: int32 (n,) cache slot per sign;
+        - miss_pos: int64 positions (within ``signs``) that were misses
+          (first occurrence only — a duplicate of an earlier miss in the
+          same batch hits the freshly assigned slot);
+        - evicted_signs: uint64, same length as miss_pos; the sign whose
+          slot was reused for this miss, or 0 when a free slot was used.
+          The caller must write the evicted sign's device row back to the
+          PS (see VictimBuffer).
+        """
+        signs = np.ascontiguousarray(signs, dtype=np.uint64)
+        n = len(signs)
+        m = self._map
+        batch_signs = set(int(s) for s in signs)
+        if len(batch_signs) > self.capacity:
+            raise ValueError(
+                f"batch has {len(batch_signs)} distinct signs but cache "
+                f"capacity is {self.capacity}; eviction pinning needs "
+                "capacity >= distinct signs per batch")
+        slots = np.empty(n, dtype=np.int32)
+        miss_pos: List[int] = []
+        evicted: List[int] = []
+        for i in range(n):
+            s = int(signs[i])
+            slot = m.pop(s, None)
+            if slot is not None:  # hit: refresh to MRU
+                m[s] = slot
+                slots[i] = slot
+                self.hits += 1
+                continue
+            self.misses += 1
+            if self._free:
+                slot = self._free.pop()
+                evicted.append(0)
+            else:
+                # evict LRU skipping pinned (current-batch) signs
+                victim = next(k for k in m if k not in batch_signs)
+                slot = m.pop(victim)
+                evicted.append(victim)
+                self.evictions += 1
+            m[s] = slot
+            slots[i] = slot
+            miss_pos.append(i)
+        return (slots,
+                np.asarray(miss_pos, dtype=np.int64),
+                np.asarray(evicted, dtype=np.uint64))
+
+    def drop(self, sign: int) -> Optional[int]:
+        """Remove a sign (after flush_all); returns its freed slot."""
+        slot = self._map.pop(int(sign), None)
+        if slot is not None:
+            self._free.append(slot)
+        return slot
+
+    def signs_and_slots(self) -> Tuple[np.ndarray, np.ndarray]:
+        """All cached (signs, slots) — the flush_all working set."""
+        if not self._map:
+            return (np.empty(0, np.uint64), np.empty(0, np.int32))
+        return (np.fromiter(self._map.keys(), np.uint64, len(self._map)),
+                np.fromiter(self._map.values(), np.int32, len(self._map)))
+
+    @property
+    def hit_rate(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
+
+class VictimBuffer:
+    """In-flight evicted rows, keyed by sign.
+
+    Eviction write-back is asynchronous (the device->host fetch of the
+    evicted row plus the PS set_entry run on a flush thread, off the
+    training path). Until that completes, the PS copy of the evicted
+    sign is stale — a cache miss on the same sign must read the
+    in-flight value here, not the PS. ``pending`` values may be jax
+    device arrays; ``take``/``flush_one`` materialize them (np.asarray)
+    at the point of use, so the d2h transfer also stays off the training
+    thread."""
+
+    def __init__(self):
+        # sign -> (token, payload). The token identifies WHICH eviction
+        # produced the entry: a write-back job may only consume its own
+        # (take_if) — otherwise this ABA sequence loses an update:
+        # evict(job A) -> miss reclaims row -> evict again(job B);
+        # job A's plain take would steal B's fresher entry and write A's
+        # older value to the PS while B later finds nothing to write.
+        self._pending: Dict[int, Tuple[int, object]] = {}
+        self._lock = threading.Lock()
+
+    def put(self, sign: int, payload, token: int = 0) -> None:
+        with self._lock:
+            self._pending[int(sign)] = (token, payload)
+
+    def take(self, sign: int):
+        """Remove and return the pending payload (None if absent). Used
+        by the miss path: any pending entry is the freshest copy (newer
+        puts overwrite older), so no token check."""
+        with self._lock:
+            entry = self._pending.pop(int(sign), None)
+            return None if entry is None else entry[1]
+
+    def take_if(self, sign: int, token: int):
+        """Remove and return the payload only if the entry's token
+        matches (the write-back path)."""
+        with self._lock:
+            entry = self._pending.get(int(sign))
+            if entry is None or entry[0] != token:
+                return None
+            del self._pending[int(sign)]
+            return entry[1]
+
+    def pop_any(self):
+        """Remove and return an arbitrary (sign, payload), or None."""
+        with self._lock:
+            if not self._pending:
+                return None
+            sign = next(iter(self._pending))
+            return sign, self._pending.pop(sign)[1]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._pending)

@@ -373,6 +373,57 @@ class EmbeddingWorker:
 
     # --- checkpoint fan-out ----------------------------------------------
 
+    # --- raw row access (device-cache miss/write-back path) --------------
+
+    def lookup_rows_with_state(self, signs: np.ndarray, dim: int,
+                               default_state: float = 0.0):
+        """Per-sign rows INCLUDING optimizer state, routed by the same
+        farmhash shard split as normal lookups. The batched ``lookup``
+        first creates+initializes any missing entries exactly like a
+        training lookup; the batched ``get_entries`` then reads the full
+        vecs (value + state) — one extra round trip per replica, not per
+        sign — so a re-admitted sign keeps its accumulator history.
+        Admission-rejected signs stay absent: value 0, state
+        ``default_state``. Returns (vals (n, dim) f32, state (n, dim)
+        f32; non-shared Adagrad state width == dim, the only optimizer
+        the device cache admits)."""
+        from persia_tpu.hashing import sign_to_shard
+
+        signs = np.ascontiguousarray(signs, dtype=np.uint64)
+        n = len(signs)
+        width = 2 * dim  # value + per-element accumulator
+        vals = np.zeros((n, dim), np.float32)
+        state = np.full((n, dim), default_state, np.float32)
+        shards = sign_to_shard(signs, self.replica_size)
+        for r in np.unique(shards):
+            sel = np.nonzero(shards == r)[0]
+
+            def fetch(r=r, sel=sel):
+                client = self.ps_clients[r]
+                client.lookup(signs[sel], dim, True)
+                return client.get_entries(signs[sel], width)
+
+            found, vecs = self._with_ps_retry(fetch)
+            hit = np.nonzero(found)[0]
+            vals[sel[hit]] = vecs[hit, :dim]
+            state[sel[hit]] = vecs[hit, dim:]
+        return vals, state
+
+    def set_rows(self, signs: np.ndarray, vecs: np.ndarray, dim: int):
+        """Write full rows (value + optimizer state) back, shard-routed,
+        one batched RPC per replica — the device cache's eviction
+        write-back / flush_all."""
+        from persia_tpu.hashing import sign_to_shard
+
+        signs = np.ascontiguousarray(signs, dtype=np.uint64)
+        vecs = np.ascontiguousarray(vecs, dtype=np.float32)
+        shards = sign_to_shard(signs, self.replica_size)
+        for r in np.unique(shards):
+            sel = np.nonzero(shards == r)[0]
+            self._with_ps_retry(
+                lambda r=r, sel=sel: self.ps_clients[r].set_entries(
+                    signs[sel], dim, vecs[sel]))
+
     def dump(self, dirpath: str):
         from persia_tpu.checkpoint import dump_sharded
         from persia_tpu.pipeline import flush_backward_engines
