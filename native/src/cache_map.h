@@ -1,0 +1,206 @@
+// Device-cache sign->slot LRU mapper — the C++ twin of
+// persia_tpu/worker/device_cache.py SignSlotMap.
+//
+// assign() is the hot host-side op of cached training: ~batch x slots
+// (100k at bs 4096 x 26) hash probes + LRU splices per step. The python
+// dict loop costs tens of ms there; this is the same flat-table +
+// index-links design as store.h's LruShard (open addressing, linear
+// probing, backward-shift deletion), minus entry payloads — the map
+// value IS the slot index.
+//
+// Semantics mirrored exactly (parity-tested in
+// tests/test_device_cache.py): hits refresh to MRU; misses take a free
+// slot, else evict the least-recently-used sign NOT pinned by the
+// current batch (pass 0 pins every currently-cached batch sign: an
+// in-batch victim would be re-fetched from the PS before its in-flight
+// device value got written back); duplicate in-batch misses allocate
+// once; distinct-signs > capacity is an error (-1).
+#pragma once
+
+#include <cstdint>
+#include <utility>
+#include <vector>
+
+#include "hashrng.h"
+
+namespace persia {
+
+class CacheMap {
+ public:
+  static constexpr uint32_t kNil = 0xFFFFFFFFu;
+
+  explicit CacheMap(uint64_t capacity) : cap_(capacity) {
+    slot_sign_.assign(cap_, 0);
+    prev_.assign(cap_, kNil);
+    next_.assign(cap_, kNil);
+    pin_epoch_.assign(cap_, 0);
+    uid_tag_.assign(cap_, 0);
+    batch_uid_.assign(cap_, 0);
+    free_.reserve(cap_);
+    for (uint64_t i = cap_; i > 0; --i)
+      free_.push_back(static_cast<uint32_t>(i - 1));
+    uint64_t nb = 16;
+    while (nb < 2 * cap_) nb <<= 1;
+    table_.assign(nb, {0, kNil});
+    mask_ = nb - 1;
+  }
+
+  // evicted_mask_out disambiguates "no victim (free slot)" from an
+  // evicted sign that happens to BE 0 — sign 0 is a legal sign (the
+  // "missing token" convention), so the sign value cannot be the marker.
+  //
+  // inverse_out/unique_slots_out (each sized n) expose the batch-local
+  // dedup the probe loop computes anyway: inverse_out[i] is the index of
+  // position i's sign among this batch's distinct signs, and
+  // unique_slots_out[u] the u-th distinct sign's slot. The device step
+  // dedup-sums gradients through this map into an O(batch)-sized buffer
+  // instead of a dense O(capacity) one. *n_unique_out gets the count.
+  int64_t assign(const uint64_t* signs, uint64_t n, int32_t* slots_out,
+                 int64_t* miss_pos_out, uint64_t* evicted_out,
+                 uint8_t* evicted_mask_out, int32_t* inverse_out,
+                 int32_t* unique_slots_out, int64_t* n_unique_out) {
+    ++epoch_;
+    for (uint64_t i = 0; i < n; ++i) {  // pass 0: pin cached batch signs
+      uint32_t s = find(signs[i]);
+      if (s != kNil) pin_epoch_[s] = epoch_;
+    }
+    int64_t misses = 0;
+    int64_t n_unique = 0;
+    for (uint64_t i = 0; i < n; ++i) {
+      const uint64_t sign = signs[i];
+      uint32_t s = find(sign);
+      if (s != kNil) {
+        detach(s);
+        push_back(s);  // refresh to MRU
+        slots_out[i] = static_cast<int32_t>(s);
+        if (uid_tag_[s] != epoch_) {
+          uid_tag_[s] = epoch_;
+          batch_uid_[s] = n_unique;
+          unique_slots_out[n_unique] = static_cast<int32_t>(s);
+          ++n_unique;
+        }
+        inverse_out[i] = static_cast<int32_t>(batch_uid_[s]);
+        continue;
+      }
+      uint64_t evicted = 0;
+      uint8_t evicted_real = 0;
+      if (!free_.empty()) {
+        s = free_.back();
+        free_.pop_back();
+      } else {
+        uint32_t v = head_;  // LRU end; skip pinned
+        while (v != kNil && pin_epoch_[v] == epoch_) v = next_[v];
+        if (v == kNil) return -1;  // capacity < distinct batch signs
+        evicted = slot_sign_[v];
+        evicted_real = 1;
+        table_erase(evicted);
+        detach(v);
+        s = v;
+      }
+      slot_sign_[s] = sign;
+      pin_epoch_[s] = epoch_;  // newly inserted is a batch sign: pinned
+      table_insert(sign, s);
+      push_back(s);
+      slots_out[i] = static_cast<int32_t>(s);
+      // a miss is always this batch's first occurrence of the sign
+      uid_tag_[s] = epoch_;
+      batch_uid_[s] = n_unique;
+      unique_slots_out[n_unique] = static_cast<int32_t>(s);
+      inverse_out[i] = static_cast<int32_t>(n_unique);
+      ++n_unique;
+      miss_pos_out[misses] = static_cast<int64_t>(i);
+      evicted_out[misses] = evicted;
+      evicted_mask_out[misses] = evicted_real;
+      ++misses;
+    }
+    *n_unique_out = n_unique;
+    return misses;
+  }
+
+  uint64_t size() const { return cap_ - free_.size(); }
+
+  // All (sign, slot) pairs in LRU->MRU order (flush_all's working set).
+  uint64_t items(uint64_t* signs_out, int32_t* slots_out) const {
+    uint64_t k = 0;
+    for (uint32_t s = head_; s != kNil; s = next_[s]) {
+      signs_out[k] = slot_sign_[s];
+      slots_out[k] = static_cast<int32_t>(s);
+      ++k;
+    }
+    return k;
+  }
+
+ private:
+  uint64_t cap_;
+  std::vector<uint64_t> slot_sign_;
+  std::vector<uint32_t> prev_, next_;
+  std::vector<uint64_t> pin_epoch_;
+  std::vector<uint64_t> uid_tag_;
+  std::vector<int64_t> batch_uid_;
+  std::vector<uint32_t> free_;
+  uint32_t head_ = kNil;  // least recently used
+  uint32_t tail_ = kNil;  // most recently used
+  uint64_t epoch_ = 0;
+  std::vector<std::pair<uint64_t, uint32_t>> table_;  // (sign, slot)
+  uint64_t mask_ = 0;
+
+  uint64_t ideal(uint64_t sign) const { return splitmix_mix(sign) & mask_; }
+
+  uint32_t find(uint64_t sign) const {
+    uint64_t i = ideal(sign);
+    for (;;) {
+      const auto& slot = table_[i];
+      if (slot.second == kNil) return kNil;
+      if (slot.first == sign) return slot.second;
+      i = (i + 1) & mask_;
+    }
+  }
+
+  void table_insert(uint64_t sign, uint32_t s) {
+    uint64_t i = ideal(sign);
+    while (table_[i].second != kNil) i = (i + 1) & mask_;
+    table_[i] = {sign, s};
+  }
+
+  void table_erase(uint64_t sign) {
+    uint64_t i = ideal(sign);
+    while (table_[i].first != sign || table_[i].second == kNil) {
+      if (table_[i].second == kNil) return;
+      i = (i + 1) & mask_;
+    }
+    uint64_t hole = i;
+    uint64_t j = (i + 1) & mask_;
+    while (table_[j].second != kNil) {
+      uint64_t h = ideal(table_[j].first);
+      if (((j - h) & mask_) >= ((j - hole) & mask_)) {
+        table_[hole] = table_[j];
+        hole = j;
+      }
+      j = (j + 1) & mask_;
+    }
+    table_[hole] = {0, kNil};
+  }
+
+  void detach(uint32_t s) {
+    if (prev_[s] != kNil)
+      next_[prev_[s]] = next_[s];
+    else
+      head_ = next_[s];
+    if (next_[s] != kNil)
+      prev_[next_[s]] = prev_[s];
+    else
+      tail_ = prev_[s];
+  }
+
+  void push_back(uint32_t s) {
+    prev_[s] = tail_;
+    next_[s] = kNil;
+    if (tail_ != kNil)
+      next_[tail_] = s;
+    else
+      head_ = s;
+    tail_ = s;
+  }
+};
+
+}  // namespace persia

@@ -3,6 +3,7 @@
 #include <cstdint>
 #include <cstring>
 
+#include "cache_map.h"
 #include "hashrng.h"
 #include "mw_kernels.h"
 #include "store.h"
@@ -138,6 +139,25 @@ void ptmw_scatter_rows(float* dst, const int32_t* idx, int64_t m, int32_t dim,
 void ptmw_scatter_add_rows(float* dst, const int32_t* idx, int64_t m,
                            int32_t dim, const float* src) {
   persia::mw_scatter_add_rows(dst, idx, m, dim, src);
+}
+
+// Device-cache sign->slot LRU mapper (cache_map.h).
+void* ptcm_new(uint64_t capacity) { return new persia::CacheMap(capacity); }
+void ptcm_free(void* m) { delete static_cast<persia::CacheMap*>(m); }
+int64_t ptcm_assign(void* m, const uint64_t* signs, uint64_t n,
+                    int32_t* slots_out, int64_t* miss_pos_out,
+                    uint64_t* evicted_out, uint8_t* evicted_mask_out,
+                    int32_t* inverse_out, int32_t* unique_slots_out,
+                    int64_t* n_unique_out) {
+  return static_cast<persia::CacheMap*>(m)->assign(
+      signs, n, slots_out, miss_pos_out, evicted_out, evicted_mask_out,
+      inverse_out, unique_slots_out, n_unique_out);
+}
+uint64_t ptcm_len(void* m) {
+  return static_cast<persia::CacheMap*>(m)->size();
+}
+uint64_t ptcm_items(void* m, uint64_t* signs_out, int32_t* slots_out) {
+  return static_cast<persia::CacheMap*>(m)->items(signs_out, slots_out);
 }
 
 }  // extern "C"

@@ -226,6 +226,8 @@ class TrainCtx(EmbeddingCtx):
         super().__enter__()
         if self.embedding_optimizer is not None:
             self.embedding_optimizer.apply()
+        if self._cache_engine is not None:
+            self._cache_engine.ensure_open()  # re-entry after __exit__
         return self
 
     def _wire_dtype(self):
@@ -505,16 +507,17 @@ class TrainCtx(EmbeddingCtx):
     def _cached_train_step(self, batch: PersiaBatch):
         self._ensure_cache(batch)
         eng = self._cache_engine
-        slot_idx, cold_idx, cold_vals, cold_acc, evicted = eng.prepare(
-            batch.id_type_features)
+        (slot_idx, cold_idx, cold_vals, cold_acc, evicted, evicted_mask,
+         inverse, unique_slots) = eng.prepare(batch.id_type_features)
         non_id = [jnp.asarray(f.data) for f in batch.non_id_type_features]
         label = jnp.asarray(batch.labels[0].data)
         (self.state, eng.cache_vals, eng.cache_acc, loss, pred,
          ev_vals, ev_acc) = self._cached_step(
             self.state, eng.cache_vals, eng.cache_acc, non_id,
             jnp.asarray(slot_idx), jnp.asarray(cold_idx),
-            jnp.asarray(cold_vals), jnp.asarray(cold_acc), label)
-        eng.finish(evicted, ev_vals, ev_acc)
+            jnp.asarray(cold_vals), jnp.asarray(cold_acc),
+            jnp.asarray(inverse), jnp.asarray(unique_slots), label)
+        eng.finish(evicted, evicted_mask, ev_vals, ev_acc)
         return loss, pred
 
     def flush_device_cache(self) -> int:
@@ -523,6 +526,18 @@ class TrainCtx(EmbeddingCtx):
         if self._cache_engine is None:
             return 0
         return self._cache_engine.flush_all()
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        # leaving the ctx must leave the PS authoritative (a later
+        # InferCtx / dump / second TrainCtx reads it) and must not leak
+        # the flush thread
+        if self._cache_engine is not None:
+            try:
+                if exc_type is None:
+                    self.flush_device_cache()
+            finally:
+                self._cache_engine.close()
+        return super().__exit__(exc_type, exc_val, exc_tb)
 
     def dump_checkpoint(self, dst_dir: str, with_dense: bool = True):
         self.flush_device_cache()

@@ -23,7 +23,7 @@ from typing import List, Tuple
 import numpy as np
 
 from persia_tpu.parallel.cached_train import pad_to_bucket
-from persia_tpu.worker.device_cache import SignSlotMap, VictimBuffer
+from persia_tpu.worker.device_cache import VictimBuffer, make_sign_slot_map
 
 _BUCKETS = (64, 256, 1024, 4096, 16384, 65536)
 
@@ -36,7 +36,7 @@ class DeviceCacheEngine:
         self.num_slots = int(num_slots)
         self.dim = int(dim)
         self.acc_init = float(acc_init)
-        self.mapper = SignSlotMap(capacity)
+        self.mapper = make_sign_slot_map(capacity)
         self.victims = VictimBuffer()
         from persia_tpu.parallel.cached_train import init_cache_arrays
 
@@ -60,15 +60,23 @@ class DeviceCacheEngine:
 
         Returns (slot_idx (B,S) i32, cold_idx (Mpad,) i32, cold_vals
         (Mpad, D) f32, cold_acc (Mpad, D) f32, evicted_signs (Mpad,)
-        u64). Runs on the ordered training path — batch order IS the
-        LRU order.
+        u64, evicted_mask (Mpad,) bool, inverse (B*S,) i32,
+        unique_slots (B*S,) i32). Runs on the ordered training path —
+        batch order IS the LRU order.
         """
         # single-id slots: f.signs is exactly one sign per sample (the
         # ctx-level guard verified this before building the engine)
         signs = np.stack([f.signs for f in id_type_features], axis=1)
         batch, num_slots = signs.shape
         flat_signs = signs.reshape(-1)
-        slots, miss_pos, evicted = self.mapper.assign(flat_signs)
+        res = self.mapper.assign(flat_signs)
+        slots, miss_pos, evicted, emask = (res.slots, res.miss_pos,
+                                           res.evicted_signs,
+                                           res.evicted_mask)
+        # tail past the distinct count is uninitialized: point it at the
+        # dummy slot so the device update's pad rows are inert
+        unique_slots = res.unique_slots
+        unique_slots[res.n_unique:] = self.capacity
         slot_idx = slots.reshape(batch, num_slots)
         miss_signs = flat_signs[miss_pos]
         m = len(miss_signs)
@@ -77,9 +85,11 @@ class DeviceCacheEngine:
         cold_vals = np.zeros((mpad, self.dim), np.float32)
         cold_acc = np.full((mpad, self.dim), self.acc_init, np.float32)
         evicted_signs = np.zeros(mpad, np.uint64)
+        evicted_mask = np.zeros(mpad, bool)
         if m:
             cold_idx[:m] = slots[miss_pos]
             evicted_signs[:m] = evicted
+            evicted_mask[:m] = emask
             # victim buffer first: an evicted row still in flight is the
             # authoritative copy (the PS write-back may not have landed).
             # Entries are (ev_vals, ev_acc, row) with possibly-device
@@ -110,15 +120,18 @@ class DeviceCacheEngine:
         moved = (slot_idx.nbytes + cold_idx.nbytes + cold_vals.nbytes
                  + cold_acc.nbytes + (2 * mpad * self.dim * 4))
         self.wire_bytes_saved += max(0, packed - moved)
-        return slot_idx, cold_idx, cold_vals, cold_acc, evicted_signs
+        return (slot_idx, cold_idx, cold_vals, cold_acc, evicted_signs,
+                evicted_mask, res.inverse, unique_slots)
 
-    def finish(self, evicted_signs: np.ndarray, ev_vals, ev_acc) -> None:
+    def finish(self, evicted_signs: np.ndarray, evicted_mask: np.ndarray,
+               ev_vals, ev_acc) -> None:
         """Queue evicted rows for async PS write-back. ``ev_vals`` /
         ``ev_acc`` may be jax device arrays; the d2h materialization
-        happens on the flush thread."""
+        happens on the flush thread. The mask (not sign truthiness)
+        selects real evictions — sign 0 is a legal sign."""
         if self._flush_err:
             raise self._flush_err[0]
-        real = [i for i, s in enumerate(evicted_signs) if s]
+        real = list(np.nonzero(evicted_mask)[0])
         if not real:
             return
         self._flush_token += 1
@@ -153,12 +166,12 @@ class DeviceCacheEngine:
         todo_signs, todo_vecs = [], []
         for i in real:
             sign = int(evicted_signs[i])
-            # token-matched take: consume only THIS job's entry. Absent
-            # or different token => the miss path reclaimed the row (the
-            # cache copy is authoritative again) or a newer eviction owns
-            # the sign — either way writing our older value would clobber
-            # fresher state, so skip.
-            if self.victims.take_if(sign, token) is None:
+            # token-matched PEEK (no removal yet): absent or different
+            # token => the miss path reclaimed the row (the cache copy is
+            # authoritative again) or a newer eviction owns the sign —
+            # either way writing our older value would clobber fresher
+            # state, so skip.
+            if self.victims.peek_if(sign, token) is None:
                 continue
             todo_signs.append(sign)
             todo_vecs.append(np.concatenate([vals[i], acc[i]]))
@@ -166,6 +179,13 @@ class DeviceCacheEngine:
             self.worker.set_rows(
                 np.asarray(todo_signs, np.uint64),
                 np.stack(todo_vecs), self.dim)
+        # remove only AFTER the PS write landed: a miss racing the write
+        # must keep finding the pending entry, otherwise it would read
+        # the stale pre-write PS row. A miss that took the entry mid-
+        # write is also fine — the PS got the same value, and the cache
+        # copy stays authoritative.
+        for sign in todo_signs:
+            self.victims.take_if(sign, token)
 
     def flush_all(self) -> int:
         """Write every cached row (+ the victim buffer) back to the PS.
@@ -204,7 +224,7 @@ class DeviceCacheEngine:
         self._drain_flush_queue()
         while self.victims.pop_any() is not None:
             pass
-        self.mapper = SignSlotMap(self.capacity)
+        self.mapper = make_sign_slot_map(self.capacity)
         from persia_tpu.parallel.cached_train import init_cache_arrays
 
         self.cache_vals, self.cache_acc = init_cache_arrays(
@@ -220,7 +240,19 @@ class DeviceCacheEngine:
             raise self._flush_err[0]
 
     def close(self):
-        self._flush_q.put(None)
+        """Stop the flush thread (TrainCtx.__exit__). The engine's state
+        (cache arrays, mapper) stays valid; ensure_open() restarts the
+        thread if the ctx is re-entered."""
+        if self._flush_thread.is_alive():
+            self._flush_q.put(None)
+            self._flush_thread.join(timeout=30)
+
+    def ensure_open(self):
+        if not self._flush_thread.is_alive():
+            self._flush_thread = threading.Thread(
+                target=self._flush_loop, daemon=True,
+                name="device-cache-flush")
+            self._flush_thread.start()
 
     @property
     def hit_rate(self) -> float:

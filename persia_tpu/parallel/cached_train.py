@@ -57,21 +57,26 @@ def make_cached_train_step(
     weight_bound: float = 0.0,
 ) -> Callable:
     """step(state, cache_vals, cache_acc, non_id, slot_idx, cold_idx,
-    cold_vals, cold_acc, label) -> (state, cache_vals, cache_acc, loss,
-    pred, evicted_vals, evicted_acc)
+    cold_vals, cold_acc, inverse, unique_slots, label) -> (state,
+    cache_vals, cache_acc, loss, pred, evicted_vals, evicted_acc)
 
     - slot_idx: (B, S) int32 — cache slot per (sample, slot) position;
     - cold_idx: (M,) int32 — slots receiving this batch's miss rows
       (padded entries point at the dummy slot);
     - cold_vals/cold_acc: (M, D) — miss rows (+ Adagrad state) fetched
       from the PS / victim buffer;
+    - inverse: (B*S,) int32 — position -> index among this batch's
+      distinct signs (the mapper computes it during its probe pass);
+    - unique_slots: (B*S,) int32 — distinct index -> cache slot, tail
+      past the distinct count padded with the dummy slot;
     - evicted_vals/evicted_acc: (M, D) — the PREVIOUS contents of
       cold_idx slots, read before the overwrite; the host writes these
       back to the PS keyed by the evicted signs.
     """
 
     def step(state: TrainState, cache_vals, cache_acc, non_id_tensors,
-             slot_idx, cold_idx, cold_vals, cold_acc, label):
+             slot_idx, cold_idx, cold_vals, cold_acc, inverse,
+             unique_slots, label):
         # read rows being evicted BEFORE their slots are reused
         evicted_vals = cache_vals[cold_idx]
         evicted_acc = cache_acc[cold_idx]
@@ -110,26 +115,33 @@ def make_cached_train_step(
             step=state.step + 1,
         )
 
-        # sparse Adagrad on device. scatter-add sums duplicate signs'
-        # gradients (== middleware dedup+sum), then one optimizer step
-        # per touched row with the PRE-update accumulator.
-        flat_idx = slot_idx.reshape(-1)
-        gsum = jnp.zeros_like(cache_vals).at[flat_idx].add(
-            emb_grad.reshape(-1, dim))
-        touched = jnp.zeros((cache_vals.shape[0], 1), jnp.bool_).at[
-            flat_idx].set(True)
-        cache_vals = cache_vals - lr * gsum * jax.lax.rsqrt(cache_acc + eps)
+        # Sparse Adagrad on device, touching ONLY this batch's rows and
+        # allocating ONLY O(batch)-sized buffers: duplicate signs'
+        # gradients dedup-sum through the mapper's inverse map (==
+        # middleware dedup+sum) into a (B*S, D) buffer — NOT a dense
+        # (capacity, D) one, which would cost a full-cache zero-init +
+        # memory pass per step. One optimizer row per distinct sign,
+        # scatter-SET back (pad rows carry zero grads and write their
+        # unchanged dummy-row value; untouched cache rows are never read
+        # or written — matching the PS: no accumulator decay without a
+        # gradient).
+        dummy = cache_vals.shape[0] - 1
+        valid = (unique_slots != dummy)[:, None]
+        gsum_u = jnp.zeros((inverse.shape[0], dim), jnp.float32).at[
+            inverse].add(emb_grad.reshape(-1, dim))
+        acc_u = cache_acc[unique_slots]  # PRE-update accumulator
+        new_val_u = (cache_vals[unique_slots]
+                     - lr * gsum_u * jax.lax.rsqrt(acc_u + eps))
         if weight_bound > 0:
             # the PS clamps after every update (ps/optim.py
             # apply_weight_bound; reference persia-simd lib.rs:231-251) —
             # mirror it or cached and uncached training diverge for hot
             # rows near the bound
-            cache_vals = jnp.where(
-                touched,
-                jnp.clip(cache_vals, -weight_bound, weight_bound),
-                cache_vals)
-        cache_acc = jnp.where(
-            touched, cache_acc * g_square_momentum + gsum * gsum, cache_acc)
+            new_val_u = jnp.clip(new_val_u, -weight_bound, weight_bound)
+        new_acc_u = jnp.where(
+            valid, acc_u * g_square_momentum + gsum_u * gsum_u, acc_u)
+        cache_vals = cache_vals.at[unique_slots].set(new_val_u)
+        cache_acc = cache_acc.at[unique_slots].set(new_acc_u)
         return (new_state, cache_vals, cache_acc, loss, pred,
                 evicted_vals, evicted_acc)
 

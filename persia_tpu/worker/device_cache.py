@@ -18,9 +18,136 @@ gather/train/scatter step) lives in persia_tpu/parallel/cached_train.py.
 """
 
 import threading
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
+
+
+class AssignResult(NamedTuple):
+    """One batch's sign->slot mapping (see SignSlotMap.assign)."""
+
+    slots: np.ndarray         # int32 (n,) cache slot per position
+    miss_pos: np.ndarray      # int64 (m,) first-occurrence miss positions
+    evicted_signs: np.ndarray  # uint64 (m,) victim sign per miss
+    evicted_mask: np.ndarray  # bool (m,) True = real eviction (sign 0 is
+    #                           a legal sign, so the mask is the marker)
+    inverse: np.ndarray       # int32 (n,) position -> batch-distinct index
+    unique_slots: np.ndarray  # int32 (n,) distinct index -> slot (tail
+    #                           beyond n_unique is uninitialized)
+    n_unique: int
+
+
+def _load_cache_map_lib():
+    """The native mapper (native/src/cache_map.h) via the shared lib the
+    PS store already builds; None when the toolchain is absent."""
+    import ctypes
+
+    from persia_tpu.ps.native import load_native_lib
+
+    lib = load_native_lib()
+    if lib is None or not hasattr(lib, "ptcm_new"):
+        return None
+    u64 = ctypes.c_uint64
+    lib.ptcm_new.restype = ctypes.c_void_p
+    lib.ptcm_new.argtypes = [u64]
+    lib.ptcm_free.argtypes = [ctypes.c_void_p]
+    lib.ptcm_assign.restype = ctypes.c_int64
+    lib.ptcm_assign.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(u64), u64,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(u64), ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int64)]
+    lib.ptcm_len.restype = u64
+    lib.ptcm_len.argtypes = [ctypes.c_void_p]
+    lib.ptcm_items.restype = u64
+    lib.ptcm_items.argtypes = [ctypes.c_void_p, ctypes.POINTER(u64),
+                               ctypes.POINTER(ctypes.c_int32)]
+    return lib
+
+
+class NativeSignSlotMap:
+    """C++ LRU mapper — same contract as SignSlotMap, ~10-30x faster on
+    the 100k-probe batches of the cached training hot path."""
+
+    def __init__(self, capacity: int):
+        if capacity <= 0:
+            raise ValueError("cache capacity must be positive")
+        import ctypes
+
+        self._ct = ctypes
+        self.capacity = int(capacity)
+        self._lib = _load_cache_map_lib()
+        if self._lib is None:
+            raise RuntimeError("native cache_map unavailable")
+        self._h = self._lib.ptcm_new(self.capacity)
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def __del__(self):
+        lib = getattr(self, "_lib", None)
+        if lib is not None and getattr(self, "_h", None):
+            lib.ptcm_free(self._h)
+            self._h = None
+
+    def __len__(self) -> int:
+        return int(self._lib.ptcm_len(self._h))
+
+    def _ptr(self, a, ctype):
+        return a.ctypes.data_as(self._ct.POINTER(ctype))
+
+    def assign(self, signs: np.ndarray):
+        ct = self._ct
+        signs = np.ascontiguousarray(signs, dtype=np.uint64)
+        n = len(signs)
+        slots = np.empty(n, dtype=np.int32)
+        miss_pos = np.empty(n, dtype=np.int64)
+        evicted = np.empty(n, dtype=np.uint64)
+        emask = np.empty(n, dtype=np.uint8)
+        inverse = np.empty(n, dtype=np.int32)
+        unique_slots = np.empty(n, dtype=np.int32)
+        n_unique = ct.c_int64(0)
+        m = self._lib.ptcm_assign(
+            self._h, self._ptr(signs, ct.c_uint64), n,
+            self._ptr(slots, ct.c_int32), self._ptr(miss_pos, ct.c_int64),
+            self._ptr(evicted, ct.c_uint64), self._ptr(emask, ct.c_uint8),
+            self._ptr(inverse, ct.c_int32),
+            self._ptr(unique_slots, ct.c_int32), ct.byref(n_unique))
+        if m < 0:
+            raise ValueError(
+                f"batch distinct signs exceed cache capacity "
+                f"{self.capacity}; eviction pinning needs capacity >= "
+                "distinct signs per batch")
+        self.misses += int(m)
+        self.hits += n - int(m)
+        self.evictions += int(np.count_nonzero(emask[:m]))
+        return AssignResult(
+            slots, miss_pos[:m].copy(), evicted[:m].copy(),
+            emask[:m].astype(bool), inverse,
+            unique_slots, int(n_unique.value))
+
+    def signs_and_slots(self):
+        n = len(self)
+        signs = np.empty(n, dtype=np.uint64)
+        slots = np.empty(n, dtype=np.int32)
+        k = self._lib.ptcm_items(self._h, self._ptr(signs, self._ct.c_uint64),
+                                 self._ptr(slots, self._ct.c_int32))
+        return signs[:k], slots[:k]
+
+    @property
+    def hit_rate(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
+
+def make_sign_slot_map(capacity: int):
+    """Native mapper when the lib is built, python fallback otherwise
+    (same contract either way; parity-tested)."""
+    try:
+        return NativeSignSlotMap(capacity)
+    except (RuntimeError, OSError):
+        return SignSlotMap(capacity)
 
 
 class SignSlotMap:
@@ -54,15 +181,18 @@ class SignSlotMap:
                ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Map a batch of signs to slots, allocating on miss.
 
-        Returns ``(slots, miss_pos, evicted_signs)``:
+        Returns ``(slots, miss_pos, evicted_signs, evicted_mask)``:
         - slots: int32 (n,) cache slot per sign;
         - miss_pos: int64 positions (within ``signs``) that were misses
           (first occurrence only — a duplicate of an earlier miss in the
           same batch hits the freshly assigned slot);
         - evicted_signs: uint64, same length as miss_pos; the sign whose
-          slot was reused for this miss, or 0 when a free slot was used.
-          The caller must write the evicted sign's device row back to the
-          PS (see VictimBuffer).
+          slot was reused for this miss;
+        - evicted_mask: bool, same length; True when a victim was
+          actually evicted (False = free slot). The mask, not the sign
+          value, is the marker: sign 0 is a legal sign (the "missing
+          token" convention), so an evicted sign-0 row must still be
+          written back (see VictimBuffer).
         """
         signs = np.ascontiguousarray(signs, dtype=np.uint64)
         n = len(signs)
@@ -74,8 +204,12 @@ class SignSlotMap:
                 f"capacity is {self.capacity}; eviction pinning needs "
                 "capacity >= distinct signs per batch")
         slots = np.empty(n, dtype=np.int32)
+        inverse = np.empty(n, dtype=np.int32)
+        unique_slots = np.empty(n, dtype=np.int32)
+        uid: Dict[int, int] = {}
         miss_pos: List[int] = []
         evicted: List[int] = []
+        emask: List[bool] = []
         for i in range(n):
             s = int(signs[i])
             slot = m.pop(s, None)
@@ -83,23 +217,36 @@ class SignSlotMap:
                 m[s] = slot
                 slots[i] = slot
                 self.hits += 1
+                u = uid.get(s)
+                if u is None:
+                    u = uid[s] = len(uid)
+                    unique_slots[u] = slot
+                inverse[i] = u
                 continue
             self.misses += 1
             if self._free:
                 slot = self._free.pop()
                 evicted.append(0)
+                emask.append(False)
             else:
                 # evict LRU skipping pinned (current-batch) signs
                 victim = next(k for k in m if k not in batch_signs)
                 slot = m.pop(victim)
                 evicted.append(victim)
+                emask.append(True)
                 self.evictions += 1
             m[s] = slot
             slots[i] = slot
+            u = uid[s] = len(uid)  # a miss is the first occurrence
+            unique_slots[u] = slot
+            inverse[i] = u
             miss_pos.append(i)
-        return (slots,
-                np.asarray(miss_pos, dtype=np.int64),
-                np.asarray(evicted, dtype=np.uint64))
+        return AssignResult(
+            slots,
+            np.asarray(miss_pos, dtype=np.int64),
+            np.asarray(evicted, dtype=np.uint64),
+            np.asarray(emask, dtype=bool),
+            inverse, unique_slots, len(uid))
 
     def drop(self, sign: int) -> Optional[int]:
         """Remove a sign (after flush_all); returns its freed slot."""
@@ -155,9 +302,22 @@ class VictimBuffer:
             entry = self._pending.pop(int(sign), None)
             return None if entry is None else entry[1]
 
+    def peek_if(self, sign: int, token: int):
+        """Return the payload WITHOUT removing it, only if the entry's
+        token matches. The write-back path peeks, writes to the PS, then
+        take_if-removes: removing before the write lands would open a
+        window where a concurrent miss finds no pending entry and reads
+        the stale pre-write PS row — losing every on-device update since
+        the row's import."""
+        with self._lock:
+            entry = self._pending.get(int(sign))
+            if entry is None or entry[0] != token:
+                return None
+            return entry[1]
+
     def take_if(self, sign: int, token: int):
         """Remove and return the payload only if the entry's token
-        matches (the write-back path)."""
+        matches (the write-back path, after its PS write landed)."""
         with self._lock:
             entry = self._pending.get(int(sign))
             if entry is None or entry[0] != token:

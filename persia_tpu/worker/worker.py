@@ -395,15 +395,25 @@ class EmbeddingWorker:
         vals = np.zeros((n, dim), np.float32)
         state = np.full((n, dim), default_state, np.float32)
         shards = sign_to_shard(signs, self.replica_size)
-        for r in np.unique(shards):
-            sel = np.nonzero(shards == r)[0]
+        groups = [np.nonzero(shards == r)[0] for r in np.unique(shards)]
+        replicas = [int(shards[sel[0]]) for sel in groups]
 
-            def fetch(r=r, sel=sel):
-                client = self.ps_clients[r]
-                client.lookup(signs[sel], dim, True)
-                return client.get_entries(signs[sel], width)
+        def fetch_one(r, sel):
+            client = self.ps_clients[r]
+            client.lookup(signs[sel], dim, True)
+            return client.get_entries(signs[sel], width)
 
-            found, vecs = self._with_ps_retry(fetch)
+        def fetch_all():
+            # miss import sits on the training critical path: overlap
+            # the per-replica round trips like the normal lookup fan-out
+            if self._fanout is None or len(groups) <= 1:
+                return [fetch_one(r, sel)
+                        for r, sel in zip(replicas, groups)]
+            return list(self._fanout.map(
+                lambda rs: fetch_one(*rs), zip(replicas, groups)))
+
+        for sel, (found, vecs) in zip(groups,
+                                      self._with_ps_retry(fetch_all)):
             hit = np.nonzero(found)[0]
             vals[sel[hit]] = vecs[hit, :dim]
             state[sel[hit]] = vecs[hit, dim:]
@@ -418,11 +428,21 @@ class EmbeddingWorker:
         signs = np.ascontiguousarray(signs, dtype=np.uint64)
         vecs = np.ascontiguousarray(vecs, dtype=np.float32)
         shards = sign_to_shard(signs, self.replica_size)
-        for r in np.unique(shards):
-            sel = np.nonzero(shards == r)[0]
-            self._with_ps_retry(
-                lambda r=r, sel=sel: self.ps_clients[r].set_entries(
-                    signs[sel], dim, vecs[sel]))
+        groups = [np.nonzero(shards == r)[0] for r in np.unique(shards)]
+        replicas = [int(shards[sel[0]]) for sel in groups]
+
+        def push_all():
+            if self._fanout is None or len(groups) <= 1:
+                for r, sel in zip(replicas, groups):
+                    self.ps_clients[r].set_entries(signs[sel], dim,
+                                                   vecs[sel])
+                return
+            list(self._fanout.map(
+                lambda rs: self.ps_clients[rs[0]].set_entries(
+                    signs[rs[1]], dim, vecs[rs[1]]),
+                zip(replicas, groups)))
+
+        self._with_ps_retry(push_all)
 
     def dump(self, dirpath: str):
         from persia_tpu.checkpoint import dump_sharded

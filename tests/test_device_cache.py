@@ -29,17 +29,17 @@ SLOTS = [f"s{i}" for i in range(NUM_SLOTS)]
 
 def test_mapper_hit_miss_evict_order():
     m = SignSlotMap(3)
-    slots, miss, ev = m.assign(np.array([10, 11, 12], np.uint64))
-    assert len(set(slots)) == 3 and list(miss) == [0, 1, 2]
-    assert list(ev) == [0, 0, 0]  # free slots, nothing evicted
+    r = m.assign(np.array([10, 11, 12], np.uint64))
+    assert len(set(r.slots)) == 3 and list(r.miss_pos) == [0, 1, 2]
+    assert not r.evicted_mask.any()  # free slots, nothing evicted
     # touch 10 (refresh), then force one eviction: LRU is now 11
     m.assign(np.array([10], np.uint64))
-    slots2, miss2, ev2 = m.assign(np.array([13], np.uint64))
-    assert list(ev2) == [11]
+    r2 = m.assign(np.array([13], np.uint64))
+    assert list(r2.evicted_signs) == [11] and list(r2.evicted_mask) == [True]
     # 11 is gone, 13 present
-    s3, miss3, _ = m.assign(np.array([13, 11], np.uint64))
-    assert list(miss3) == [1]
-    assert s3[0] == slots2[0]
+    r3 = m.assign(np.array([13, 11], np.uint64))
+    assert list(r3.miss_pos) == [1]
+    assert r3.slots[0] == r2.slots[0]
 
 
 def test_mapper_pins_current_batch_signs():
@@ -47,19 +47,78 @@ def test_mapper_pins_current_batch_signs():
     m.assign(np.array([1, 2, 3], np.uint64))
     # batch contains 1 (LRU) AND a miss; the victim must not be 1 even
     # though it is least-recently-used BEFORE this batch touches it
-    slots, miss, ev = m.assign(np.array([1, 4], np.uint64))
-    assert list(ev) == [2]  # not 1
+    r = m.assign(np.array([1, 4], np.uint64))
+    assert list(r.evicted_signs) == [2] and list(r.evicted_mask) == [True]
 
 
 def test_mapper_duplicate_miss_in_batch():
     m = SignSlotMap(4)
-    slots, miss, ev = m.assign(np.array([7, 7, 7], np.uint64))
-    assert list(miss) == [0]  # one allocation
-    assert slots[0] == slots[1] == slots[2]
+    r = m.assign(np.array([7, 7, 7], np.uint64))
+    assert list(r.miss_pos) == [0]  # one allocation
+    assert r.slots[0] == r.slots[1] == r.slots[2]
+    # dedup map: all three positions share one distinct index
+    assert r.n_unique == 1 and list(r.inverse) == [0, 0, 0]
+    assert r.unique_slots[0] == r.slots[0]
+
+
+def test_mapper_evicted_sign_zero_is_masked():
+    """Sign 0 is legal; its eviction must be reported via the mask."""
+    m = SignSlotMap(2)
+    m.assign(np.array([0, 5], np.uint64))
+    m.assign(np.array([5], np.uint64))     # sign 0 becomes LRU
+    r = m.assign(np.array([9], np.uint64))
+    assert list(r.evicted_signs) == [0] and list(r.evicted_mask) == [True]
 
 
 def test_mapper_rejects_oversized_batch():
     m = SignSlotMap(2)
+    with pytest.raises(ValueError):
+        m.assign(np.array([1, 2, 3], np.uint64))
+
+
+def test_native_mapper_matches_python(native_lib_path):
+    """Randomized trace: the C++ mapper must produce identical slots,
+    miss positions, and eviction choices to the python reference."""
+    from persia_tpu.worker.device_cache import NativeSignSlotMap
+
+    rng = np.random.default_rng(7)
+    py = SignSlotMap(50)
+    nat = NativeSignSlotMap(50)
+    for _ in range(60):
+        # skewed draws incl. duplicates; distinct-per-batch < capacity
+        signs = (rng.zipf(1.3, size=30) % 120).astype(np.uint64)
+        pr = py.assign(signs)
+        nr = nat.assign(signs)
+        # slot NUMBERS may differ (allocation order); the MAPPING must
+        # agree: same sign -> same slot within a batch, same miss set,
+        # same eviction victims, same dedup structure
+        np.testing.assert_array_equal(pr.miss_pos, nr.miss_pos)
+        np.testing.assert_array_equal(pr.evicted_signs, nr.evicted_signs)
+        np.testing.assert_array_equal(pr.evicted_mask, nr.evicted_mask)
+        np.testing.assert_array_equal(pr.inverse, nr.inverse)
+        assert pr.n_unique == nr.n_unique
+        for u in range(pr.n_unique):
+            # distinct index u maps to the slot its positions use
+            sel = np.nonzero(pr.inverse == u)[0]
+            assert (pr.slots[sel] == pr.unique_slots[u]).all()
+            assert (nr.slots[sel] == nr.unique_slots[u]).all()
+        for s in np.unique(signs):
+            sel = np.nonzero(signs == s)[0]
+            assert len(set(pr.slots[sel])) == 1
+            assert len(set(nr.slots[sel])) == 1
+        assert len(py) == len(nat)
+    assert py.hits == nat.hits and py.misses == nat.misses
+    assert py.evictions == nat.evictions
+    # full working set agrees
+    psigns, _ = py.signs_and_slots()
+    nsigns, _ = nat.signs_and_slots()
+    assert set(psigns.tolist()) == set(nsigns.tolist())
+
+
+def test_native_mapper_rejects_oversized_batch(native_lib_path):
+    from persia_tpu.worker.device_cache import NativeSignSlotMap
+
+    m = NativeSignSlotMap(2)
     with pytest.raises(ValueError):
         m.assign(np.array([1, 2, 3], np.uint64))
 
