@@ -552,10 +552,16 @@ def preflight_backend(metric, unit, timeout=90):
 
 def main():
     p = argparse.ArgumentParser()
+    # Default is the device-resident mode: the flagship TPU-native
+    # training path (embeddings in HBM, sparse update on device). The
+    # hybrid host-PS path stays measurable via --mode hybrid; on this
+    # relay-tunneled dev box its per-step embedding upload rides a
+    # ~6 MB/s tunnel, so its number measures the tunnel, not the design
+    # (see BASELINE.md round-4 table for both).
     p.add_argument("--mode",
                    choices=["hybrid", "device", "cached", "wire", "worker",
                             "worker-svc", "store"],
-                   default="hybrid")
+                   default="device")
     p.add_argument("--entries", type=int, default=10_000_000,
                    help="store mode: fill target (== capacity)")
     p.add_argument("--batch-size", type=int, default=4096)

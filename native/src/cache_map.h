@@ -59,6 +59,15 @@ class CacheMap {
                  int64_t* miss_pos_out, uint64_t* evicted_out,
                  uint8_t* evicted_mask_out, int32_t* inverse_out,
                  int32_t* unique_slots_out, int64_t* n_unique_out) {
+    // capacity check BEFORE any mutation, like the python twin: a
+    // mid-loop abort would leave signs mapped to slots whose rows were
+    // never imported — later hits on them would read garbage. n <= cap
+    // implies distinct <= cap (the only failure condition), so the
+    // dedup pre-pass only runs on batches where n > cap (every step
+    // when capacity < batch signs — heavy-duplicate traffic — so it
+    // must stay O(n): a reused open-addressing scratch set with an
+    // early exit the moment distinct signs provably fit).
+    if (n > cap_ && !distinct_fits(signs, n)) return -1;
     ++epoch_;
     for (uint64_t i = 0; i < n; ++i) {  // pass 0: pin cached batch signs
       uint32_t s = find(signs[i]);
@@ -145,6 +154,36 @@ class CacheMap {
   uint64_t mask_ = 0;
 
   uint64_t ideal(uint64_t sign) const { return splitmix_mix(sign) & mask_; }
+
+  // O(n) distinct-count with early exit at cap_+1. Sign 0 is legal, so
+  // the empty-slot sentinel is tracked by a separate flag.
+  bool distinct_fits(const uint64_t* signs, uint64_t n) {
+    uint64_t nb = 16;
+    while (nb < 2 * n) nb <<= 1;
+    scratch_set_.assign(nb, 0);
+    const uint64_t m = nb - 1;
+    uint64_t distinct = 0;
+    bool zero_seen = false;
+    for (uint64_t i = 0; i < n; ++i) {
+      const uint64_t s = signs[i];
+      if (s == 0) {
+        if (!zero_seen) {
+          zero_seen = true;
+          if (++distinct > cap_) return false;
+        }
+        continue;
+      }
+      uint64_t h = splitmix_mix(s) & m;
+      while (scratch_set_[h] != 0 && scratch_set_[h] != s) h = (h + 1) & m;
+      if (scratch_set_[h] == 0) {
+        scratch_set_[h] = s;
+        if (++distinct > cap_) return false;
+      }
+    }
+    return true;
+  }
+
+  std::vector<uint64_t> scratch_set_;
 
   uint32_t find(uint64_t sign) const {
     uint64_t i = ideal(sign);

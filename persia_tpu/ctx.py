@@ -530,14 +530,19 @@ class TrainCtx(EmbeddingCtx):
     def __exit__(self, exc_type, exc_val, exc_tb):
         # leaving the ctx must leave the PS authoritative (a later
         # InferCtx / dump / second TrainCtx reads it) and must not leak
-        # the flush thread
-        if self._cache_engine is not None:
-            try:
-                if exc_type is None:
-                    self.flush_device_cache()
-            finally:
-                self._cache_engine.close()
-        return super().__exit__(exc_type, exc_val, exc_tb)
+        # the flush thread; super().__exit__ must run even when the
+        # flush raises, or the dead ctx stays on the _ctx_stack and
+        # current_ctx() keeps returning it
+        try:
+            if self._cache_engine is not None:
+                try:
+                    if exc_type is None:
+                        self.flush_device_cache()
+                finally:
+                    self._cache_engine.close()
+        finally:
+            result = super().__exit__(exc_type, exc_val, exc_tb)
+        return result
 
     def dump_checkpoint(self, dst_dir: str, with_dense: bool = True):
         self.flush_device_cache()

@@ -53,9 +53,9 @@ class DeviceCacheEngine:
 
     # --- per-batch host work --------------------------------------------
 
-    def prepare(self, id_type_features) -> Tuple[np.ndarray, np.ndarray,
-                                                 np.ndarray, np.ndarray,
-                                                 np.ndarray]:
+    def prepare(self, id_type_features) -> Tuple[
+            np.ndarray, np.ndarray, np.ndarray, np.ndarray,
+            np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
         """Map this batch's signs and fetch its miss rows.
 
         Returns (slot_idx (B,S) i32, cold_idx (Mpad,) i32, cold_vals
@@ -249,6 +249,11 @@ class DeviceCacheEngine:
 
     def ensure_open(self):
         if not self._flush_thread.is_alive():
+            # a recorded flush error belongs to the previous life of the
+            # ctx (it was raised at — or superseded by — exit); keeping
+            # it would make every finish()/flush of the re-entered ctx
+            # re-raise a stale, already-surfaced exception forever
+            self._flush_err.clear()
             self._flush_thread = threading.Thread(
                 target=self._flush_loop, daemon=True,
                 name="device-cache-flush")

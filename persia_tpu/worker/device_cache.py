@@ -177,11 +177,10 @@ class SignSlotMap:
     def __len__(self) -> int:
         return len(self._map)
 
-    def assign(self, signs: np.ndarray
-               ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    def assign(self, signs: np.ndarray) -> "AssignResult":
         """Map a batch of signs to slots, allocating on miss.
 
-        Returns ``(slots, miss_pos, evicted_signs, evicted_mask)``:
+        The returned :class:`AssignResult` fields:
         - slots: int32 (n,) cache slot per sign;
         - miss_pos: int64 positions (within ``signs``) that were misses
           (first occurrence only — a duplicate of an earlier miss in the

@@ -123,6 +123,39 @@ def test_native_mapper_rejects_oversized_batch(native_lib_path):
         m.assign(np.array([1, 2, 3], np.uint64))
 
 
+@pytest.mark.parametrize("make", [SignSlotMap, "native"])
+def test_mapper_oversized_batch_leaves_state_intact(make, request):
+    """A rejected batch must not mutate the map (both backends): a
+    half-applied assign would leave signs mapped to slots whose rows
+    were never imported — later hits on them would read garbage."""
+    if make == "native":
+        # only the native param needs the built lib; the pure-python
+        # invariant must stay covered on toolchain-less machines (the
+        # exact machines that fall back to SignSlotMap in production)
+        request.getfixturevalue("native_lib_path")
+        from persia_tpu.worker.device_cache import NativeSignSlotMap as make
+
+    m = make(4)
+    first = m.assign(np.array([10, 11], np.uint64))
+    with pytest.raises(ValueError):
+        m.assign(np.array([1, 2, 3, 4, 5], np.uint64))
+    assert len(m) == 2
+    signs, slots = m.signs_and_slots()
+    by_sign = dict(zip(signs.tolist(), slots.tolist()))
+    assert set(by_sign) == {10, 11}
+    assert by_sign[10] == first.slots[0] and by_sign[11] == first.slots[1]
+    # a batch with many DUPLICATES but few distinct signs must still fit
+    # (n > capacity, distinct <= capacity)
+    dup = np.array([7, 7, 7, 7, 8, 8], np.uint64)
+    r = m.assign(dup)
+    assert r.n_unique == 2
+    # and the map still serves correct hits afterwards (capacity 4 holds
+    # all four signs — nothing was evicted along the way)
+    again = m.assign(np.array([10, 7], np.uint64))
+    assert again.slots[0] == by_sign[10]
+    assert m.misses == 4 and m.evictions == 0
+
+
 def test_victim_buffer_token_matching():
     v = VictimBuffer()
     v.put(5, "old", token=1)
