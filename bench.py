@@ -4,10 +4,16 @@ Prints exactly ONE JSON line:
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
 Modes:
-- ``hybrid`` (default): the full PERSIA-style path — host-side C++
-  parameter servers + worker middleware feeding the jitted DLRM step,
-  embedding gradients routed back to the PS each step.
-- ``device``: fully device-resident sharded embeddings (TPU-first mode).
+- ``device`` (default): fully device-resident sharded embeddings — the
+  flagship TPU-first mode.
+- ``hybrid``: the full PERSIA-style path — host-side C++ parameter
+  servers + worker middleware feeding the jitted DLRM step, embedding
+  gradients routed back to the PS each step.
+- ``cached``: hybrid + device-resident LRU cache of hot rows.
+- ``attn``: long-context flash attention TFLOP/s (MXU-bound
+  counterpart to the gather-bound DLRM numbers).
+- ``wire`` / ``worker`` / ``worker-svc`` / ``store``: host-tier
+  microbenchmarks (no accelerator).
 
 The reference repo publishes no absolute throughput numbers
 ("published": {} in BASELINE.json); the north star is "matching A100
@@ -17,6 +23,7 @@ Criteo-scale workloads), so vs_baseline = measured / 100_000.
 """
 
 import argparse
+import functools
 import json
 import sys
 import time
@@ -198,6 +205,57 @@ def bench_cached(batch_size, steps, warmup, n_ps=2,
             f"wire bytes saved {eng.wire_bytes_saved / 1e6:.1f} MB over "
             f"{warmup + steps} steps")
     return steps * batch_size / elapsed
+
+
+def bench_attn(steps, warmup, seq_len=8192, batch=4, heads=8, head_dim=128,
+               chunk_size=512, smoke=False):
+    """Long-context flash attention on chip: bf16 causal self-attention
+    through ``local_flash_attention`` (the inner kernel of the ring /
+    Ulysses sequence-parallel strategies). Reports sustained TFLOP/s —
+    the MXU-bound counterpart to the gather-bound DLRM number."""
+    import jax
+    import jax.numpy as jnp
+
+    from persia_tpu.parallel.ring_attention import local_flash_attention
+
+    if smoke:
+        seq_len, batch, heads = 512, 1, 2
+    rng = np.random.default_rng(0)
+
+    def mk(shape):
+        return jnp.asarray(rng.normal(size=shape) * 0.05, jnp.bfloat16)
+
+    q = mk((batch, heads, seq_len, head_dim))
+    k = mk((batch, heads, seq_len, head_dim))
+    v = mk((batch, heads, seq_len, head_dim))
+    on_tpu = jax.devices()[0].platform == "tpu"
+    impls = {"xla-scan": jax.jit(functools.partial(
+        local_flash_attention, causal=True, chunk_size=chunk_size))}
+    if on_tpu:  # interpret-mode pallas on CPU is minutes/call
+        from persia_tpu.ops.flash_attention import flash_attention_fwd_pallas
+
+        impls["pallas"] = jax.jit(functools.partial(
+            flash_attention_fwd_pallas, causal=True,
+            block_q=chunk_size, block_k=chunk_size))
+    # causal fwd: qk^T + s@v = 2 * 2*b*h*t^2*d FLOPs, halved by the mask
+    flops = 2.0 * batch * heads * seq_len * seq_len * head_dim
+    best = 0.0
+    for name, fn in impls.items():
+        out = fn(q, k, v)  # compile + first call (never time a cold fn)
+        for _ in range(max(warmup - 1, 0)):
+            out = fn(q, k, v)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = fn(q, k, v)
+        jax.block_until_ready(out)
+        elapsed = time.perf_counter() - t0
+        tflops = flops * steps / elapsed / 1e12
+        log(f"attn[{name}]: b={batch} h={heads} t={seq_len} dh={head_dim} "
+            f"{elapsed / steps * 1e3:.2f} ms/call, {tflops:.1f} TFLOP/s "
+            f"({tflops / 197 * 100:.0f}% of v5e bf16 peak)")
+        best = max(best, tflops)
+    return best
 
 
 def bench_device(batch_size, steps, warmup, vocab=1 << 20):
@@ -559,8 +617,8 @@ def main():
     # ~6 MB/s tunnel, so its number measures the tunnel, not the design
     # (see BASELINE.md round-4 table for both).
     p.add_argument("--mode",
-                   choices=["hybrid", "device", "cached", "wire", "worker",
-                            "worker-svc", "store"],
+                   choices=["hybrid", "device", "cached", "attn", "wire",
+                            "worker", "worker-svc", "store"],
                    default="device")
     p.add_argument("--entries", type=int, default=10_000_000,
                    help="store mode: fill target (== capacity)")
@@ -583,6 +641,7 @@ def main():
         "worker-svc": ("worker_service_samples_per_sec_core", "samples/sec"),
         "store": ("store_hit_lookups_per_sec_core", "lookups/sec"),
         "cached": ("dlrm_cached_samples_per_sec_chip", "samples/sec"),
+        "attn": ("flash_attention_tflops_chip", "TFLOP/sec"),
     }[args.mode]
 
     # Two-tier watchdog. Tier 1 (threading.Timer) emits the diagnostic
@@ -645,6 +704,10 @@ def main():
     elif args.mode == "store":
         value = bench_store(100_000 if args.smoke else args.entries)
         vs_baseline = 1.0
+    elif args.mode == "attn":
+        value = bench_attn(max(args.steps, 5), args.warmup,
+                           smoke=args.smoke)
+        vs_baseline = 1.0  # reference has no attention benchmark
     elif args.mode == "wire":
         value = bench_wire(args.batch_size, max(args.steps, 5))
         vs_baseline = 1.0  # reference publishes only relative wire numbers

@@ -1,0 +1,172 @@
+"""Pallas flash-attention forward for TPU.
+
+Why a kernel here when the embedding-bag measurement said "let XLA do
+it": the XLA formulation of blockwise attention
+(`parallel/ring_attention.py local_flash_attention`) is a `lax.scan`
+whose carry — o/m/l running statistics, (B,H,T,dh)+2×(B,H,T) f32 —
+round-trips through HBM on EVERY k/v chunk. At B=4 H=8 T=8192 dh=128
+that is ~134 MB of carry read+written per chunk step, ~16× per call:
+the op is carry-bandwidth-bound, not MXU-bound. The fix is structural,
+not fusion-level, so XLA cannot do it: keep the per-q-block statistics
+in VMEM across the k-grid and only write the finished output block.
+This is the classic flash-attention schedule mapped onto the Pallas
+TPU grid (sequential iteration, innermost axis fastest; scratch
+persists across grid steps — see /opt/skills/guides/pallas_guide.md).
+
+Kernel shape rules: dh is the lane axis of every block (any dh ≤ 128
+works, full-axis blocks are padded internally; dh=128 is the sweet
+spot). T is padded to the k/q block size by the wrapper; padded KEY
+positions are masked via the static true-length, padded QUERY rows
+compute garbage that the wrapper slices off.
+
+Backward: jax.custom_vjp with recompute-through-the-XLA-scan — the
+residuals are (q, k, v) only, the bwd pass differentiates
+`local_flash_attention` (numerically identical online softmax). The
+forward (serving, and the fwd half of training) takes the Pallas path.
+
+Measured on TPU v5e (B=4 H=8 T=8192 dh=128 bf16 causal): see
+BASELINE.md round-4 table — the motivation numbers above are from
+`bench.py --mode attn` on the scan implementation.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30  # large-finite: -inf NaNs the m-update on all-masked rows
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc, m_scr, l_scr, *,
+                scale: float, causal: bool, block_q: int, block_k: int,
+                t_k_real: int, n_k: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc[...] = jnp.zeros_like(acc)
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+
+    def _body():
+        q = q_ref[0]                       # (block_q, dh) bf16/f32
+        k = k_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (bq, bk)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = k_pos < t_k_real            # padded keys never attend
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            mask = jnp.logical_and(mask, q_pos >= k_pos)
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_scr[...]                # (block_q, 128) lane-replicated
+        m_cur = jnp.max(s, axis=1, keepdims=True)       # (bq, 1)
+        m_new = jnp.maximum(m_prev, m_cur)              # (bq, 128)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, :1])                   # (bq, bk) f32
+        l_scr[...] = l_scr[...] * alpha + jnp.sum(
+            p, axis=1, keepdims=True)
+        m_scr[...] = m_new
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)         # (bq, dh)
+        acc[...] = acc[...] * alpha[:, :1] + pv
+
+    if causal:
+        # blocks strictly above the diagonal contribute nothing — skip
+        # their matmuls (their k/v DMAs still ride the pipeline; pruning
+        # those too needs grid index-remapping, not worth it here)
+        pl.when((qi + 1) * block_q - 1 >= ki * block_k)(_body)
+    else:
+        _body()
+
+    @pl.when(ki == n_k - 1)
+    def _finish():
+        l = jnp.maximum(l_scr[...][:, :1], 1e-20)
+        o_ref[0] = (acc[...] / l).astype(o_ref.dtype)
+
+
+def _pad_t(x, block, axis=1):
+    """Zero-pad ``axis`` up to a multiple of ``block``."""
+    pad = (-x.shape[axis]) % block
+    if pad:
+        widths = [(0, 0)] * x.ndim
+        widths[axis] = (0, pad)
+        x = jnp.pad(x, widths)
+    return x
+
+
+def flash_attention_fwd_pallas(q, k, v, causal: bool = False,
+                               block_q: int = 512, block_k: int = 512,
+                               interpret: bool = False):
+    """Forward-only Pallas flash attention. q/k/v: (B, H, T, Dh)."""
+    b, h, t_q, dh = q.shape
+    t_k = k.shape[2]
+    block_q = min(block_q, max(t_q, 8))
+    block_k = min(block_k, max(t_k, 8))
+    qp = _pad_t(q.reshape(b * h, t_q, dh), block_q)
+    kp = _pad_t(k.reshape(b * h, t_k, dh), block_k)
+    vp = _pad_t(v.reshape(b * h, t_k, dh), block_k)
+    n_q = qp.shape[1] // block_q
+    n_k = kp.shape[1] // block_k
+    kernel = functools.partial(
+        _fwd_kernel, scale=1.0 / float(dh) ** 0.5, causal=causal,
+        block_q=block_q, block_k=block_k, t_k_real=t_k, n_k=n_k)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, dh), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, dh), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, dh), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, dh),
+                               lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, n_q * block_q, dh), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, dh), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp)
+    return out[:, :t_q].reshape(b, h, t_q, dh)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal: bool = False, block_q: int = 512,
+                    block_k: int = 512, interpret: bool = False):
+    """Flash attention with a Pallas forward and recompute backward.
+
+    Forward runs the VMEM-resident Pallas kernel; backward recomputes
+    through the XLA blockwise implementation (same online softmax), so
+    gradients match `local_flash_attention`'s to numerical tolerance.
+    """
+    return flash_attention_fwd_pallas(q, k, v, causal=causal,
+                                      block_q=block_q, block_k=block_k,
+                                      interpret=interpret)
+
+
+def _fa_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out = flash_attention(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _fa_bwd(causal, block_q, block_k, interpret, res, g):
+    from persia_tpu.parallel.ring_attention import local_flash_attention
+
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: local_flash_attention(
+            q, k, v, causal=causal, chunk_size=block_k), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)
