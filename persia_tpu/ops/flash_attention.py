@@ -19,10 +19,11 @@ spot). T is padded to the k/q block size by the wrapper; padded KEY
 positions are masked via the static true-length, padded QUERY rows
 compute garbage that the wrapper slices off.
 
-Backward: jax.custom_vjp with recompute-through-the-XLA-scan — the
-residuals are (q, k, v) only, the bwd pass differentiates
-`local_flash_attention` (numerically identical online softmax). The
-forward (serving, and the fwd half of training) takes the Pallas path.
+Backward: Pallas too (jax.custom_vjp). The forward saves (q, k, v,
+out, lse); `flash_attention_bwd_pallas` recomputes each softmax block
+in VMEM from those residuals with the same schedule run twice — dq
+accumulates across the k-grid, dk/dv across the q-grid. delta
+(rowsum(dO·O)) is a cheap XLA reduce. Memory stays O(T) end to end.
 
 Measured on TPU v5e (B=4 H=8 T=8192 dh=128 bf16 causal): see
 BASELINE.md round-4 table — the motivation numbers above are from
@@ -39,9 +40,13 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30  # large-finite: -inf NaNs the m-update on all-masked rows
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc, m_scr, l_scr, *,
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
                 scale: float, causal: bool, block_q: int, block_k: int,
-                t_k_real: int, n_k: int):
+                t_k_real: int, n_k: int, with_lse: bool):
+    if with_lse:
+        lse_ref, acc, m_scr, l_scr = rest
+    else:
+        acc, m_scr, l_scr = rest
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -91,6 +96,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc, m_scr, l_scr, *,
     def _finish():
         l = jnp.maximum(l_scr[...][:, :1], 1e-20)
         o_ref[0] = (acc[...] / l).astype(o_ref.dtype)
+        if with_lse:
+            # logsumexp residual for the backward kernels, stored
+            # (BH, T) with T on lanes — a (T, 1) layout would be padded
+            # to 128 lanes on TPU, 128x the footprint
+            lse_ref[...] = jnp.transpose(m_scr[...][:, :1] + jnp.log(l))
 
 
 def _pad_t(x, block, axis=1):
@@ -105,8 +115,12 @@ def _pad_t(x, block, axis=1):
 
 def flash_attention_fwd_pallas(q, k, v, causal: bool = False,
                                block_q: int = 512, block_k: int = 512,
-                               interpret: bool = False):
-    """Forward-only Pallas flash attention. q/k/v: (B, H, T, Dh)."""
+                               interpret: bool = False,
+                               return_lse: bool = False):
+    """Forward Pallas flash attention. q/k/v: (B, H, T, Dh).
+
+    With ``return_lse`` also returns the (B, H, T) logsumexp residual
+    the backward kernels consume."""
     b, h, t_q, dh = q.shape
     t_k = k.shape[2]
     block_q = min(block_q, max(t_q, 8))
@@ -118,8 +132,18 @@ def flash_attention_fwd_pallas(q, k, v, causal: bool = False,
     n_k = kp.shape[1] // block_k
     kernel = functools.partial(
         _fwd_kernel, scale=1.0 / float(dh) ** 0.5, causal=causal,
-        block_q=block_q, block_k=block_k, t_k_real=t_k, n_k=n_k)
-    out = pl.pallas_call(
+        block_q=block_q, block_k=block_k, t_k_real=t_k, n_k=n_k,
+        with_lse=return_lse)
+    o_spec = pl.BlockSpec((1, block_q, dh), lambda bh, qi, ki: (bh, qi, 0))
+    o_shape = jax.ShapeDtypeStruct((b * h, n_q * block_q, dh), q.dtype)
+    if return_lse:
+        out_specs = (o_spec, pl.BlockSpec((1, block_q),
+                                          lambda bh, qi, ki: (bh, qi)))
+        out_shape = (o_shape, jax.ShapeDtypeStruct(
+            (b * h, n_q * block_q), jnp.float32))
+    else:  # serving path: no lse output, no wasted HBM write
+        out_specs, out_shape = o_spec, o_shape
+    res = pl.pallas_call(
         kernel,
         grid=(b * h, n_q, n_k),
         in_specs=[
@@ -127,9 +151,8 @@ def flash_attention_fwd_pallas(q, k, v, causal: bool = False,
             pl.BlockSpec((1, block_k, dh), lambda bh, qi, ki: (bh, ki, 0)),
             pl.BlockSpec((1, block_k, dh), lambda bh, qi, ki: (bh, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, dh),
-                               lambda bh, qi, ki: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, n_q * block_q, dh), q.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((block_q, dh), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -137,17 +160,185 @@ def flash_attention_fwd_pallas(q, k, v, causal: bool = False,
         ],
         interpret=interpret,
     )(qp, kp, vp)
-    return out[:, :t_q].reshape(b, h, t_q, dh)
+    if return_lse:
+        out, lse = res
+        return (out[:, :t_q].reshape(b, h, t_q, dh),
+                lse[:, :t_q].reshape(b, h, t_q))
+    return res[:, :t_q].reshape(b, h, t_q, dh)
+
+
+def _masked_p(q, k, lse, *, scale, causal, block_q, block_k, qi, ki,
+              t_q_real, t_k_real):
+    """Recompute the (block_q, block_k) softmax block from q/k/lse with
+    padding + causal masking — shared by both backward kernels."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    mask = jnp.logical_and(q_pos < t_q_real, k_pos < t_k_real)
+    if causal:
+        mask = jnp.logical_and(mask, q_pos >= k_pos)
+    s = jnp.where(mask, s, _NEG_INF)
+    return jnp.exp(s - lse)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_acc, *, scale: float, causal: bool,
+                   block_q: int, block_k: int, t_q_real: int,
+                   t_k_real: int, n_k: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    def _body():
+        # lse/delta ride in (1, block_q) lane-major rows (a (T, 1)
+        # layout would be 128-lane padded in HBM); transpose to columns
+        lse = jnp.transpose(lse_ref[...])               # (bq, 1)
+        delta = jnp.transpose(delta_ref[...])
+        p = _masked_p(q_ref[0], k_ref[0], lse, scale=scale,
+                      causal=causal, block_q=block_q, block_k=block_k,
+                      qi=qi, ki=ki, t_q_real=t_q_real, t_k_real=t_k_real)
+        do = do_ref[0]
+        dp = jax.lax.dot_general(                       # dO @ V^T
+            do, v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)                           # (bq, bk)
+        dq_acc[...] += jax.lax.dot_general(             # ds @ K
+            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    if causal:
+        pl.when((qi + 1) * block_q - 1 >= ki * block_k)(_body)
+    else:
+        _body()
+
+    @pl.when(ki == n_k - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
+                    causal: bool, block_q: int, block_k: int,
+                    t_q_real: int, t_k_real: int, n_q: int):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    def _body():
+        q = q_ref[0]
+        lse = jnp.transpose(lse_ref[...])               # (bq, 1)
+        delta = jnp.transpose(delta_ref[...])
+        p = _masked_p(q, k_ref[0], lse, scale=scale,
+                      causal=causal, block_q=block_q, block_k=block_k,
+                      qi=qi, ki=ki, t_q_real=t_q_real, t_k_real=t_k_real)
+        do = do_ref[0]
+        dv_acc[...] += jax.lax.dot_general(             # P^T @ dO
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(                       # dO @ V^T
+            do, v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk_acc[...] += jax.lax.dot_general(             # ds^T @ Q
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    if causal:
+        pl.when((qi + 1) * block_q - 1 >= ki * block_k)(_body)
+    else:
+        _body()
+
+    @pl.when(qi == n_q - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def flash_attention_bwd_pallas(q, k, v, out, lse, do, causal: bool = False,
+                               block_q: int = 512, block_k: int = 512,
+                               interpret: bool = False):
+    """Pallas flash-attention backward: (dq, dk, dv).
+
+    Same schedule as the forward, run twice: dq revisits its q-block
+    accumulator across the k-grid; dk/dv revisit their k-block
+    accumulators across the q-grid. The softmax block is recomputed
+    from (q, k, lse) in VMEM — nothing quadratic ever touches HBM.
+    """
+    b, h, t_q, dh = q.shape
+    t_k = k.shape[2]
+    block_q = min(block_q, max(t_q, 8))
+    block_k = min(block_k, max(t_k, 8))
+    scale = 1.0 / float(dh) ** 0.5
+    # delta_i = rowsum(dO_i * O_i) — cheap XLA elementwise+reduce
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)                             # (b, h, t_q)
+    qp = _pad_t(q.reshape(b * h, t_q, dh), block_q)
+    kp = _pad_t(k.reshape(b * h, t_k, dh), block_k)
+    vp = _pad_t(v.reshape(b * h, t_k, dh), block_k)
+    dop = _pad_t(do.reshape(b * h, t_q, dh), block_q)
+    lsep = _pad_t(lse.reshape(b * h, t_q), block_q)
+    deltap = _pad_t(delta.reshape(b * h, t_q), block_q)
+    n_q = qp.shape[1] // block_q
+    n_k = kp.shape[1] // block_k
+
+    q_spec = pl.BlockSpec((1, block_q, dh), lambda bh, qi, ki: (bh, qi, 0))
+    k_spec = pl.BlockSpec((1, block_k, dh), lambda bh, qi, ki: (bh, ki, 0))
+    col_spec = pl.BlockSpec((1, block_q), lambda bh, qi, ki: (bh, qi))
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, scale=scale, causal=causal, block_q=block_q,
+            block_k=block_k, t_q_real=t_q, t_k_real=t_k, n_k=n_k),
+        grid=(b * h, n_q, n_k),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, col_spec, col_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((b * h, n_q * block_q, dh), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, dh), jnp.float32)],
+        interpret=interpret,
+    )(qp, kp, vp, dop, lsep, deltap)
+
+    # dk/dv: k-block outermost, q innermost (the accumulation axis)
+    q_spec2 = pl.BlockSpec((1, block_q, dh), lambda bh, ki, qi: (bh, qi, 0))
+    k_spec2 = pl.BlockSpec((1, block_k, dh), lambda bh, ki, qi: (bh, ki, 0))
+    col_spec2 = pl.BlockSpec((1, block_q), lambda bh, ki, qi: (bh, qi))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, scale=scale, causal=causal, block_q=block_q,
+            block_k=block_k, t_q_real=t_q, t_k_real=t_k, n_q=n_q),
+        grid=(b * h, n_k, n_q),
+        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, col_spec2, col_spec2],
+        out_specs=(k_spec2, k_spec2),
+        out_shape=(
+            jax.ShapeDtypeStruct((b * h, n_k * block_k, dh), k.dtype),
+            jax.ShapeDtypeStruct((b * h, n_k * block_k, dh), v.dtype),
+        ),
+        scratch_shapes=[pltpu.VMEM((block_k, dh), jnp.float32),
+                        pltpu.VMEM((block_k, dh), jnp.float32)],
+        interpret=interpret,
+    )(qp, kp, vp, dop, lsep, deltap)
+    return (dq[:, :t_q].reshape(b, h, t_q, dh),
+            dk[:, :t_k].reshape(b, h, t_k, dh),
+            dv[:, :t_k].reshape(b, h, t_k, dh))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, causal: bool = False, block_q: int = 512,
                     block_k: int = 512, interpret: bool = False):
-    """Flash attention with a Pallas forward and recompute backward.
+    """Flash attention, Pallas forward AND backward.
 
-    Forward runs the VMEM-resident Pallas kernel; backward recomputes
-    through the XLA blockwise implementation (same online softmax), so
-    gradients match `local_flash_attention`'s to numerical tolerance.
+    The forward saves (q, k, v, out, lse); the backward recomputes each
+    softmax block in VMEM from those residuals — memory stays O(T)
+    end-to-end and nothing quadratic touches HBM in either direction.
     """
     return flash_attention_fwd_pallas(q, k, v, causal=causal,
                                       block_q=block_q, block_k=block_k,
@@ -155,18 +346,17 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 512,
 
 
 def _fa_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out = flash_attention(q, k, v, causal, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = flash_attention_fwd_pallas(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret, return_lse=True)
+    return out, (q, k, v, out, lse)
 
 
 def _fa_bwd(causal, block_q, block_k, interpret, res, g):
-    from persia_tpu.parallel.ring_attention import local_flash_attention
-
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q, k, v: local_flash_attention(
-            q, k, v, causal=causal, chunk_size=block_k), q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    return flash_attention_bwd_pallas(
+        q, k, v, out, lse, g, causal=causal, block_q=block_q,
+        block_k=block_k, interpret=interpret)
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)

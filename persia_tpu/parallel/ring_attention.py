@@ -51,9 +51,12 @@ def reference_attention(q, k, v, causal: bool = False, kv_mask=None):
     scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
     if causal:
-        t = q.shape[2]
-        mask = jnp.tril(jnp.ones((t, t), bool))
-        s = jnp.where(mask[None, None], s, -jnp.inf)
+        # position i attends to keys <= i; with t_q != t_k the mask is
+        # the rectangular slice of the square relation, not tril of a
+        # (t_q, t_q) matrix
+        q_pos = jnp.arange(q.shape[2])[:, None]
+        k_pos = jnp.arange(k.shape[2])[None, :]
+        s = jnp.where((q_pos >= k_pos)[None, None], s, -jnp.inf)
     if kv_mask is not None:
         s = jnp.where(kv_mask[:, None, None, :], s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
