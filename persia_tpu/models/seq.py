@@ -27,6 +27,9 @@ class SequenceSelfAttention(nn.Module):
     seq_axis: str = "model"
     causal: bool = False
     context_parallel: str = "ring"  # "ring" | "ulysses"
+    # single-device / per-shard kernel: "xla" (dense reference or scan)
+    # or "pallas" (VMEM-resident flash, persia_tpu.ops.flash_attention)
+    attn_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x, mask):
@@ -55,15 +58,43 @@ class SequenceSelfAttention(nn.Module):
             raise ValueError(
                 f"context_parallel must be 'ring' or 'ulysses', got "
                 f"{self.context_parallel!r}")
+        if self.attn_impl not in ("xla", "pallas"):
+            # a typo here must not silently fall through to the O(T^2)
+            # dense reference path
+            raise ValueError(
+                f"attn_impl must be 'xla' or 'pallas', got "
+                f"{self.attn_impl!r}")
         if self.mesh is not None and self.mesh.shape[self.seq_axis] > 1:
-            cp = (ulysses_self_attention
-                  if self.context_parallel == "ulysses"
-                  else ring_self_attention)
-            out = cp(
-                q.astype(jnp.float32), k.astype(jnp.float32),
-                v.astype(jnp.float32),
-                self.mesh, seq_axis=self.seq_axis, causal=self.causal,
-                kv_mask=mask)
+            if self.context_parallel == "ulysses":
+                # pallas impl: keep the compute dtype — halves both the
+                # all_to_all bytes on ICI and the kernel's HBM traffic
+                # (f32 accumulation happens inside the kernel); the xla
+                # impl keeps its historical f32 contract
+                cast = (jnp.float32 if self.attn_impl == "xla"
+                        else self.compute_dtype)
+                out = ulysses_self_attention(
+                    q.astype(cast), k.astype(cast), v.astype(cast),
+                    self.mesh, seq_axis=self.seq_axis, causal=self.causal,
+                    kv_mask=mask, impl=self.attn_impl)
+            else:
+                # ring streams k/v blocks ACROSS devices with the o/m/l
+                # carry in the rotation itself; its inner update is not
+                # swappable for the local pallas kernel
+                out = ring_self_attention(
+                    q.astype(jnp.float32), k.astype(jnp.float32),
+                    v.astype(jnp.float32),
+                    self.mesh, seq_axis=self.seq_axis, causal=self.causal,
+                    kv_mask=mask)
+        elif self.attn_impl == "pallas":
+            from persia_tpu.ops.flash_attention import (
+                flash_attention_masked,
+            )
+
+            # keep the compute dtype: the kernel accumulates in f32
+            # internally (preferred_element_type), so bf16 inputs keep
+            # MXU rate + halve HBM bytes without losing the f32 math
+            out = flash_attention_masked(
+                q, k, v, kv_mask=mask, causal=self.causal)
         else:
             out = reference_attention(
                 q.astype(jnp.float32), k.astype(jnp.float32),
@@ -84,6 +115,7 @@ class SequenceTower(nn.Module):
     compute_dtype: Any = jnp.bfloat16
     mesh: Optional[Any] = None
     context_parallel: str = "ring"  # "ring" | "ulysses"
+    attn_impl: str = "xla"  # "xla" | "pallas" (see SequenceSelfAttention)
 
     @nn.compact
     def __call__(self, non_id_tensors, embedding_tensors, train: bool = False):
@@ -97,6 +129,7 @@ class SequenceTower(nn.Module):
                     num_heads=self.num_heads, compute_dtype=dt,
                     mesh=self.mesh,
                     context_parallel=self.context_parallel,
+                    attn_impl=self.attn_impl,
                 )(x, mask)
                 denom = jnp.maximum(
                     mask.sum(axis=1, keepdims=True), 1).astype(dt)

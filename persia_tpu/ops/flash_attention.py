@@ -40,9 +40,12 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30  # large-finite: -inf NaNs the m-update on all-masked rows
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
                 scale: float, causal: bool, block_q: int, block_k: int,
-                t_k_real: int, n_k: int, with_lse: bool):
+                t_k_real: int, n_k: int, with_lse: bool, with_mask: bool):
+    if with_mask:
+        mask_ref, rest = rest[0], rest[1:]
+    o_ref, rest = rest[0], rest[1:]
     if with_lse:
         lse_ref, acc, m_scr, l_scr = rest
     else:
@@ -69,6 +72,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             mask = jnp.logical_and(mask, q_pos >= k_pos)
+        if with_mask:
+            mask = jnp.logical_and(mask, mask_ref[...] > 0)  # (1, bk) bcast
         s = jnp.where(mask, s, _NEG_INF)
 
         m_prev = m_scr[...]                # (block_q, 128) lane-replicated
@@ -76,6 +81,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
         m_new = jnp.maximum(m_prev, m_cur)              # (bq, 128)
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new[:, :1])                   # (bq, bk) f32
+        if with_mask:
+            # a FULLY-masked row has s = m_new = NEG_INF everywhere, so
+            # the subtraction above degenerates to exp(0)=1; zero it
+            # (l stays 0 -> output 0, matching reference_attention)
+            p = jnp.where(m_new[:, :1] > _NEG_INF / 2, p, 0.0)
         l_scr[...] = l_scr[...] * alpha + jnp.sum(
             p, axis=1, keepdims=True)
         m_scr[...] = m_new
@@ -113,14 +123,36 @@ def _pad_t(x, block, axis=1):
     return x
 
 
+def _spec_family(block_q, block_k, dh, h, q_minor: bool):
+    """The four block-spec shapes every kernel here uses, for one grid
+    order: q-tile, k-tile, per-q lane row (lse/delta), per-k lane row
+    (kv_mask, batch axis = bh // h). ``q_minor=True`` = grid (bh, qi,
+    ki); ``False`` = (bh, ki, qi). One definition so a layout change
+    cannot drift between the forward and the two backward calls."""
+    if q_minor:
+        def pos(bh, qi, ki):
+            return qi, ki
+    else:
+        def pos(bh, ki, qi):
+            return qi, ki
+    return (
+        pl.BlockSpec((1, block_q, dh), lambda *g: (g[0], pos(*g)[0], 0)),
+        pl.BlockSpec((1, block_k, dh), lambda *g: (g[0], pos(*g)[1], 0)),
+        pl.BlockSpec((1, block_q), lambda *g: (g[0], pos(*g)[0])),
+        pl.BlockSpec((1, block_k), lambda *g, h=h: (g[0] // h, pos(*g)[1])),
+    )
+
+
 def flash_attention_fwd_pallas(q, k, v, causal: bool = False,
                                block_q: int = 512, block_k: int = 512,
                                interpret: bool = False,
-                               return_lse: bool = False):
+                               return_lse: bool = False,
+                               kv_mask=None):
     """Forward Pallas flash attention. q/k/v: (B, H, T, Dh).
 
     With ``return_lse`` also returns the (B, H, T) logsumexp residual
-    the backward kernels consume."""
+    the backward kernels consume. ``kv_mask`` optional (B, T_k) of
+    valid key positions; fully-masked query rows yield 0."""
     b, h, t_q, dh = q.shape
     t_k = k.shape[2]
     block_q = min(block_q, max(t_q, 8))
@@ -133,12 +165,19 @@ def flash_attention_fwd_pallas(q, k, v, causal: bool = False,
     kernel = functools.partial(
         _fwd_kernel, scale=1.0 / float(dh) ** 0.5, causal=causal,
         block_q=block_q, block_k=block_k, t_k_real=t_k, n_k=n_k,
-        with_lse=return_lse)
-    o_spec = pl.BlockSpec((1, block_q, dh), lambda bh, qi, ki: (bh, qi, 0))
+        with_lse=return_lse, with_mask=kv_mask is not None)
+    q_spec, k_spec, qrow_spec, krow_spec = _spec_family(
+        block_q, block_k, dh, h, q_minor=True)
+    in_specs = [q_spec, k_spec, k_spec]
+    operands = [qp, kp, vp]
+    if kv_mask is not None:
+        # (B, T_k) f32 0/1; the grid's bh axis maps back to batch bh//h
+        in_specs.append(krow_spec)
+        operands.append(_pad_t(kv_mask.astype(jnp.float32), block_k))
+    o_spec = q_spec
     o_shape = jax.ShapeDtypeStruct((b * h, n_q * block_q, dh), q.dtype)
     if return_lse:
-        out_specs = (o_spec, pl.BlockSpec((1, block_q),
-                                          lambda bh, qi, ki: (bh, qi)))
+        out_specs = (o_spec, qrow_spec)
         out_shape = (o_shape, jax.ShapeDtypeStruct(
             (b * h, n_q * block_q), jnp.float32))
     else:  # serving path: no lse output, no wasted HBM write
@@ -146,11 +185,7 @@ def flash_attention_fwd_pallas(q, k, v, causal: bool = False,
     res = pl.pallas_call(
         kernel,
         grid=(b * h, n_q, n_k),
-        in_specs=[
-            pl.BlockSpec((1, block_q, dh), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, block_k, dh), lambda bh, qi, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, dh), lambda bh, qi, ki: (bh, ki, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
         scratch_shapes=[
@@ -159,7 +194,7 @@ def flash_attention_fwd_pallas(q, k, v, causal: bool = False,
             pltpu.VMEM((block_q, 128), jnp.float32),
         ],
         interpret=interpret,
-    )(qp, kp, vp)
+    )(*operands)
     if return_lse:
         out, lse = res
         return (out[:, :t_q].reshape(b, h, t_q, dh),
@@ -168,9 +203,11 @@ def flash_attention_fwd_pallas(q, k, v, causal: bool = False,
 
 
 def _masked_p(q, k, lse, *, scale, causal, block_q, block_k, qi, ki,
-              t_q_real, t_k_real):
+              t_q_real, t_k_real, mask_row=None):
     """Recompute the (block_q, block_k) softmax block from q/k/lse with
-    padding + causal masking — shared by both backward kernels."""
+    padding + causal + optional key masking — shared by both backward
+    kernels. Fully-masked rows (lse pinned at NEG_INF by the forward)
+    are forced to p=0, not the exp(0)=1 the raw arithmetic gives."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale
@@ -181,14 +218,21 @@ def _masked_p(q, k, lse, *, scale, causal, block_q, block_k, qi, ki,
     mask = jnp.logical_and(q_pos < t_q_real, k_pos < t_k_real)
     if causal:
         mask = jnp.logical_and(mask, q_pos >= k_pos)
+    if mask_row is not None:
+        mask = jnp.logical_and(mask, mask_row > 0)      # (1, bk) bcast
     s = jnp.where(mask, s, _NEG_INF)
-    return jnp.exp(s - lse)
+    p = jnp.exp(s - lse)
+    return jnp.where(lse > _NEG_INF / 2, p, 0.0)
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_acc, *, scale: float, causal: bool,
+                   *rest, scale: float, causal: bool,
                    block_q: int, block_k: int, t_q_real: int,
-                   t_k_real: int, n_k: int):
+                   t_k_real: int, n_k: int, with_mask: bool):
+    if with_mask:
+        mask_ref, dq_ref, dq_acc = rest
+    else:
+        mask_ref, (dq_ref, dq_acc) = None, rest
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -203,7 +247,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = jnp.transpose(delta_ref[...])
         p = _masked_p(q_ref[0], k_ref[0], lse, scale=scale,
                       causal=causal, block_q=block_q, block_k=block_k,
-                      qi=qi, ki=ki, t_q_real=t_q_real, t_k_real=t_k_real)
+                      qi=qi, ki=ki, t_q_real=t_q_real, t_k_real=t_k_real,
+                      mask_row=None if mask_ref is None else mask_ref[...])
         do = do_ref[0]
         dp = jax.lax.dot_general(                       # dO @ V^T
             do, v_ref[0], (((1,), (1,)), ((), ())),
@@ -224,9 +269,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
+                    *rest, scale: float,
                     causal: bool, block_q: int, block_k: int,
-                    t_q_real: int, t_k_real: int, n_q: int):
+                    t_q_real: int, t_k_real: int, n_q: int,
+                    with_mask: bool):
+    if with_mask:
+        mask_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
+    else:
+        mask_ref, (dk_ref, dv_ref, dk_acc, dv_acc) = None, rest
     ki = pl.program_id(1)
     qi = pl.program_id(2)
 
@@ -241,7 +291,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = jnp.transpose(delta_ref[...])
         p = _masked_p(q, k_ref[0], lse, scale=scale,
                       causal=causal, block_q=block_q, block_k=block_k,
-                      qi=qi, ki=ki, t_q_real=t_q_real, t_k_real=t_k_real)
+                      qi=qi, ki=ki, t_q_real=t_q_real, t_k_real=t_k_real,
+                      mask_row=None if mask_ref is None else mask_ref[...])
         do = do_ref[0]
         dv_acc[...] += jax.lax.dot_general(             # P^T @ dO
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -267,7 +318,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def flash_attention_bwd_pallas(q, k, v, out, lse, do, causal: bool = False,
                                block_q: int = 512, block_k: int = 512,
-                               interpret: bool = False):
+                               interpret: bool = False, kv_mask=None):
     """Pallas flash-attention backward: (dq, dk, dv).
 
     Same schedule as the forward, run twice: dq revisits its q-block
@@ -292,31 +343,44 @@ def flash_attention_bwd_pallas(q, k, v, out, lse, do, causal: bool = False,
     n_q = qp.shape[1] // block_q
     n_k = kp.shape[1] // block_k
 
-    q_spec = pl.BlockSpec((1, block_q, dh), lambda bh, qi, ki: (bh, qi, 0))
-    k_spec = pl.BlockSpec((1, block_k, dh), lambda bh, qi, ki: (bh, ki, 0))
-    col_spec = pl.BlockSpec((1, block_q), lambda bh, qi, ki: (bh, qi))
+    maskp = (None if kv_mask is None
+             else _pad_t(kv_mask.astype(jnp.float32), block_k))
+
+    q_spec, k_spec, col_spec, mask_spec = _spec_family(
+        block_q, block_k, dh, h, q_minor=True)
+    in_specs = [q_spec, k_spec, k_spec, q_spec, col_spec, col_spec]
+    operands = [qp, kp, vp, dop, lsep, deltap]
+    if maskp is not None:
+        in_specs.append(mask_spec)
+        operands.append(maskp)
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, scale=scale, causal=causal, block_q=block_q,
-            block_k=block_k, t_q_real=t_q, t_k_real=t_k, n_k=n_k),
+            block_k=block_k, t_q_real=t_q, t_k_real=t_k, n_k=n_k,
+            with_mask=maskp is not None),
         grid=(b * h, n_q, n_k),
-        in_specs=[q_spec, k_spec, k_spec, q_spec, col_spec, col_spec],
+        in_specs=in_specs,
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((b * h, n_q * block_q, dh), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, dh), jnp.float32)],
         interpret=interpret,
-    )(qp, kp, vp, dop, lsep, deltap)
+    )(*operands)
 
     # dk/dv: k-block outermost, q innermost (the accumulation axis)
-    q_spec2 = pl.BlockSpec((1, block_q, dh), lambda bh, ki, qi: (bh, qi, 0))
-    k_spec2 = pl.BlockSpec((1, block_k, dh), lambda bh, ki, qi: (bh, ki, 0))
-    col_spec2 = pl.BlockSpec((1, block_q), lambda bh, ki, qi: (bh, qi))
+    q_spec2, k_spec2, col_spec2, mask_spec2 = _spec_family(
+        block_q, block_k, dh, h, q_minor=False)
+    in_specs2 = [q_spec2, k_spec2, k_spec2, q_spec2, col_spec2, col_spec2]
+    operands2 = [qp, kp, vp, dop, lsep, deltap]
+    if maskp is not None:
+        in_specs2.append(mask_spec2)
+        operands2.append(maskp)
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, scale=scale, causal=causal, block_q=block_q,
-            block_k=block_k, t_q_real=t_q, t_k_real=t_k, n_q=n_q),
+            block_k=block_k, t_q_real=t_q, t_k_real=t_k, n_q=n_q,
+            with_mask=maskp is not None),
         grid=(b * h, n_k, n_q),
-        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, col_spec2, col_spec2],
+        in_specs=in_specs2,
         out_specs=(k_spec2, k_spec2),
         out_shape=(
             jax.ShapeDtypeStruct((b * h, n_k * block_k, dh), k.dtype),
@@ -325,7 +389,7 @@ def flash_attention_bwd_pallas(q, k, v, out, lse, do, causal: bool = False,
         scratch_shapes=[pltpu.VMEM((block_k, dh), jnp.float32),
                         pltpu.VMEM((block_k, dh), jnp.float32)],
         interpret=interpret,
-    )(qp, kp, vp, dop, lsep, deltap)
+    )(*operands2)
     return (dq[:, :t_q].reshape(b, h, t_q, dh),
             dk[:, :t_k].reshape(b, h, t_k, dh),
             dv[:, :t_k].reshape(b, h, t_k, dh))
@@ -360,3 +424,46 @@ def _fa_bwd(causal, block_q, block_k, interpret, res, g):
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_attention_masked(q, k, v, maskf, causal, block_q, block_k,
+                            interpret):
+    return flash_attention_fwd_pallas(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret, kv_mask=maskf)
+
+
+def _fam_fwd(q, k, v, maskf, causal, block_q, block_k, interpret):
+    out, lse = flash_attention_fwd_pallas(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret, return_lse=True, kv_mask=maskf)
+    return out, (q, k, v, out, lse, maskf)
+
+
+def _fam_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse, maskf = res
+    dq, dk, dv = flash_attention_bwd_pallas(
+        q, k, v, out, lse, g, causal=causal, block_q=block_q,
+        block_k=block_k, interpret=interpret, kv_mask=maskf)
+    return dq, dk, dv, jnp.zeros_like(maskf)
+
+
+_flash_attention_masked.defvjp(_fam_fwd, _fam_bwd)
+
+
+def flash_attention_masked(q, k, v, kv_mask=None, causal: bool = False,
+                           block_q: int = 512, block_k: int = 512,
+                           interpret="auto"):
+    """`flash_attention` with an optional (B, T_k) key-validity mask —
+    the entry the sequence tower / Ulysses paths use (the mask rides as
+    f32 0/1 so the custom_vjp plumbing stays all-float; its cotangent
+    is zero). ``interpret="auto"`` compiles on TPU and falls back to
+    the Pallas interpreter elsewhere (CPU tests)."""
+    if interpret == "auto":
+        interpret = jax.default_backend() != "tpu"
+    if kv_mask is None:
+        return flash_attention(q, k, v, causal, block_q, block_k, interpret)
+    return _flash_attention_masked(
+        q, k, v, kv_mask.astype(jnp.float32), causal, block_q, block_k,
+        interpret)

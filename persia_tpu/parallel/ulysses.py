@@ -30,7 +30,8 @@ from persia_tpu.parallel.ring_attention import (
 
 
 def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
-                      chunk_size: int = 512, kv_mask=None):
+                      chunk_size: int = 512, kv_mask=None,
+                      impl: str = "xla"):
     """Inside shard_map: q/k/v (B, H, T_local, Dh) with the sequence
     sharded over ``axis_name``; H must divide by the axis size; kv_mask
     optional (B, T_local) of valid keys on this shard.
@@ -61,19 +62,30 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
     # the key mask has no head axis: gather the full sequence mask
     full_mask = lax.all_gather(kv_mask, axis_name, axis=1, tiled=True)
     q, k, v = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    # chunked flash kernel: O(T·chunk) score memory, not the O(T²)
-    # matrix a naive softmax(qkᵀ)v would materialize at long context
-    out = local_flash_attention(q, k, v, causal=causal,
-                                chunk_size=chunk_size, kv_mask=full_mask)
+    # chunked flash: O(T·chunk) score memory, not the O(T²) matrix a
+    # naive softmax(qkᵀ)v would materialize at long context.
+    # impl="pallas" keeps the o/m/l running statistics in VMEM across
+    # k-blocks (the XLA scan round-trips them through HBM per chunk)
+    if impl == "pallas":
+        from persia_tpu.ops.flash_attention import flash_attention_masked
+
+        out = flash_attention_masked(q, k, v, kv_mask=full_mask,
+                                     causal=causal, block_q=chunk_size,
+                                     block_k=chunk_size)
+    else:
+        out = local_flash_attention(q, k, v, causal=causal,
+                                    chunk_size=chunk_size,
+                                    kv_mask=full_mask)
     return heads_to_seq(out)
 
 
 def ulysses_self_attention(q, k, v, mesh: Mesh, seq_axis: str = "model",
                            causal: bool = False, chunk_size: int = 512,
-                           kv_mask=None):
+                           kv_mask=None, impl: str = "xla"):
     """shard_map wrapper: q/k/v (B, H, T, Dh) with T sharded on
     ``seq_axis``; returns attention output with the same sharding
-    (drop-in for :func:`ring_self_attention`)."""
+    (drop-in for :func:`ring_self_attention`). ``impl``: "xla" | "pallas"
+    picks the per-device flash kernel."""
     import jax.numpy as jnp
 
     if kv_mask is None:
@@ -81,6 +93,7 @@ def ulysses_self_attention(q, k, v, mesh: Mesh, seq_axis: str = "model",
 
     def inner(q, k, v, m):
         return ulysses_attention(q, k, v, axis_name=seq_axis, causal=causal,
-                                 chunk_size=chunk_size, kv_mask=m)
+                                 chunk_size=chunk_size, kv_mask=m,
+                                 impl=impl)
 
     return seq_sharded(inner, mesh, seq_axis)(q, k, v, kv_mask)
