@@ -156,6 +156,10 @@ def pallas_embedding_bag_packed(packed_table, ids, weights, dim: int,
         weights = jnp.concatenate(
             [weights, jnp.zeros((padded - batch, bag), weights.dtype)],
             axis=0)
+    # Clamp to the packed-table range: XLA's gather clamps out-of-range
+    # indices, but a Pallas DMA does not — an oversized id would read
+    # past the table in HBM (garbage, or a fault on real hardware).
+    ids = jnp.clip(ids, 0, packed_table.shape[0] * p - 1)
     pack_rows = (ids // p).reshape(-1).astype(jnp.int32)
     segs = (ids % p).astype(jnp.int32)
     grid_spec = pltpu.PrefetchScalarGridSpec(

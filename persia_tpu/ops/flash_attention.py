@@ -113,6 +113,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
             lse_ref[...] = jnp.transpose(m_scr[...][:, :1] + jnp.log(l))
 
 
+def _clamp_block(block, t):
+    """Clamp a requested block size to the (padded) sequence length,
+    rounded up to a multiple of 8 so Pallas block shapes stay
+    sublane-aligned even for ragged T (e.g. t=100 → block 104, with
+    ``_pad_t`` padding T to 104). Mosaic rejects sublane-unaligned
+    blocks on real hardware even though interpret mode accepts them."""
+    return -(-min(block, max(t, 8)) // 8) * 8
+
+
 def _pad_t(x, block, axis=1):
     """Zero-pad ``axis`` up to a multiple of ``block``."""
     pad = (-x.shape[axis]) % block
@@ -155,8 +164,8 @@ def flash_attention_fwd_pallas(q, k, v, causal: bool = False,
     valid key positions; fully-masked query rows yield 0."""
     b, h, t_q, dh = q.shape
     t_k = k.shape[2]
-    block_q = min(block_q, max(t_q, 8))
-    block_k = min(block_k, max(t_k, 8))
+    block_q = _clamp_block(block_q, t_q)
+    block_k = _clamp_block(block_k, t_k)
     qp = _pad_t(q.reshape(b * h, t_q, dh), block_q)
     kp = _pad_t(k.reshape(b * h, t_k, dh), block_k)
     vp = _pad_t(v.reshape(b * h, t_k, dh), block_k)
@@ -328,8 +337,8 @@ def flash_attention_bwd_pallas(q, k, v, out, lse, do, causal: bool = False,
     """
     b, h, t_q, dh = q.shape
     t_k = k.shape[2]
-    block_q = min(block_q, max(t_q, 8))
-    block_k = min(block_k, max(t_k, 8))
+    block_q = _clamp_block(block_q, t_q)
+    block_k = _clamp_block(block_k, t_k)
     scale = 1.0 / float(dh) ** 0.5
     # delta_i = rowsum(dO_i * O_i) — cheap XLA elementwise+reduce
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),

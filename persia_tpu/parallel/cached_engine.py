@@ -22,8 +22,11 @@ from typing import List, Tuple
 
 import numpy as np
 
+from persia_tpu.logger import get_logger
 from persia_tpu.parallel.cached_train import pad_to_bucket
 from persia_tpu.worker.device_cache import VictimBuffer, make_sign_slot_map
+
+logger = get_logger(__name__)
 
 _BUCKETS = (64, 256, 1024, 4096, 16384, 65536)
 
@@ -252,7 +255,16 @@ class DeviceCacheEngine:
             # a recorded flush error belongs to the previous life of the
             # ctx (it was raised at — or superseded by — exit); keeping
             # it would make every finish()/flush of the re-entered ctx
-            # re-raise a stale, already-surfaced exception forever
+            # re-raise a stale, already-surfaced exception forever.
+            # But if the ctx exited on an UNRELATED exception, the exit
+            # path skipped flush_device_cache and nothing ever raised
+            # this — write-backs were lost silently. Leave a trace.
+            if self._flush_err:
+                logger.warning(
+                    "device-cache: discarding %d unraised write-back "
+                    "error(s) from the previous ctx life (first: %r) — "
+                    "PS updates queued before the abnormal exit were "
+                    "lost", len(self._flush_err), self._flush_err[0])
             self._flush_err.clear()
             self._flush_thread = threading.Thread(
                 target=self._flush_loop, daemon=True,

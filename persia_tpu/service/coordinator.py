@@ -144,9 +144,18 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=23333)
+    p.add_argument("--addr-file", default=None,
+                   help="write the bound address here after listen; with "
+                        "--port 0 this is the race-free way for a parent "
+                        "to learn the port (probing a free port before "
+                        "spawn is a TOCTOU race under load)")
     args = p.parse_args()
     coord = Coordinator(args.host, args.port)
     _logger.info("coordinator listening on %s", coord.addr)
+    if args.addr_file:
+        from persia_tpu.utils import write_addr_file
+
+        write_addr_file(coord.addr, args.addr_file)
     coord.server.serve_forever()
 
 

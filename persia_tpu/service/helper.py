@@ -23,7 +23,7 @@ from persia_tpu.service.coordinator import (
     ROLE_WORKER,
     CoordinatorClient,
 )
-from persia_tpu.utils import dump_yaml, find_free_port
+from persia_tpu.utils import dump_yaml, wait_addr_file
 
 _logger = get_default_logger(__name__)
 
@@ -121,10 +121,21 @@ class ServiceCtx:
         raw = _schema_to_yaml_dict(self.schema)
         dump_yaml(raw, schema_path)
 
-        port = find_free_port()
-        self.coordinator_addr = f"127.0.0.1:{port}"
-        self._spawn(["-m", "persia_tpu.service.coordinator", "--port",
-                     str(port)], "coordinator", 0, 1)
+        # Bind-race-free startup: the coordinator binds port 0 itself and
+        # publishes the kernel-assigned address through an addr-file.
+        # (Probing a free port here and passing it down is a TOCTOU race —
+        # under full-suite load another server can grab the port between
+        # probe and bind, crashing the coordinator at startup.)
+        addr_file = os.path.join(self._tmpdir.name, "coordinator.addr")
+        coord_proc = self._spawn(
+            ["-m", "persia_tpu.service.coordinator", "--port", "0",
+             "--addr-file", addr_file], "coordinator", 0, 1)
+        try:
+            self.coordinator_addr = wait_addr_file(
+                addr_file, self.startup_timeout, coord_proc)
+        except TimeoutError:
+            self.__exit__(None, None, None)
+            raise
         coord = CoordinatorClient(self.coordinator_addr)
         deadline = time.monotonic() + self.startup_timeout
         while not coord.ping():

@@ -276,6 +276,9 @@ def main():
                    default=os.environ.get("PERSIA_COORDINATOR_ADDR"))
     p.add_argument("--global-config", default=None)
     p.add_argument("--initial-checkpoint", default=None)
+    p.add_argument("--addr-file", default=None,
+                   help="write the bound address here after listen (with "
+                        "--port 0: race-free port handoff to a parent)")
     args = p.parse_args()
     from persia_tpu.tracing import start_deadlock_detection
 
@@ -308,6 +311,10 @@ def main():
                      args.initial_checkpoint)
     _logger.info("parameter server %d/%d listening on %s",
                  args.replica_index, args.replica_size, service.addr)
+    if args.addr_file:
+        from persia_tpu.utils import write_addr_file
+
+        write_addr_file(service.addr, args.addr_file)
     if args.coordinator:
         CoordinatorClient(args.coordinator).register(
             ROLE_PS, args.replica_index, service.addr)

@@ -4,6 +4,7 @@ import os
 import random
 import socket
 import subprocess
+import time
 from typing import Any, List, Optional
 
 import numpy as np
@@ -82,8 +83,39 @@ def run_command(cmd: List[str], env: Optional[dict] = None) -> subprocess.Popen:
     return subprocess.Popen(cmd, env=full_env)
 
 
+def write_addr_file(addr: str, path: str) -> None:
+    """Atomically publish a bound server address for a waiting parent
+    (the race-free alternative to probing a free port before spawn)."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(addr)
+    os.replace(tmp, path)
+
+
+def wait_addr_file(path: str, timeout: float = 60.0,
+                   proc: Optional[subprocess.Popen] = None) -> str:
+    """Poll for an addr-file written by :func:`write_addr_file`; if
+    ``proc`` is given, fail fast when the child exits first."""
+    deadline = time.monotonic() + timeout
+    while not os.path.exists(path):
+        if proc is not None and proc.poll() is not None:
+            raise TimeoutError(
+                f"server exited (rc={proc.returncode}) before "
+                f"publishing {path}")
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"no addr-file at {path} after {timeout}s")
+        time.sleep(0.05)
+    with open(path) as f:
+        return f.read().strip()
+
+
 def find_free_port(start: int = 10000, end: int = 65535) -> int:
-    """Pick a currently-free TCP port (reference: utils.py:83-91)."""
+    """Pick a currently-free TCP port (reference: utils.py:83-91).
+
+    NOTE: inherently racy (the port can be taken between probe and the
+    caller's bind). Prefer binding port 0 + :func:`write_addr_file` for
+    parent↔child port handoff; keep this only where a pre-known port is
+    semantically required (e.g. restart-on-same-port tests)."""
     for _ in range(128):
         port = random.randint(start, end)
         with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:

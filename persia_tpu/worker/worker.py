@@ -102,6 +102,12 @@ class EmbeddingWorker:
         self._t_preprocess = reg.histogram("lookup_preprocess_time_cost_sec")
         self._t_rpc = reg.histogram("lookup_rpc_time_cost_sec")
         self._t_postprocess = reg.histogram("lookup_postprocess_time_cost_sec")
+        # periodic expiry sweep — ingestion-piggybacked expiry alone never
+        # fires once the loaders die (see _sweep_loop)
+        self._sweep_stop = threading.Event()
+        self._sweep_thread = threading.Thread(
+            target=self._sweep_loop, daemon=True, name="worker-expiry-sweep")
+        self._sweep_thread.start()
 
     # --- control plane ---------------------------------------------------
 
@@ -149,9 +155,33 @@ class EmbeddingWorker:
                 expired = [r for r, item in buf.items() if item[-1] < horizon]
                 for r in expired:
                     del buf[r]
+                if expired and buf is self._post_forward_buffer:
+                    # each post-forward entry holds one staleness permit
+                    # (taken at lookup, normally released by
+                    # update_gradients); a dead trainer's entries must
+                    # release theirs or the counter stays elevated forever
+                    self.staleness -= len(expired)
                 if expired:
                     _logger.warning("expired %d stale buffered batches",
                                     len(expired))
+
+    def _sweep_loop(self):
+        """Background expiry, matching the C++ binary's periodic sweep
+        (native/src/worker_server.cc) and the reference's tokio interval
+        task (embedding_worker_service/mod.rs:991-1029). Without it, a
+        worker whose data-loaders/trainers died keeps dead buffer entries
+        (and their staleness counts) until the next ingest — which for a
+        dead pipeline never comes."""
+        interval = max(1.0, min(self.buffered_data_expired_sec / 4.0, 30.0))
+        while not self._sweep_stop.wait(interval):
+            try:
+                self._expire_stale()
+            except Exception:
+                _logger.exception("expiry sweep failed")
+
+    def close(self):
+        """Stop the background sweep (tests; services just exit)."""
+        self._sweep_stop.set()
 
     # --- trainer side ----------------------------------------------------
 

@@ -145,7 +145,7 @@ def manual_cluster(tmp_path):
         CoordinatorClient,
     )
     from persia_tpu.service.helper import _schema_to_yaml_dict
-    from persia_tpu.utils import find_free_port
+    from persia_tpu.utils import wait_addr_file
 
     schema = EmbeddingSchema(
         slots_config=uniform_slots(["slot_a", "slot_b"], dim=DIM))
@@ -156,7 +156,6 @@ def manual_cluster(tmp_path):
     import os
 
     env = {**os.environ, **env}
-    coord_port = find_free_port()
     procs = []
 
     def spawn(args):
@@ -164,20 +163,24 @@ def manual_cluster(tmp_path):
         procs.append(p)
         return p
 
+    addr_file = str(tmp_path / "coordinator.addr")
+    coord_proc = spawn(["persia_tpu.service.coordinator", "--port", "0",
+                        "--addr-file", addr_file])
+    coord_addr = wait_addr_file(addr_file, 60, coord_proc)
+
     def spawn_ps():
         return spawn(["persia_tpu.service.ps_service",
-                      "--coordinator", f"127.0.0.1:{coord_port}",
+                      "--coordinator", coord_addr,
                       "--replica-index", "0"])
 
-    spawn(["persia_tpu.service.coordinator", "--port", str(coord_port)])
-    coord = CoordinatorClient(f"127.0.0.1:{coord_port}")
+    coord = CoordinatorClient(coord_addr)
     deadline = time.monotonic() + 60
     while not coord.ping():
         assert time.monotonic() < deadline
         time.sleep(0.05)
     ps_proc = spawn_ps()
     spawn(["persia_tpu.service.worker_service",
-           "--coordinator", f"127.0.0.1:{coord_port}",
+           "--coordinator", coord_addr,
            "--num-ps", "1",
            "--embedding-config", str(schema_path)])
     coord.wait_members(ROLE_PS, 1, timeout=60)

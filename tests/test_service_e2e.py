@@ -291,7 +291,7 @@ def test_ps_infer_boot_with_initial_checkpoint(tmp_path):
 
     from persia_tpu.ps.store import EmbeddingHolder
     from persia_tpu.service.ps_service import PsClient
-    from persia_tpu.utils import find_free_port
+    from persia_tpu.utils import wait_addr_file
 
     # build a checkpoint file
     h = EmbeddingHolder(1000, 2)
@@ -302,17 +302,18 @@ def test_ps_infer_boot_with_initial_checkpoint(tmp_path):
     ckpt = tmp_path / "initial.psd"
     h.dump_file(str(ckpt))
 
-    port = find_free_port()
     import os as _os
 
+    addr_file = str(tmp_path / "ps.addr")
     proc = subprocess.Popen(
         [_sys.executable, "-m", "persia_tpu.service.ps_service",
-         "--port", str(port), "--initial-checkpoint", str(ckpt)],
+         "--port", "0", "--addr-file", addr_file,
+         "--initial-checkpoint", str(ckpt)],
         env={**_os.environ,
              "PYTHONPATH": str(Path(__file__).resolve().parent.parent)},
     )
     try:
-        ps = PsClient(f"127.0.0.1:{port}")
+        ps = PsClient(wait_addr_file(addr_file, 60, proc))
         deadline = _time.monotonic() + 60
         while _time.monotonic() < deadline:
             try:
@@ -334,20 +335,10 @@ def test_ps_infer_boot_with_initial_checkpoint(tmp_path):
 def test_full_four_role_deployment_via_launcher_scripts():
     """The DEPLOY.md topology end to end with real role entry scripts:
     ServiceCtx cluster + nn_worker.py trainer subprocess +
-    data_loader.py subprocess, all over the coordinator. Retried once:
-    with five processes sharing one CPU core, startup occasionally loses
-    the connect race under full-suite load."""
-    import os
-    import subprocess
-    import sys as _sys
-
-    for attempt in range(2):
-        try:
-            _run_four_role_deployment()
-            return
-        except (AssertionError, ConnectionError, OSError, TimeoutError):
-            if attempt == 1:
-                raise
+    data_loader.py subprocess, all over the coordinator. Runs once, no
+    retry: the startup race this used to absorb was the coordinator's
+    find-free-port TOCTOU, fixed at the source (addr-file handoff)."""
+    _run_four_role_deployment()
 
 
 def _run_four_role_deployment():

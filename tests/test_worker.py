@@ -86,3 +86,32 @@ def test_fanout_covers_all_ps_replicas():
     total = sum(len(c) for c in w.ps_clients)
     assert total == 199
     assert all(len(c) > 0 for c in w.ps_clients)
+
+
+def test_periodic_sweep_expires_dead_trainer_entries():
+    """A trainer that died after lookup never sends gradients: its
+    post-forward entries (and their staleness permits) must age out via
+    the BACKGROUND sweep — no further ingestion happens on a dead
+    pipeline (reference mod.rs:991-1029; C++ worker_server.cc periodic
+    sweep)."""
+    import time
+
+    w = _make_worker(buffered_data_expired_sec=3)
+    try:
+        ref_id, _ = w.lookup_direct_training(_batch())
+        w.put_batch(_batch())  # an orphaned pre-lookup batch too
+        assert w.staleness == 1
+        assert len(w._post_forward_buffer) == 1
+        assert len(w._forward_id_buffer) == 1
+        # no put_batch from here on — only the sweep thread can expire
+        deadline = time.monotonic() + 10
+        while (w._post_forward_buffer or w._forward_id_buffer) \
+                and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert not w._post_forward_buffer
+        assert not w._forward_id_buffer
+        assert w.staleness == 0  # the dead trainer's permit was released
+        with pytest.raises(KeyError):
+            w.update_gradients(ref_id, {})
+    finally:
+        w.close()

@@ -1,0 +1,25 @@
+#!/bin/bash
+# Round-5 priority-zero watcher: the moment a relay port opens, run the
+# driver-shaped bench capture (python bench.py, no args) FIRST — before any
+# exploratory chip work — and log the JSON line. bench.py carries its own
+# internal watchdog + preflight (never kill it externally; see BASELINE.md
+# round-4 lesson re: wedged accelerator claims).
+LOG=/root/repo/TPU_PROBE.log
+OUT=/root/repo/BENCH_CAPTURE_r05.log
+END=$(( $(date +%s) + 39600 ))  # ~11h
+while [ "$(date +%s)" -lt "$END" ]; do
+  for p in 8082 8083 8087 8092 8113; do
+    if timeout 2 bash -c "echo > /dev/tcp/127.0.0.1/$p" 2>/dev/null; then
+      echo "$(date -u +%FT%TZ) port $p OPEN — relay up, launching bench capture" >> "$LOG"
+      sleep 20  # let the relay finish coming up
+      cd /root/repo || exit 1
+      echo "=== $(date -u +%FT%TZ) driver-shaped capture: python bench.py ===" >> "$OUT"
+      python bench.py >> "$OUT" 2>&1
+      echo "=== rc=$? at $(date -u +%FT%TZ) ===" >> "$OUT"
+      exit 0
+    fi
+  done
+  sleep 45
+done
+echo "$(date -u +%FT%TZ) r05 bench watcher expired, relay never came up" >> "$LOG"
+exit 1
