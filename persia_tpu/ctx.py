@@ -215,6 +215,9 @@ class TrainCtx(EmbeddingCtx):
         self._eval_step = None
         self._emb_shapes = None
         self._ddp = False
+        # error-feedback residuals for grad_reduce_dtype="int8_ef"
+        # (per-replica, data-axis-sharded; see parallel/train.py)
+        self._ef_state = None
         # device-resident hot-row cache (TPU-first, beyond the reference:
         # hits never cross the host<->device wire; see
         # persia_tpu/parallel/cached_engine.py for the consistency model)
@@ -270,9 +273,9 @@ class TrainCtx(EmbeddingCtx):
             # (re)build the packed step for this batch geometry; jit caches
             # by shape so alternating geometries stay cheap
             self._emb_shapes = emb_shapes
-            reduce_dtype = (
-                jnp.bfloat16 if self.grad_reduce_dtype == "bf16" else None
-            )
+            reduce_dtype = {
+                "bf16": jnp.bfloat16, "int8_ef": "int8_ef",
+            }.get(self.grad_reduce_dtype)
             batch_size = emb_shapes[0][0] if emb_shapes else 0
             if self._use_ddp_step(emb_indices, batch_size):
                 self._ddp = True
@@ -283,6 +286,11 @@ class TrainCtx(EmbeddingCtx):
                     wire_dtype=self._wire_dtype(),
                     grad_reduce_dtype=reduce_dtype,
                 )
+                if reduce_dtype == "int8_ef" and self._ef_state is None:
+                    from persia_tpu.parallel.train import init_ef_state
+
+                    self._ef_state = init_ef_state(
+                        self.state.params, self.mesh)
             else:
                 self._ddp = False
                 self._train_step = make_packed_train_step(
@@ -402,9 +410,14 @@ class TrainCtx(EmbeddingCtx):
         else:
             label = labels[0]
         if self._ddp:
-            self.state, loss, flat_grads, pred = self._train_step(
-                self.state, non_id, flat_emb, label
-            )
+            if self._ef_state is not None:
+                (self.state, loss, flat_grads, pred,
+                 self._ef_state) = self._train_step(
+                    self.state, non_id, flat_emb, label, self._ef_state)
+            else:
+                self.state, loss, flat_grads, pred = self._train_step(
+                    self.state, non_id, flat_emb, label
+                )
         else:
             self.state, loss, flat_grads, pred = self._train_step(
                 self.state, non_id, flat_emb, emb_indices, label

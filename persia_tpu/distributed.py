@@ -36,6 +36,9 @@ class DistributedOption:
         grad_reduce_dtype: "bf16" casts dense gradients before the
             cross-replica all-reduce (the analogue of Bagua's
             low-precision algorithms, persia/distributed.py:204-410);
+            "int8_ef" uses an error-feedback int8 two-phase all-reduce
+            (the ByteGrad analogue — 4x fewer wire bytes, for
+            multi-host DCN meshes; see parallel/train.py _ef_int8_mean);
             None reduces in f32. Decentralized/async peer algorithms are
             deliberately absent — ICI all-reduce is already the fast
             path they approximate. Pass to ``TrainCtx`` alongside the

@@ -194,6 +194,82 @@ def make_packed_train_step(
     return jax.jit(step, donate_argnums=(0,))
 
 
+def _ef_int8_mean(p: jnp.ndarray, axis_name: str, world: int):
+    """Two-phase int8-compressed gradient mean over ``axis_name``.
+
+    The TPU survivor of the reference's Bagua family (ByteGrad/QAdam,
+    /root/reference/persia/distributed.py:204-410): on ICI a plain bf16
+    pmean already wins, but on multi-host DCN meshes the wire is the
+    bottleneck and 4x fewer bytes buys real throughput. Scheme:
+
+    1. quantize the (error-compensated) local gradient to int8 with a
+       per-replica per-bucket scale;
+    2. ``all_to_all`` the int8 shards (each device receives every
+       replica's copy of ITS shard — int8 on the wire), dequantize with
+       the gathered scales, sum in f32;
+    3. requantize the mean shard to int8 and ``all_gather`` it back.
+
+    Total wire bytes ~= 2 x size x 1B vs 2 x size x 4B for a ring f32
+    all-reduce. BOTH quantization stages feed back into ``err``
+    (error-feedback SGD: the residual re-enters the next step's
+    gradient, so the bias of deterministic rounding averages out and
+    convergence tracks the uncompressed trajectory): stage 1 locally on
+    every replica; stage 2 by the shard's owner, scaled by ``world``
+    because a mean error times world is the aggregate error the owner
+    must re-inject through its own (1/world-weighted) contribution.
+
+    ``p``: f32 vector (grad + carried error). Returns (mean, new_err),
+    both f32 of p's shape.
+    """
+    n = p.shape[0]
+    pad = (-n) % world
+    flat = jnp.pad(p, (0, pad))
+    scale = jnp.maximum(jnp.max(jnp.abs(flat)) / 127.0, 1e-30)
+    q = jnp.clip(jnp.round(flat / scale), -127, 127).astype(jnp.int8)
+    err1 = flat - q.astype(jnp.float32) * scale
+    chunk = flat.shape[0] // world
+    qs = q.reshape(world, chunk)
+    # rows of recv are indexed by source replica: recv[s] = replica s's
+    # int8 copy of THIS device's shard
+    recv = jax.lax.all_to_all(qs, axis_name, split_axis=0, concat_axis=0)
+    scales = jax.lax.all_gather(scale, axis_name)          # (world,)
+    shard_mean = jnp.sum(
+        recv.astype(jnp.float32) * scales[:, None], axis=0) / world
+    s2 = jnp.maximum(jnp.max(jnp.abs(shard_mean)) / 127.0, 1e-30)
+    q2 = jnp.clip(jnp.round(shard_mean / s2), -127, 127).astype(jnp.int8)
+    # stage-2 residual: this device owns shard `me` of the decoded mean
+    err2 = (shard_mean - q2.astype(jnp.float32) * s2) * world
+    me = jax.lax.axis_index(axis_name)
+    own = jax.lax.dynamic_slice(err1, (me * chunk,), (chunk,))
+    new_err = jax.lax.dynamic_update_slice(
+        err1, own + err2, (me * chunk,))[:n]
+    q2g = jax.lax.all_gather(q2, axis_name)                # (world, chunk)
+    s2g = jax.lax.all_gather(s2, axis_name)                # (world,)
+    mean = (q2g.astype(jnp.float32) * s2g[:, None]).reshape(-1)[:n]
+    return mean, new_err
+
+
+def init_ef_state(params, mesh) -> jnp.ndarray:
+    """Zero error-feedback residuals for ``grad_reduce_dtype="int8_ef"``:
+    one flat f32 vector of the dense-param count per data-parallel
+    replica, carried through the DDP step sharded over the data axis
+    (each replica's residual is ITS OWN quantization error — it must
+    not be replicated). Built under an explicit NamedSharding so a
+    multi-host mesh (the mode's stated target) gets a global array, not
+    a host-local one jit would refuse to reshard."""
+    from jax.flatten_util import ravel_pytree
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from persia_tpu.parallel.mesh import DATA_AXIS
+
+    flat, _ = ravel_pytree(params)
+    world = mesh.shape[DATA_AXIS]
+    return jax.device_put(
+        jnp.zeros((world, flat.shape[0]), jnp.float32),
+        NamedSharding(mesh, P("data")))
+
+
 def make_packed_train_step_ddp(
     model,
     optimizer: optax.GradientTransformation,
@@ -212,9 +288,14 @@ def make_packed_train_step_ddp(
     the dense gradients cross ICI in ``jax.lax.pmean`` — optionally cast
     to ``grad_reduce_dtype`` (e.g. ``jnp.bfloat16``) first, halving
     all-reduce bytes the way Bagua's low-precision algorithms do.
-    Decentralized/async peer algorithms have no XLA analogue and are
-    deliberately absent: ICI all-reduce is already the fast path the
-    reference's algorithms try to approximate.
+    ``grad_reduce_dtype="int8_ef"`` goes further: an error-feedback
+    int8 two-phase all-reduce (see :func:`_ef_int8_mean`) cutting wire
+    bytes 4x — the Bagua ByteGrad analogue for multi-host DCN meshes.
+    In that mode the step takes and returns an extra ``ef_state``
+    residual (build with :func:`init_ef_state`). Decentralized/async
+    peer algorithms have no XLA analogue and are deliberately absent:
+    ICI all-reduce is already the fast path the reference's algorithms
+    try to approximate.
 
     Requires every slot to be summed (pooled): embedding values enter
     batch-major as ONE ``(batch, sum(slot_dims))`` wire array so the
@@ -229,8 +310,13 @@ def make_packed_train_step_ddp(
     bounds = np.concatenate([[0], np.cumsum(slot_dims)]).tolist()
     data_spec = P("data")
     rep = P()
+    ef_mode = grad_reduce_dtype == "int8_ef"
+    from persia_tpu.parallel.mesh import DATA_AXIS
 
-    def local_step(state: TrainState, non_id_tensors, flat_emb, label):
+    world = mesh.shape[DATA_AXIS]
+
+    def local_step(state: TrainState, non_id_tensors, flat_emb, label,
+                   ef_state=None):
         emb_values = [
             flat_emb[:, bounds[i]:bounds[i + 1]].astype(jnp.float32)
             for i in range(len(slot_dims))
@@ -257,13 +343,23 @@ def make_packed_train_step_ddp(
         )
         # the cross-replica exchange: dense grads ride ICI, optionally in
         # reduced precision (cast -> pmean -> f32, Bagua low-prec analogue)
-        if grad_reduce_dtype is not None:
-            param_grads = jax.tree_util.tree_map(
-                lambda g: g.astype(grad_reduce_dtype), param_grads)
-        param_grads = jax.lax.pmean(param_grads, axis_name="data")
-        if grad_reduce_dtype is not None:
-            param_grads = jax.tree_util.tree_map(
-                lambda g: g.astype(jnp.float32), param_grads)
+        # or int8 with error feedback (ByteGrad analogue, 4x fewer bytes)
+        if ef_mode:
+            from jax.flatten_util import ravel_pytree
+
+            flat_g, unravel = ravel_pytree(param_grads)
+            mean_flat, new_err = _ef_int8_mean(
+                flat_g + ef_state[0], "data", world)
+            param_grads = unravel(mean_flat)
+            new_ef_state = new_err[None, :]
+        else:
+            if grad_reduce_dtype is not None:
+                param_grads = jax.tree_util.tree_map(
+                    lambda g: g.astype(grad_reduce_dtype), param_grads)
+            param_grads = jax.lax.pmean(param_grads, axis_name="data")
+            if grad_reduce_dtype is not None:
+                param_grads = jax.tree_util.tree_map(
+                    lambda g: g.astype(jnp.float32), param_grads)
         loss = jax.lax.pmean(loss, axis_name="data")
         if mutated:
             # BatchNorm running stats are computed per batch shard;
@@ -282,14 +378,17 @@ def make_packed_train_step_ddp(
             step=state.step + 1,
         )
         flat_grads = jnp.concatenate(emb_grads, axis=1).astype(wire_dtype)
+        if ef_mode:
+            return new_state, loss, flat_grads, pred, new_ef_state
         return new_state, loss, flat_grads, pred
 
+    extra = (data_spec,) if ef_mode else ()
     sharded = _shard_map(
         local_step, mesh,
-        in_specs=(rep, data_spec, data_spec, data_spec),
-        out_specs=(rep, rep, data_spec, data_spec),
+        in_specs=(rep, data_spec, data_spec, data_spec) + extra,
+        out_specs=(rep, rep, data_spec, data_spec) + extra,
     )
-    return jax.jit(sharded, donate_argnums=(0,))
+    return jax.jit(sharded, donate_argnums=(0, 4) if ef_mode else (0,))
 
 
 def pack_embedding_values_batch_major(
