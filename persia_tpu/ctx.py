@@ -224,6 +224,7 @@ class TrainCtx(EmbeddingCtx):
         self.device_cache_capacity = int(device_cache_capacity)
         self._cache_engine = None
         self._cached_step = None
+        self._cache_multi_id = False
 
     def __enter__(self):
         super().__enter__()
@@ -456,52 +457,72 @@ class TrainCtx(EmbeddingCtx):
 
     def _ensure_cache(self, batch: PersiaBatch):
         """First-batch validation + lazy build of the cache engine and
-        the fused cached step. The v1 envelope: single chip (no mesh),
-        single-id slots, uniform dim, non-shared Adagrad — exactly the
-        flagship DLRM/Criteo shape; anything else raises with the reason
-        rather than silently degrading."""
+        the fused cached step. The v2 envelope: uniform dim, SUMMED
+        slots, non-shared Adagrad. Single-id slots take the pure-gather
+        fast path; multi-id bags take the segment-sum step (with
+        sqrt_scaling parity). A mesh is supported — the cache becomes
+        one GSPMD row-sharded array (cached_train._row_sharding).
+        Anything outside the envelope raises with the reason rather
+        than silently degrading."""
         if self._cache_engine is not None:
             return
         from persia_tpu.embedding.optim import Adagrad as ClientAdagrad
 
-        if self.mesh is not None:
-            raise NotImplementedError(
-                "device cache v1 is single-chip (mesh=None): replicated "
-                "per-trainer caches would fork hot rows' optimizer state")
         opt = self.embedding_optimizer
         if not isinstance(opt, ClientAdagrad) or opt.vectorwise_shared:
             raise NotImplementedError(
-                "device cache v1 mirrors non-shared Adagrad on device; "
+                "device cache mirrors non-shared Adagrad on device; "
                 f"got {type(opt).__name__}")
+        from persia_tpu.data.batch import IDTypeFeatureWithSingleID
+
+        # Mode dispatch is TYPE-based, not shape-based: the SingleID
+        # class guarantees one id per sample on EVERY batch, so the
+        # fast pure-gather path can never meet a later variable-length
+        # batch. Base IDTypeFeature streams (even if the first batch
+        # happens to look single-id) take the general bag path — a
+        # first-batch shape probe would lock in the wrong step.
+        multi_id = not all(
+            isinstance(f, IDTypeFeatureWithSingleID)
+            for f in batch.id_type_features)
         dims = set()
         for f in batch.id_type_features:
-            # exactly one sign per sample: offsets must be 0,1,2,...,B
-            # (a total-count check alone false-passes multi-id bags whose
-            # sign count happens to equal the batch size)
-            if not np.array_equal(
-                    f.offsets,
-                    np.arange(len(f.offsets), dtype=f.offsets.dtype)):
+            slot = self.schema.get_slot(f.name)
+            # both cached steps feed the model per-slot (B, D) pooled
+            # values; a raw (non-summed) slot expects the padded
+            # distinct + index representation and would be silently
+            # sum-pooled — reject regardless of observed bag shape
+            if not slot.embedding_summation:
                 raise NotImplementedError(
-                    "device cache v1 needs single-id slots "
-                    f"({f.name} is multi-id)")
-            dims.add(self.schema.get_slot(f.name).dim)
+                    "device cache needs summed (pooled) slots; "
+                    f"{f.name} is a raw slot")
+            dims.add(slot.dim)
         if len(dims) != 1:
             raise NotImplementedError(
-                f"device cache v1 needs one uniform slot dim, got {dims}")
+                f"device cache needs one uniform slot dim, got {dims}")
         dim = dims.pop()
         num_slots = len(batch.id_type_features)
         from persia_tpu.parallel.cached_engine import DeviceCacheEngine
-        from persia_tpu.parallel.cached_train import make_cached_train_step
+        from persia_tpu.parallel.cached_train import (
+            make_cached_bag_train_step,
+            make_cached_train_step,
+        )
 
         self._cache_engine = DeviceCacheEngine(
             self.worker, self.device_cache_capacity, num_slots, dim,
-            acc_init=opt.initial_accumulator_value)
-        self._cached_step = make_cached_train_step(
+            acc_init=opt.initial_accumulator_value, mesh=self.mesh,
+            sqrt_scaling=[
+                self.schema.get_slot(f.name).sqrt_scaling
+                for f in batch.id_type_features])
+        self._cache_multi_id = multi_id
+        maker = make_cached_bag_train_step if multi_id \
+            else make_cached_train_step
+        self._cached_step = maker(
             self.model, self.dense_optimizer, num_slots, dim,
             lr=opt.lr, eps=opt.eps,
             g_square_momentum=opt.g_square_momentum,
             loss_fn=self.loss_fn,
-            weight_bound=self.embedding_config.weight_bound)
+            weight_bound=self.embedding_config.weight_bound,
+            capacity=self.device_cache_capacity, mesh=self.mesh)
         if self.state is None:
             from persia_tpu.parallel.train import create_train_state
 
@@ -520,16 +541,29 @@ class TrainCtx(EmbeddingCtx):
     def _cached_train_step(self, batch: PersiaBatch):
         self._ensure_cache(batch)
         eng = self._cache_engine
-        (slot_idx, cold_idx, cold_vals, cold_acc, evicted, evicted_mask,
-         inverse, unique_slots) = eng.prepare(batch.id_type_features)
         non_id = [jnp.asarray(f.data) for f in batch.non_id_type_features]
         label = jnp.asarray(batch.labels[0].data)
-        (self.state, eng.cache_vals, eng.cache_acc, loss, pred,
-         ev_vals, ev_acc) = self._cached_step(
-            self.state, eng.cache_vals, eng.cache_acc, non_id,
-            jnp.asarray(slot_idx), jnp.asarray(cold_idx),
-            jnp.asarray(cold_vals), jnp.asarray(cold_acc),
-            jnp.asarray(inverse), jnp.asarray(unique_slots), label)
+        if self._cache_multi_id:
+            (flat_slot_idx, seg, scale, cold_idx, cold_vals, cold_acc,
+             evicted, evicted_mask, inverse,
+             unique_slots) = eng.prepare_bags(batch.id_type_features)
+            (self.state, eng.cache_vals, eng.cache_acc, loss, pred,
+             ev_vals, ev_acc) = self._cached_step(
+                self.state, eng.cache_vals, eng.cache_acc, non_id,
+                jnp.asarray(flat_slot_idx), jnp.asarray(seg),
+                jnp.asarray(scale), jnp.asarray(cold_idx),
+                jnp.asarray(cold_vals), jnp.asarray(cold_acc),
+                jnp.asarray(inverse), jnp.asarray(unique_slots), label)
+        else:
+            (slot_idx, cold_idx, cold_vals, cold_acc, evicted,
+             evicted_mask, inverse,
+             unique_slots) = eng.prepare(batch.id_type_features)
+            (self.state, eng.cache_vals, eng.cache_acc, loss, pred,
+             ev_vals, ev_acc) = self._cached_step(
+                self.state, eng.cache_vals, eng.cache_acc, non_id,
+                jnp.asarray(slot_idx), jnp.asarray(cold_idx),
+                jnp.asarray(cold_vals), jnp.asarray(cold_acc),
+                jnp.asarray(inverse), jnp.asarray(unique_slots), label)
         eng.finish(evicted, evicted_mask, ev_vals, ev_acc)
         return loss, pred
 

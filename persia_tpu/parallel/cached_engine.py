@@ -13,7 +13,10 @@ device; the PS copy of a cached sign is stale until the row is evicted
 it). A cache miss reads the victim buffer first, so an evicted row
 re-entering the cache never loses its in-flight update. Single-trainer
 only: replicated per-trainer caches would fork hot rows' optimizer
-state across trainers with no reconciliation.
+state across trainers with no reconciliation. A device MESH is fine —
+the cache is then ONE logical array row-sharded over the mesh by GSPMD
+(see cached_train._row_sharding): still a single program, a single
+writer, and per-device HBM that scales down with the device count.
 """
 
 import queue
@@ -33,18 +36,21 @@ _BUCKETS = (64, 256, 1024, 4096, 16384, 65536)
 
 class DeviceCacheEngine:
     def __init__(self, worker, capacity: int, num_slots: int, dim: int,
-                 acc_init: float):
+                 acc_init: float, mesh=None, sqrt_scaling=None):
         self.worker = worker
         self.capacity = int(capacity)
         self.num_slots = int(num_slots)
         self.dim = int(dim)
         self.acc_init = float(acc_init)
+        self.mesh = mesh
+        # per-slot sqrt-scaling flags (bag mode only; see prepare_bags)
+        self.sqrt_scaling = list(sqrt_scaling or [])
         self.mapper = make_sign_slot_map(capacity)
         self.victims = VictimBuffer()
         from persia_tpu.parallel.cached_train import init_cache_arrays
 
         self.cache_vals, self.cache_acc = init_cache_arrays(
-            capacity, dim, acc_init)
+            capacity, dim, acc_init, mesh=mesh)
         self._flush_q: "queue.Queue" = queue.Queue()
         self._flush_token = 0
         self._flush_err: List[BaseException] = []
@@ -73,14 +79,83 @@ class DeviceCacheEngine:
         batch, num_slots = signs.shape
         flat_signs = signs.reshape(-1)
         res = self.mapper.assign(flat_signs)
-        slots, miss_pos, evicted, emask = (res.slots, res.miss_pos,
-                                           res.evicted_signs,
-                                           res.evicted_mask)
         # tail past the distinct count is uninitialized: point it at the
         # dummy slot so the device update's pad rows are inert
         unique_slots = res.unique_slots
         unique_slots[res.n_unique:] = self.capacity
-        slot_idx = slots.reshape(batch, num_slots)
+        slot_idx = res.slots.reshape(batch, num_slots)
+        (cold_idx, cold_vals, cold_acc, evicted_signs, evicted_mask,
+         mpad) = self._miss_import(flat_signs, res)
+        # bookkeeping: what the packed path would have moved for this
+        # batch (bf16 both ways) minus what the cached path moves
+        packed = batch * num_slots * self.dim * 2 * 2
+        moved = (slot_idx.nbytes + cold_idx.nbytes + cold_vals.nbytes
+                 + cold_acc.nbytes + (2 * mpad * self.dim * 4))
+        self.wire_bytes_saved += max(0, packed - moved)
+        return (slot_idx, cold_idx, cold_vals, cold_acc, evicted_signs,
+                evicted_mask, res.inverse, unique_slots)
+
+    def prepare_bags(self, id_type_features) -> tuple:
+        """Multi-id variant of :meth:`prepare` for summed bag slots.
+
+        Flattens every (sample, slot) bag into one position list
+        (slot-major), maps it through the same LRU assign, and returns
+        (flat_slot_idx (Lpad,) i32, seg (Lpad,) i32, scale (B, S) f32,
+        cold_idx, cold_vals, cold_acc, evicted_signs, evicted_mask,
+        inverse (Lpad,) i32, unique_slots (Lpad,) i32) for
+        ``make_cached_bag_train_step``. Pad positions carry
+        seg == B*S (the trash bag row) and the dummy slot."""
+        batch = id_type_features[0].batch_size
+        num_slots = len(id_type_features)
+        sign_parts, seg_parts, counts = [], [], []
+        for s, f in enumerate(id_type_features):
+            off = f.offsets.astype(np.int64)
+            cnt = np.diff(off)
+            counts.append(cnt)
+            sign_parts.append(f.signs)
+            seg_parts.append(
+                np.repeat(np.arange(batch, dtype=np.int64) * num_slots + s,
+                          cnt))
+        flat_signs = np.concatenate(sign_parts).astype(np.uint64)
+        seg = np.concatenate(seg_parts)
+        n = len(flat_signs)
+        res = self.mapper.assign(flat_signs)
+        lpad = pad_to_bucket(max(n, 1), _BUCKETS)
+        flat_slot_idx = np.full(lpad, self.capacity, np.int32)
+        flat_slot_idx[:n] = res.slots
+        seg_pad = np.full(lpad, batch * num_slots, np.int32)
+        seg_pad[:n] = seg
+        # pad inverse entries add the (zero) trash-row grad to distinct
+        # index 0 — adding zeros is inert
+        inverse = np.zeros(lpad, np.int32)
+        inverse[:n] = res.inverse
+        unique_slots = np.full(lpad, self.capacity, np.int32)
+        unique_slots[:res.n_unique] = res.unique_slots[:res.n_unique]
+        # per-(sample, slot) sqrt scaling, matching the middleware's
+        # 1/sqrt(max(bag size, 1)) (worker/middleware.py)
+        scale = np.ones((batch, num_slots), np.float32)
+        for s in range(num_slots):
+            if self.sqrt_scaling and self.sqrt_scaling[s]:
+                scale[:, s] = 1.0 / np.sqrt(
+                    np.maximum(counts[s], 1).astype(np.float32))
+        (cold_idx, cold_vals, cold_acc, evicted_signs, evicted_mask,
+         mpad) = self._miss_import(flat_signs, res)
+        packed = batch * num_slots * self.dim * 2 * 2
+        moved = (flat_slot_idx.nbytes + seg_pad.nbytes + scale.nbytes
+                 + cold_idx.nbytes + cold_vals.nbytes + cold_acc.nbytes
+                 + (2 * mpad * self.dim * 4))
+        self.wire_bytes_saved += max(0, packed - moved)
+        return (flat_slot_idx, seg_pad, scale, cold_idx, cold_vals,
+                cold_acc, evicted_signs, evicted_mask, inverse,
+                unique_slots)
+
+    def _miss_import(self, flat_signs, res):
+        """Fetch this batch's miss rows (victim buffer first, then PS),
+        bucket-padded. Returns (cold_idx, cold_vals, cold_acc,
+        evicted_signs, evicted_mask, mpad)."""
+        slots, miss_pos, evicted, emask = (res.slots, res.miss_pos,
+                                           res.evicted_signs,
+                                           res.evicted_mask)
         miss_signs = flat_signs[miss_pos]
         m = len(miss_signs)
         mpad = pad_to_bucket(max(m, 1), _BUCKETS)
@@ -117,14 +192,8 @@ class DeviceCacheEngine:
                     cold_acc[idx] = state
                 # (space != dim would mean a non-matching optimizer; the
                 # ctx-level guard rejects that before the engine exists)
-        # bookkeeping: what the packed path would have moved for this
-        # batch (bf16 both ways) minus what the cached path moves
-        packed = batch * num_slots * self.dim * 2 * 2
-        moved = (slot_idx.nbytes + cold_idx.nbytes + cold_vals.nbytes
-                 + cold_acc.nbytes + (2 * mpad * self.dim * 4))
-        self.wire_bytes_saved += max(0, packed - moved)
-        return (slot_idx, cold_idx, cold_vals, cold_acc, evicted_signs,
-                evicted_mask, res.inverse, unique_slots)
+        return (cold_idx, cold_vals, cold_acc, evicted_signs,
+                evicted_mask, mpad)
 
     def finish(self, evicted_signs: np.ndarray, evicted_mask: np.ndarray,
                ev_vals, ev_acc) -> None:
@@ -231,7 +300,7 @@ class DeviceCacheEngine:
         from persia_tpu.parallel.cached_train import init_cache_arrays
 
         self.cache_vals, self.cache_acc = init_cache_arrays(
-            self.capacity, self.dim, self.acc_init)
+            self.capacity, self.dim, self.acc_init, mesh=self.mesh)
 
     def _drain_flush_queue(self):
         """Block until queued write-backs complete (order matters: a

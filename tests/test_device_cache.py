@@ -172,14 +172,14 @@ def _schema():
     return EmbeddingSchema(slots_config=uniform_slots(SLOTS, dim=DIM))
 
 
-def _make_ctx(worker, cache_capacity=0, seed=3):
+def _make_ctx(worker, cache_capacity=0, seed=3, mesh=None, schema=None):
     from persia_tpu.config import CommonConfig, GlobalConfig
 
     return TrainCtx(
         model=DLRM(embedding_dim=DIM),
         dense_optimizer=optax.adagrad(0.05),
         embedding_optimizer=Adagrad(lr=0.05),
-        schema=_schema(),
+        schema=schema or _schema(),
         worker=worker,
         embedding_config=EmbeddingConfig(emb_initialization=(-0.05, 0.05)),
         # f32 wire so the uncached run is comparable at float tolerance
@@ -188,6 +188,7 @@ def _make_ctx(worker, cache_capacity=0, seed=3):
             common=CommonConfig(embedding_wire_dtype="f32")),
         seed=seed,
         device_cache_capacity=cache_capacity,
+        mesh=mesh,
     )
 
 
@@ -211,12 +212,13 @@ def _zipf_batches(n_batches, bs, vocab=400, seed=0):
         )
 
 
-def _run(cache_capacity, n_batches=12, bs=64, holder_factory=None):
+def _run(cache_capacity, n_batches=12, bs=64, holder_factory=None,
+         mesh=None):
     from persia_tpu.ps.store import EmbeddingHolder
 
     factory = holder_factory or (lambda: EmbeddingHolder(100_000, 2))
     worker = EmbeddingWorker(_schema(), [factory(), factory()])
-    ctx = _make_ctx(worker, cache_capacity)
+    ctx = _make_ctx(worker, cache_capacity, mesh=mesh)
     losses = []
     with ctx:
         for b in _zipf_batches(n_batches, bs):
@@ -394,3 +396,142 @@ def test_cache_rejects_unsupported_shapes():
         b = next(_zipf_batches(1, 8))
         with pytest.raises(NotImplementedError):
             ctx.train_step(b)
+
+
+def test_cached_matches_uncached_on_mesh():
+    """The v2 envelope's mesh support: under the 8-device CPU mesh the
+    cache is ONE GSPMD row-sharded array — same program, partitioned —
+    so losses AND post-flush PS contents must match the unmeshed
+    uncached run to float tolerance (the same gate that certifies v1)."""
+    import jax
+
+    from persia_tpu.parallel.mesh import make_mesh
+
+    losses_ref, tables_ref = _run(0, n_batches=8, bs=64)
+    mesh = make_mesh((8, 1))
+    losses_mesh, tables_mesh = _run(2048, n_batches=8, bs=64, mesh=mesh)
+    np.testing.assert_allclose(losses_mesh, losses_ref, rtol=1e-3,
+                               atol=1e-3)
+    total = 0
+    for tr, tc in zip(tables_ref, tables_mesh):
+        assert set(tr) == set(tc)
+        for sign in tr:
+            np.testing.assert_allclose(tc[sign], tr[sign], rtol=1e-3,
+                                       atol=1e-3, err_msg=f"sign {sign}")
+            total += 1
+    assert total > 100
+
+
+def test_cached_mesh_arrays_actually_sharded():
+    """The cache arrays must really be laid out across the mesh (not
+    silently replicated — the HBM-scaling claim depends on it)."""
+    from persia_tpu.parallel.mesh import make_mesh
+    from persia_tpu.ps.store import EmbeddingHolder
+
+    mesh = make_mesh((4, 2))
+    worker = EmbeddingWorker(_schema(), [EmbeddingHolder(100_000, 2)])
+    ctx = _make_ctx(worker, cache_capacity=1024, mesh=mesh)
+    with ctx:
+        for b in _zipf_batches(2, 32):
+            ctx.train_step(b)
+        eng = ctx._cache_engine
+        shardings = {tuple(s.index) for s in
+                     eng.cache_vals.addressable_shards}
+        assert len(shardings) == 8  # 8 distinct row ranges, one per device
+        # rows axis padded to a device-count multiple, dummy row intact
+        assert eng.cache_vals.shape[0] % 8 == 0
+        assert eng.cache_vals.shape[0] >= 1024 + 1
+
+
+def _bag_schema():
+    from persia_tpu.config import SlotConfig
+
+    # two plain summed bags + one sqrt-scaled bag (middleware parity)
+    return EmbeddingSchema(slots_config={
+        "b0": SlotConfig(name="b0", dim=DIM),
+        "b1": SlotConfig(name="b1", dim=DIM),
+        "b2": SlotConfig(name="b2", dim=DIM, sqrt_scaling=True),
+    })
+
+
+def _bag_batches(n_batches, bs, vocab=300, seed=0):
+    from persia_tpu.data.batch import IDTypeFeature
+
+    rng = np.random.default_rng(seed)
+    for i in range(n_batches):
+        feats = []
+        for s, name in enumerate(["b0", "b1", "b2"]):
+            # variable bag sizes incl. empty bags; duplicate ids within
+            # a bag are legal and must count twice
+            rows = [
+                ((rng.zipf(1.5, size=rng.integers(0, 4)) % vocab)
+                 + s * vocab + 1).astype(np.uint64)
+                for _ in range(bs)
+            ]
+            feats.append(IDTypeFeature(name, rows))
+        dense = rng.normal(size=(bs, 13)).astype(np.float32)
+        label = (rng.random((bs, 1)) < 0.3).astype(np.float32)
+        yield PersiaBatch(
+            feats,
+            non_id_type_features=[NonIDTypeFeature(dense)],
+            labels=[Label(label)],
+            requires_grad=True,
+            batch_id=i,
+        )
+
+
+def _run_bags(cache_capacity, n_batches=8, bs=64, mesh=None):
+    from persia_tpu.ps.store import EmbeddingHolder
+
+    worker = EmbeddingWorker(_bag_schema(),
+                             [EmbeddingHolder(100_000, 2),
+                              EmbeddingHolder(100_000, 2)])
+    ctx = _make_ctx(worker, cache_capacity, mesh=mesh,
+                    schema=_bag_schema())
+    losses = []
+    with ctx:
+        for b in _bag_batches(n_batches, bs):
+            loss, _ = ctx.train_step(b)
+            losses.append(float(loss))
+        if cache_capacity:
+            ctx.flush_device_cache()
+        tables = []
+        for c in worker.ps_clients:
+            entries = {}
+            for sign, (d, vec) in _iter_entries(c):
+                entries[sign] = vec[:d].copy()
+            tables.append(entries)
+    return losses, tables
+
+
+def test_cached_multi_id_bags_match_uncached():
+    """Multi-id summed bags (variable length, empty bags, duplicate ids,
+    one sqrt-scaled slot) through the segment-sum cached step must match
+    the uncached middleware path: same losses, same PS contents."""
+    losses_ref, tables_ref = _run_bags(0)
+    losses_cached, tables_cached = _run_bags(2048)
+    np.testing.assert_allclose(losses_cached, losses_ref, rtol=1e-3,
+                               atol=1e-3)
+    total = 0
+    for tr, tc in zip(tables_ref, tables_cached):
+        assert set(tr) == set(tc)
+        for sign in tr:
+            np.testing.assert_allclose(tc[sign], tr[sign], rtol=1e-3,
+                                       atol=1e-3, err_msg=f"sign {sign}")
+            total += 1
+    assert total > 50
+
+
+def test_cached_multi_id_bags_on_mesh_with_eviction():
+    """Bags + mesh + a tiny cache (eviction churn) together."""
+    from persia_tpu.parallel.mesh import make_mesh
+
+    losses_ref, tables_ref = _run_bags(0, n_batches=6)
+    losses_c, tables_c = _run_bags(160, n_batches=6,
+                                   mesh=make_mesh((8, 1)))
+    np.testing.assert_allclose(losses_c, losses_ref, rtol=1e-3, atol=1e-3)
+    for tr, tc in zip(tables_ref, tables_c):
+        assert set(tr) == set(tc)
+        for sign in tr:
+            np.testing.assert_allclose(tc[sign], tr[sign], rtol=1e-3,
+                                       atol=1e-3, err_msg=f"sign {sign}")
