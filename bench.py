@@ -713,23 +713,19 @@ def main():
         "roofline": ("dlrm_hybrid_best_samples_per_sec", "samples/sec"),
     }[args.mode]
 
-    # Two-tier watchdog. Tier 1 (threading.Timer) emits the diagnostic
-    # JSON line (rc=0) — but as Python code it needs the GIL, which a
-    # native call wedged *while holding it* would deny. Tier 2
-    # (faulthandler's pure-C watchdog thread) needs no GIL and hard-exits
-    # 60s later as the backstop, so the harness never hangs either way.
-    import faulthandler
-
-    def watchdog():
-        faulthandler.dump_traceback(file=sys.stderr)
-        _diag_exit(metric, unit,
-                   f"bench watchdog fired after {args.max_seconds}s")
+    # Shared two-tier watchdog (persia_tpu.utils.arm_watchdog — the same
+    # arrangement the probes and PERSIA_TEST_TPU pytest runs arm): tier 1
+    # emits the diagnostic JSON line, tier 2 (faulthandler, no GIL
+    # needed) hard-exits 60s later as the backstop, so the harness never
+    # hangs either way.
+    from persia_tpu.utils import arm_watchdog
 
     log(f"bench: watchdog armed at {args.max_seconds}s")
-    wd = threading.Timer(args.max_seconds, watchdog)
-    wd.daemon = True
-    wd.start()
-    faulthandler.dump_traceback_later(args.max_seconds + 60, exit=True)
+    cancel_watchdog = arm_watchdog(
+        args.max_seconds, label="bench",
+        on_fire=lambda: _diag_exit(
+            metric, unit,
+            f"bench watchdog fired after {args.max_seconds}s"))
     if args.smoke:
         args.batch_size, args.steps, args.warmup = 256, 3, 1
 
@@ -785,8 +781,7 @@ def main():
         value = bench_device(args.batch_size, args.steps, args.warmup,
                              vocab=(1 << 12) if args.smoke else (1 << 20))
         vs_baseline = value / BASELINE_SAMPLES_PER_SEC
-    wd.cancel()
-    faulthandler.cancel_dump_traceback_later()
+    cancel_watchdog()
     log(f"bench: done in {time.perf_counter() - t0:.1f}s -> "
         f"{value:,.1f} {unit}")
     _emit_json({

@@ -83,6 +83,52 @@ def run_command(cmd: List[str], env: Optional[dict] = None) -> subprocess.Popen:
     return subprocess.Popen(cmd, env=full_env)
 
 
+def arm_watchdog(max_seconds: int, label: str = "tool", on_fire=None):
+    """Two-tier in-process watchdog for EVERY chip-touching tool.
+
+    Round-4 lesson (BASELINE.md): a TPU client killed EXTERNALLY
+    mid-compile wedges the accelerator claim for everyone after it; an
+    in-process exit leaves the claim releasable. Tier 1
+    (threading.Timer) dumps stacks and exits with a diagnostic — but
+    needs the GIL, which a wedged native call may hold. Tier 2
+    (faulthandler's pure-C watchdog) needs no GIL and hard-exits 60s
+    later as the backstop. Used by bench.py, the probes, and the
+    PERSIA_TEST_TPU pytest runs (conftest); never wrap these tools in
+    external `timeout`/kill instead.
+
+    ``on_fire``: optional callable run by tier 1 instead of the default
+    exit (bench.py passes its JSON-diagnostic emitter); it must
+    terminate the process itself. Returns a zero-arg ``cancel``.
+    """
+    import faulthandler
+    import sys
+    import threading
+
+    def fire():
+        print(f"{label}: watchdog fired after {max_seconds}s — "
+              "exiting in-process to keep the accelerator claim "
+              "releasable", file=sys.stderr, flush=True)
+        faulthandler.dump_traceback(file=sys.stderr)
+        if on_fire is not None:
+            on_fire()
+        # raising in a timer thread wouldn't stop the main thread;
+        # os._exit skips atexit but IS an in-process exit — the PJRT
+        # client object is torn down with the process, not killed
+        # mid-syscall by an outside signal at an arbitrary point
+        os._exit(17)
+
+    t = threading.Timer(max_seconds, fire)
+    t.daemon = True
+    t.start()
+    faulthandler.dump_traceback_later(max_seconds + 60, exit=True)
+
+    def cancel():
+        t.cancel()
+        faulthandler.cancel_dump_traceback_later()
+
+    return cancel
+
+
 def write_addr_file(addr: str, path: str) -> None:
     """Atomically publish a bound server address for a waiting parent
     (the race-free alternative to probing a free port before spawn)."""

@@ -25,6 +25,15 @@ from persia_tpu.utils import force_cpu_platform  # noqa: E402
 
 if os.environ.get("PERSIA_TEST_TPU") != "1":
     force_cpu_platform(8)
+else:
+    # Chip-touching pytest runs get the same two-tier in-process
+    # watchdog as bench.py: a hung remote compile must self-exit (claim
+    # stays releasable), never be killed externally (round-4 lesson —
+    # an external kill mid-compile wedged the accelerator claim).
+    from persia_tpu.utils import arm_watchdog
+
+    arm_watchdog(int(os.environ.get("PERSIA_TPU_WATCHDOG_SEC", "1500")),
+                 label="pytest[PERSIA_TEST_TPU]")
 
 
 @pytest.fixture(scope="session")

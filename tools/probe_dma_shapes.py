@@ -13,7 +13,17 @@ redesign needs to know exactly which copy shapes are legal:
 Run on real TPU only (CPU interpret mode accepts everything).
 """
 
+import pathlib
 import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from persia_tpu.utils import arm_watchdog
+
+# chip-touching tool: in-process watchdog armed BEFORE the jax import so
+# even a hang during backend init self-exits; never external kill
+# (round-4 wedged-claim lesson, BASELINE.md)
+arm_watchdog(1200, label=__file__)
 
 import jax
 import jax.numpy as jnp

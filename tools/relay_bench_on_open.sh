@@ -1,21 +1,38 @@
 #!/bin/bash
 # Round-5 priority-zero watcher: the moment a relay port opens, run the
-# driver-shaped bench capture (python bench.py, no args) FIRST — before any
-# exploratory chip work — and log the JSON line. bench.py carries its own
-# internal watchdog + preflight (never kill it externally; see BASELINE.md
-# round-4 lesson re: wedged accelerator claims).
+# chip sequence in VERDICT order — driver-shaped capture FIRST, then the
+# pending round-4 validations, then a re-capture. Every step is a python
+# process with its OWN internal two-tier watchdog (bench.py's built-in;
+# pytest via conftest's arm_watchdog when PERSIA_TEST_TPU=1) — nothing
+# here kills a TPU client externally (round-4 wedged-claim lesson).
 LOG=/root/repo/TPU_PROBE.log
 OUT=/root/repo/BENCH_CAPTURE_r05.log
 END=$(( $(date +%s) + 39600 ))  # ~11h
+step() {
+  echo "=== $(date -u +%FT%TZ) $1 ===" >> "$OUT"
+  shift
+  "$@" >> "$OUT" 2>&1
+  echo "=== rc=$? at $(date -u +%FT%TZ) ===" >> "$OUT"
+}
 while [ "$(date +%s)" -lt "$END" ]; do
   for p in 8082 8083 8087 8092 8113; do
     if timeout 2 bash -c "echo > /dev/tcp/127.0.0.1/$p" 2>/dev/null; then
-      echo "$(date -u +%FT%TZ) port $p OPEN — relay up, launching bench capture" >> "$LOG"
+      echo "$(date -u +%FT%TZ) port $p OPEN — relay up, launching chip sequence" >> "$LOG"
       sleep 20  # let the relay finish coming up
       cd /root/repo || exit 1
-      echo "=== $(date -u +%FT%TZ) driver-shaped capture: python bench.py ===" >> "$OUT"
-      python bench.py >> "$OUT" 2>&1
-      echo "=== rc=$? at $(date -u +%FT%TZ) ===" >> "$OUT"
+      # 1. the single unmet deliverable: driver-shaped capture
+      step "driver-shaped capture: python bench.py" python bench.py
+      # 2. compiled flash-attention validation (conftest arms watchdog)
+      # -s: pytest capture would swallow the watchdog's stack dump at
+      # os._exit time — the diagnostic must reach this log
+      step "flash-attention compiled validation" env PERSIA_TEST_TPU=1 \
+        PERSIA_TPU_WATCHDOG_SEC=1200 python -m pytest \
+        tests/test_flash_attention.py -q -s
+      # 3. attn bench: xla-scan vs pallas TFLOP/s
+      step "bench attn" python bench.py --mode attn --max-seconds 1100
+      # 4. re-capture the headline near the end of the window
+      step "re-capture: python bench.py" python bench.py
+      echo "$(date -u +%FT%TZ) chip sequence complete — see BENCH_CAPTURE_r05.log" >> "$LOG"
       exit 0
     fi
   done
