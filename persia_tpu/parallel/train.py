@@ -258,16 +258,17 @@ def init_ef_state(params, mesh) -> jnp.ndarray:
     multi-host mesh (the mode's stated target) gets a global array, not
     a host-local one jit would refuse to reshard."""
     from jax.flatten_util import ravel_pytree
-    from jax.sharding import NamedSharding
-    from jax.sharding import PartitionSpec as P
 
-    from persia_tpu.parallel.mesh import DATA_AXIS
+    from persia_tpu.parallel.mesh import DATA_AXIS, batch_sharding
 
     flat, _ = ravel_pytree(params)
     world = mesh.shape[DATA_AXIS]
-    return jax.device_put(
-        jnp.zeros((world, flat.shape[0]), jnp.float32),
-        NamedSharding(mesh, P("data")))
+    # computed UNDER the sharding (not device_put of a host-local
+    # array, which would raise on a multi-process mesh's
+    # non-addressable devices)
+    return jax.jit(
+        lambda: jnp.zeros((world, flat.shape[0]), jnp.float32),
+        out_shardings=batch_sharding(mesh))()
 
 
 def make_packed_train_step_ddp(

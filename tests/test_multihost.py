@@ -68,7 +68,50 @@ local = jnp.full((n_local, 8), float(pid + 1))
 arr = jax.make_array_from_process_local_data(sharding, local, global_shape)
 mean = jax.jit(lambda x: x.mean(), out_shardings=None)(arr)
 assert abs(float(mean) - 1.5) < 1e-6, float(mean)
-print(f"proc {pid} ok total={total} mean={float(mean)}")
+
+# int8_ef compressed reduction across REAL processes: the ef_state is
+# data-axis-sharded over a mesh spanning both hosts (the mode's stated
+# target), and the two-phase all_to_all/all_gather rides the
+# cross-process backend
+import numpy as np
+import optax
+
+from persia_tpu.models import DNN
+from persia_tpu.parallel.train import (
+    create_train_state,
+    init_ef_state,
+    make_packed_train_step_ddp,
+)
+
+rng = np.random.default_rng(0)  # same on both processes -> same init
+# global batch must divide by the data axis (= all devices, both hosts)
+bs_local, slot_dims = 2 * n_local, [8, 8]
+non_id_l = rng.normal(size=(bs_local, 5)).astype(np.float32)
+emb_l = rng.normal(size=(bs_local, 16)).astype(np.float32)
+label_l = rng.integers(0, 2, size=(bs_local, 1)).astype(np.float32)
+model = DNN()
+opt2 = optax.sgd(0.1)
+state = create_train_state(
+    model, opt2, jax.random.key(0),
+    [jnp.zeros((2 * bs_local, 5))],
+    [jnp.zeros((2 * bs_local, 8)), jnp.zeros((2 * bs_local, 8))])
+step = make_packed_train_step_ddp(model, opt2, slot_dims, mesh,
+                                  grad_reduce_dtype="int8_ef")
+ef = init_ef_state(state.params, mesh)
+assert not ef.is_fully_addressable  # really spans both processes
+
+def shard2(local, width):
+    return jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("data")), local, (2 * bs_local, width))
+
+flat_emb = shard2(jnp.asarray(emb_l, jnp.bfloat16), 16)
+loss = None
+for _ in range(2):  # second step consumes the carried residual
+    state, loss, flat_grads, pred, ef = step(
+        state, [shard2(non_id_l, 5)], flat_emb, shard2(label_l, 1), ef)
+loss = float(loss)
+assert loss == loss, "int8_ef loss is NaN"
+print(f"proc {pid} ok total={total} mean={float(mean)} ef_loss={loss:.4f}")
 """
 
 
