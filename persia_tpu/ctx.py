@@ -381,10 +381,14 @@ class TrainCtx(EmbeddingCtx):
 
         if self.device_cache_capacity:
             if isinstance(batch, LookedUpBatch):
-                raise NotImplementedError(
-                    "device_cache_capacity + DataLoader pipeline: the "
-                    "cache path does its own (cheaper) miss lookups; "
-                    "feed raw PersiaBatch objects")
+                # DataLoader yields raw batches when the active ctx is
+                # cached (dataloader.py), so a pre-looked-up batch here
+                # means an engine was driven against this ctx by hand
+                raise RuntimeError(
+                    "device-cache ctx received a pre-looked-up batch; "
+                    "the cache path does its own (cheaper) miss lookups "
+                    "— feed raw PersiaBatch objects (DataLoader does "
+                    "this automatically for cached ctxs)")
             return self._cached_train_step(batch)
 
         engine = None

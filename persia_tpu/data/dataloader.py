@@ -149,6 +149,19 @@ class DataLoader:
         return self._engine
 
     def __iter__(self) -> Iterator[TrainingBatch]:
+        from persia_tpu.ctx import current_ctx
+
+        ctx = current_ctx()
+        if ctx is not None and getattr(ctx, "device_cache_capacity", 0):
+            # Device-cache path: the worker-lookup prefetch pipeline is
+            # skipped entirely — the cached step does its own (cheaper)
+            # miss imports, and JAX's async dispatch already overlaps
+            # batch i+1's host work (mapper assign + PS miss fetch) with
+            # batch i's device step. The dataset's background producer
+            # still decouples the data source. Ordered iteration is
+            # REQUIRED here: batch order is the cache's LRU order.
+            yield from iter(self.dataset)
+            return
         engine = self._ensure_engine()
         try:
             yield from engine.run(iter(self.dataset), timeout_ms=self.timeout_ms)

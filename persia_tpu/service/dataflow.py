@@ -104,7 +104,15 @@ class DataflowReceiver:
 
 
 class DataflowClient:
-    """Data-loader side: worker ingestion + trainer routing."""
+    """Data-loader side: worker ingestion + trainer routing.
+
+    ``worker=None`` skips the embedding-worker ingestion leg entirely:
+    the loader ships the raw batch (id features included) straight to
+    the trainer. That is the wiring for device-cache / device-mode
+    trainers, which do their own lookups — ingesting into a worker tier
+    would leak forward-buffer entries no trainer ever consumes (their
+    expiry sweep would clean them, but only after holding buffer slots
+    for buffered_data_expired_sec)."""
 
     def __init__(self, worker, trainer_addrs: Sequence[str],
                  max_retries: int = 60):
@@ -115,7 +123,7 @@ class DataflowClient:
 
     def send(self, batch: PersiaBatch):
         ref = None
-        if batch.requires_grad:
+        if batch.requires_grad and self.worker is not None:
             delay = 0.05
             for attempt in range(self.max_retries):
                 try:
