@@ -14,6 +14,9 @@ Modes:
   counterpart to the gather-bound DLRM numbers).
 - ``wire`` / ``worker`` / ``worker-svc`` / ``store``: host-tier
   microbenchmarks (no accelerator).
+- ``infer``: serving-path p50/p99 latency + QPS through a real
+  InferenceServer over sockets, serialized vs micro-batched paths, 1
+  and N concurrent clients, with batch-fill / cache-hit counters.
 
 The reference repo publishes no absolute throughput numbers
 ("published": {} in BASELINE.json); the north star is "matching A100
@@ -471,6 +474,185 @@ def bench_worker_service(batch_size, steps, native_worker, n_ps=2, dim=DIM):
     return steps * batch_size / elapsed
 
 
+def make_infer_requests(num, rows, n_slots, num_dense, vocab=1 << 18,
+                        a=1.2, seed=0):
+    """Pre-serialized label-less PersiaBatch blobs with Zipf-skewed signs
+    (serving traffic is hot-row heavy; the cache's target regime)."""
+    from persia_tpu.data.batch import (
+        IDTypeFeatureWithSingleID,
+        NonIDTypeFeature,
+        PersiaBatch,
+    )
+
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(num):
+        ids = rng.zipf(a, size=(rows, n_slots)) % vocab
+        signs = (ids + np.arange(n_slots, dtype=np.uint64) * vocab
+                 + 1).astype(np.uint64)
+        out.append(PersiaBatch(
+            [IDTypeFeatureWithSingleID(
+                f"slot_{s}", np.ascontiguousarray(signs[:, s]))
+             for s in range(n_slots)],
+            non_id_type_features=[NonIDTypeFeature(
+                rng.normal(size=(rows, num_dense)).astype(np.float32))],
+            requires_grad=False,
+        ).to_bytes())
+    return out
+
+
+def _drive_clients(addr, blobs, n_clients, per_client):
+    """Closed-loop clients (one thread + connection each) against one
+    server; returns (wall_sec, per-request latencies)."""
+    import threading as _threading
+
+    from persia_tpu.serving import InferenceClient
+
+    lat = [[] for _ in range(n_clients)]
+    errors = []
+    start = _threading.Barrier(n_clients + 1)
+
+    def run(ci):
+        try:
+            cl = InferenceClient(addr)
+            cl.predict_bytes(blobs[ci % len(blobs)])  # dial + warm path
+            start.wait()
+        except _threading.BrokenBarrierError:
+            return  # another client failed and aborted the run
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+            start.abort()  # release everyone else immediately
+            return
+        try:
+            for k in range(per_client):
+                blob = blobs[(ci * per_client + k) % len(blobs)]
+                t0 = time.perf_counter()
+                cl.predict_bytes(blob)
+                lat[ci].append(time.perf_counter() - t0)
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [_threading.Thread(target=run, args=(ci,), daemon=True)
+               for ci in range(n_clients)]
+    for t in threads:
+        t.start()
+    try:
+        start.wait()
+    except _threading.BrokenBarrierError:
+        pass  # a client error is about to surface via errors[0]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    if errors:
+        raise errors[0]
+    return wall, [x for per in lat for x in per]
+
+
+def _lat_summary(wall, lats):
+    lats = np.asarray(sorted(lats))
+    return {
+        "qps": round(len(lats) / wall, 1),
+        "p50_ms": round(float(np.percentile(lats, 50)) * 1e3, 3),
+        "p99_ms": round(float(np.percentile(lats, 99)) * 1e3, 3),
+        "n": len(lats),
+    }
+
+
+def bench_infer(batch_size, steps, warmup, smoke=False, n_clients=8):
+    """Serving-path latency/QPS: serialized (one forward per request,
+    the legacy path) vs micro-batched (coalesce + bucket + hot-row
+    cache) through a real InferenceServer over real sockets, with 1 and
+    N closed-loop clients. The embedding worker runs in-process (like
+    the other host-tier modes) so the number measures the serving tier,
+    not subprocess spawn; the client<->server RPC is the real wire."""
+    from persia_tpu.config import EmbeddingSchema, uniform_slots
+    from persia_tpu.data.batch import PersiaBatch
+    from persia_tpu.ps.native import make_holder
+    from persia_tpu.models import DLRM
+    from persia_tpu.serving import (
+        InferenceClient,
+        InferenceServer,
+        build_state_template,
+    )
+    from persia_tpu.worker.worker import EmbeddingWorker
+
+    rows = 32 if smoke else min(batch_size, 128)
+    n_slots = 8 if smoke else NUM_SLOTS
+    per_client = max(steps * 10, 30) if not smoke else 25
+    schema = EmbeddingSchema(slots_config=uniform_slots(
+        [f"slot_{s}" for s in range(n_slots)], dim=DIM))
+    holders = [make_holder(5_000_000, 8) for _ in range(2)]
+    worker = EmbeddingWorker(schema, holders)
+    worker.configure_parameter_servers(
+        "bounded_uniform", {"lower": -0.01, "upper": 0.01}, 1.0, 10.0)
+    worker.register_optimizer({
+        "type": "adagrad", "lr": 0.02, "initial_accumulator_value": 0.1,
+        "g_square_momentum": 1.0, "vectorwise_shared": False,
+    })
+    model = DLRM(embedding_dim=DIM)
+    state = build_state_template(model, schema, NUM_DENSE)
+    blobs = make_infer_requests(64, rows, n_slots, NUM_DENSE)
+    # create the rows once (training lookups admit+init) so eval-mode
+    # predicts serve real values, as a converged production PS would
+    for blob in blobs:
+        worker.lookup_direct(
+            PersiaBatch.from_bytes(blob).id_type_features, training=True)
+
+    detail = {}
+    qps = {}
+    configs = [
+        ("serialized", dict(max_batch_rows=0, cache_rows=0)),
+        ("microbatched", dict(max_batch_rows=rows * n_clients,
+                              max_wait_us=2000,
+                              cache_rows=2_000_000, cache_ttl_sec=60.0)),
+    ]
+    for name, kw in configs:
+        server = InferenceServer(model, state, schema, worker=worker, **kw)
+        server.serve_background()
+        try:
+            # compile every bucket shape deterministically (a b-row
+            # request merges to exactly bucket b), then warm the
+            # coalescing path under real concurrency — first-compile
+            # cost must not pollute the timed p99
+            warm = InferenceClient(server.addr)
+            for b in (server.buckets or (rows,)):
+                warm.predict_bytes(make_infer_requests(
+                    1, b, n_slots, NUM_DENSE, seed=1000 + b)[0])
+            _drive_clients(server.addr, blobs, n_clients,
+                           max(warmup * 2, 4))
+            entry = {}
+            for nc in (1, n_clients):
+                wall, lats = _drive_clients(server.addr, blobs, nc,
+                                            per_client)
+                entry[f"clients_{nc}"] = _lat_summary(wall, lats)
+                qps[(name, nc)] = entry[f"clients_{nc}"]["qps"]
+                log(f"infer[{name}] clients={nc}: "
+                    f"{entry[f'clients_{nc}']['qps']:,} req/s  p50 "
+                    f"{entry[f'clients_{nc}']['p50_ms']} ms  p99 "
+                    f"{entry[f'clients_{nc}']['p99_ms']} ms")
+            stats = InferenceClient(server.addr).stats()
+            entry["server"] = {k: (round(v, 4)
+                                   if isinstance(v, float) else v)
+                               for k, v in stats.items()}
+            detail[name] = entry
+            if name == "microbatched":
+                log(f"infer[{name}]: avg coalesce "
+                    f"{stats['avg_coalesce']:.2f} req/forward, fill "
+                    f"{stats['batch_fill_ratio']:.2f}, cache hit rate "
+                    f"{stats.get('cache_hit_rate', 0.0):.3f}, buckets "
+                    f"compiled {stats['compiled_buckets']}")
+        finally:
+            server.stop()
+    speedup = qps[("microbatched", n_clients)] / max(
+        qps[("serialized", n_clients)], 1e-9)
+    log(f"infer: micro-batched path {speedup:.2f}x serialized QPS at "
+        f"{n_clients} clients (rows/request={rows})")
+    detail["rows_per_request"] = rows
+    detail["speedup_vs_serialized"] = round(speedup, 3)
+    return qps[("microbatched", n_clients)], speedup, detail
+
+
 def _rss_bytes() -> int:
     with open("/proc/self/statm") as f:
         return int(f.read().split()[1]) * os.sysconf("SC_PAGESIZE")
@@ -645,9 +827,31 @@ def _diag_exit(metric, unit, error):
     os._exit(0)
 
 
-def preflight_backend(metric, unit, timeout=90):
-    """Probe the JAX backend with a tiny transfer under a watchdog before
-    committing to the full bench; on a hung claim, report instead of rc=1."""
+# The accelerator is reached through a local relay; these are its ports
+# (the same set tools_tpu_probe.sh watches). Distinguishing "relay down"
+# from "wedged accelerator claim" matters: five rounds of red scoreboard
+# were mislabeled as wedged claims when the ports were simply closed
+# (VERDICT r05 item 1a).
+RELAY_PORTS = (8082, 8083, 8087, 8092, 8113)
+
+
+def _relay_port_open(timeout=1.5):
+    """First open relay port, else None."""
+    import socket
+
+    for p in RELAY_PORTS:
+        try:
+            s = socket.create_connection(("127.0.0.1", p), timeout=timeout)
+            s.close()
+            return p
+        except OSError:
+            continue
+    return None
+
+
+def _attempt_backend_probe(timeout):
+    """One tiny-transfer probe under a thread watchdog. Returns
+    (platform, None) or (None, error_string)."""
     import threading
 
     done = threading.Event()
@@ -667,13 +871,96 @@ def preflight_backend(metric, unit, timeout=90):
 
     t = threading.Thread(target=probe, daemon=True)
     t.start()
-    if not done.wait(timeout) or "error" in info:
-        _diag_exit(metric, unit, info.get(
-            "error",
-            f"backend preflight timed out after {timeout}s "
-            "(wedged accelerator claim)"))
-    log(f"bench: preflight ok, platform={info['platform']}")
-    return info["platform"]
+    if not done.wait(timeout):
+        return None, f"timed out after {int(timeout)}s"
+    if "error" in info:
+        return None, info["error"]
+    return info["platform"], None
+
+
+def _subprocess_backend_probe(timeout) -> bool:
+    """Probe the backend in a FRESH process. After an in-process probe
+    has hung, this process's jax backend state is poisoned (the stuck
+    thread holds the backend-init lock), so only a subprocess can tell
+    whether a relay that just came up actually serves — the in-process
+    retry would block on the same lock and mislabel the recovery."""
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax, numpy as np; "
+             "x = jax.device_put(np.ones((8, 8), np.float32)); "
+             "jax.block_until_ready(x); "
+             "print(jax.devices()[0].platform)"],
+            timeout=timeout, capture_output=True)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def preflight_backend(metric, unit, timeout=90, budget_deadline=None,
+                      local_platform=False):
+    """Probe the JAX backend with a tiny transfer before committing to
+    the full bench; on failure, DIAGNOSE before blaming: probe the relay
+    ports, name the true cause in the JSON error ("relay ports closed"
+    vs "wedged accelerator claim"), and — when the relay is simply down
+    — poll for an up-window until ``budget_deadline`` instead of giving
+    up early: the driver's capture time is not the builder's choice, so
+    the bench fights for every window the watchdog budget allows."""
+    platform, err = _attempt_backend_probe(timeout)
+    if platform is not None:
+        log(f"bench: preflight ok, platform={platform}")
+        return platform
+    if local_platform:
+        # forced-CPU run: the relay is irrelevant, don't blame it
+        _diag_exit(metric, unit,
+                   f"backend preflight failed on forced-local platform: "
+                   f"{err}")
+    port = _relay_port_open()
+    if port is not None:
+        _diag_exit(metric, unit,
+                   f"wedged accelerator claim (relay port {port} is "
+                   f"OPEN but the backend probe {err})")
+    log("bench: relay ports all closed — relay is down, polling for an "
+        "up-window within the watchdog budget")
+    t0 = time.monotonic()
+    last_log = t0
+    while budget_deadline is not None and time.monotonic() < budget_deadline:
+        time.sleep(15)
+        port = _relay_port_open()
+        now = time.monotonic()
+        if port is not None:
+            log(f"bench: relay port {port} opened after "
+                f"{int(now - t0)}s — probing backend in a subprocess "
+                f"(this process's earlier probe may hold jax's "
+                f"backend-init lock)")
+            if _subprocess_backend_probe(timeout):
+                # a fresh process CAN serve; this one may be poisoned by
+                # the hung first probe, so re-exec the bench once with
+                # the same argv — the clean restart completes the
+                # capture instead of mislabeling the recovery
+                if os.environ.get("_PERSIA_BENCH_REEXEC") != "1":
+                    log("bench: relay recovered — re-exec'ing for a "
+                        "clean backend init")
+                    os.environ["_PERSIA_BENCH_REEXEC"] = "1"
+                    os.execv(sys.executable, [sys.executable] + sys.argv)
+                _diag_exit(metric, unit,
+                           f"backend probe failed after relay recovery "
+                           f"AND a clean re-exec (first probe {err}) — "
+                           f"claim-side failure, not the relay")
+            _diag_exit(metric, unit,
+                       f"wedged accelerator claim (relay came up on "
+                       f"port {port} after {int(now - t0)}s but a "
+                       f"fresh-process backend probe still failed; "
+                       f"in-process probe {err})")
+        if now - last_log >= 60:
+            log(f"bench: relay still down after {int(now - t0)}s")
+            last_log = now
+    _diag_exit(metric, unit,
+               f"relay ports closed (relay down; polled for "
+               f"{int(time.monotonic() - t0)}s with no up-window — NOT "
+               f"a wedged accelerator claim)")
 
 
 def main():
@@ -686,8 +973,11 @@ def main():
     # (see BASELINE.md round-4 table for both).
     p.add_argument("--mode",
                    choices=["hybrid", "device", "cached", "attn", "wire",
-                            "worker", "worker-svc", "store", "roofline"],
+                            "worker", "worker-svc", "store", "roofline",
+                            "infer"],
                    default="device")
+    p.add_argument("--clients", type=int, default=8,
+                   help="infer mode: concurrent closed-loop clients")
     p.add_argument("--entries", type=int, default=10_000_000,
                    help="store mode: fill target (== capacity)")
     p.add_argument("--batch-size", type=int, default=4096)
@@ -711,6 +1001,7 @@ def main():
         "cached": ("dlrm_cached_samples_per_sec_chip", "samples/sec"),
         "attn": ("flash_attention_tflops_chip", "TFLOP/sec"),
         "roofline": ("dlrm_hybrid_best_samples_per_sec", "samples/sec"),
+        "infer": ("infer_microbatched_qps", "req/sec"),
     }[args.mode]
 
     # Shared two-tier watchdog (persia_tpu.utils.arm_watchdog — the same
@@ -741,12 +1032,27 @@ def main():
             import jax
 
             jax.config.update("jax_platforms", forced)
-        preflight_backend(metric, unit,
-                          timeout=max(args.max_seconds // 4, 90))
+        # per-attempt probe timeout stays short; the relay-down case now
+        # POLLS for an up-window until ~3/4 of the watchdog budget is
+        # spent rather than burning the whole allowance on one wait
+        preflight_backend(
+            metric, unit,
+            timeout=min(max(args.max_seconds // 8, 90), 300),
+            budget_deadline=time.monotonic() + args.max_seconds * 0.75,
+            local_platform=forced is not None)
 
     log(f"bench: mode={args.mode} bs={args.batch_size} steps={args.steps}")
     t0 = time.perf_counter()
-    if args.mode == "hybrid":
+    extra = {}
+    if args.mode == "infer":
+        value, speedup, detail = bench_infer(
+            args.batch_size, args.steps, args.warmup, smoke=args.smoke,
+            n_clients=max(args.clients, 2))
+        # no published serving baseline; the serialized path at the same
+        # concurrency IS the baseline, so vs_baseline = the speedup
+        vs_baseline = speedup
+        extra["detail"] = detail
+    elif args.mode == "hybrid":
         value = bench_hybrid(args.batch_size, args.steps, args.warmup)
         vs_baseline = value / BASELINE_SAMPLES_PER_SEC
     elif args.mode == "roofline":
@@ -789,6 +1095,7 @@ def main():
         "value": round(value, 3),
         "unit": unit,
         "vs_baseline": round(vs_baseline, 4),
+        **extra,
     })
 
 

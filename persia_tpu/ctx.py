@@ -153,6 +153,13 @@ class EmbeddingCtx(BaseCtx):
         (reference: forward_directly path, ctx.py:433-469)."""
         lookup = self.worker.lookup_direct(batch.id_type_features,
                                            training=False)
+        return self.forward_prepared(batch, lookup)
+
+    def forward_prepared(self, batch: PersiaBatch, lookup: Dict[str, Any]):
+        """Forward from an ALREADY-performed lookup — the serving tier's
+        entry point: its hot-row cache resolves the embeddings itself
+        (serving.py `_lookup_cached`) and only needs the feature
+        preparation + jitted eval apply from the ctx."""
         non_id, emb_inputs, labels = self.prepare_features(batch, lookup)
         pred = self._apply_model(non_id, emb_inputs)
         return pred, labels
@@ -470,6 +477,21 @@ class TrainCtx(EmbeddingCtx):
         than silently degrading."""
         if self._cache_engine is not None:
             return
+        if jax.process_count() > 1:
+            # Single-controller constraint: the engine's sign->slot map,
+            # miss imports and eviction write-backs are host-side state
+            # on THIS process, while a multi-process mesh shards the
+            # cache arrays across hosts — remote rows would be
+            # imported/flushed by a host that cannot address them, and
+            # every process would run a divergent mapper. A multi-host
+            # cache needs per-process row ownership (shard the mapper by
+            # jax.process_index) before this can be lifted.
+            raise NotImplementedError(
+                "device cache is single-controller only: "
+                f"jax.process_count()={jax.process_count()} — the "
+                "sign->slot mapper and miss/evict host transfers live "
+                "on one process; use the uncached hybrid path (or "
+                "device mode) on multi-process meshes")
         from persia_tpu.embedding.optim import Adagrad as ClientAdagrad
 
         opt = self.embedding_optimizer
@@ -610,12 +632,20 @@ class TrainCtx(EmbeddingCtx):
 
 class InferCtx(EmbeddingCtx):
     """Inference: fixed worker addresses, eval-mode lookups
-    (reference ctx.py:1077-1133)."""
+    (reference ctx.py:1077-1133).
+
+    The eval step is built once and jit-caches per input geometry, so
+    the number of XLA compiles equals the number of distinct batch-row
+    shapes the server feeds it. ``eval_batch_rows_seen`` records those
+    shapes — the serving tier's shape-bucketing exists exactly to keep
+    this set equal to its bucket ladder instead of one entry per
+    coalesced request count (see serving.py)."""
 
     def __init__(self, model, state, schema, worker, **kw):
         super().__init__(model=model, schema=schema, worker=worker, **kw)
         self.state = state
         self._eval_step = None
+        self.eval_batch_rows_seen: set = set()
 
     def _apply_model(self, non_id, emb_inputs):
         from persia_tpu.parallel.train import (
@@ -626,6 +656,24 @@ class InferCtx(EmbeddingCtx):
         if self._eval_step is None:
             self._eval_step = make_eval_step(self.model)
         emb_values, emb_indices = split_embedding_inputs(emb_inputs)
+        rows = None
+        if non_id:
+            rows = int(non_id[0].shape[0])
+        else:
+            # embedding-only model: summed slots are (batch, dim); raw
+            # slots carry batch rows in their (batch, sfs) index tensor
+            for v, idx in zip(emb_values, emb_indices):
+                rows = int(v.shape[0] if idx is None else idx.shape[0])
+                break
+        if rows is not None:
+            if rows not in self.eval_batch_rows_seen:
+                # replace-on-write, not .add(): a concurrent stats
+                # reader iterating the old set must never see it mutate
+                # mid-iteration (serving's stats RPC runs on another
+                # thread); a lost concurrent insert re-adds on the next
+                # call with the same shape
+                self.eval_batch_rows_seen = (
+                    self.eval_batch_rows_seen | {rows})
         return self._eval_step(self.state, non_id, emb_values, emb_indices)
 
 

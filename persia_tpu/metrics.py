@@ -71,6 +71,28 @@ class Histogram:
     def timer(self):
         return _Timer(self)
 
+    def percentile(self, q: float) -> float:
+        """Approximate quantile from the bucket counts (linear
+        interpolation inside the winning bucket, Prometheus
+        histogram_quantile-style). The overflow bucket clamps to the
+        top finite bound — serving dashboards prefer a pessimistic
+        finite p99 over +Inf."""
+        with self._lock:
+            counts = list(self._counts)
+            total = self._total
+        if total == 0:
+            return 0.0
+        rank = q / 100.0 * total
+        cum = 0
+        lo = 0.0
+        for b, c in zip(self.buckets, counts):
+            if c and cum + c >= rank:
+                frac = min(max((rank - cum) / c, 0.0), 1.0)
+                return lo + (b - lo) * frac
+            cum += c
+            lo = b
+        return self.buckets[-1]
+
 
 class _Timer:
     def __init__(self, hist: Histogram):

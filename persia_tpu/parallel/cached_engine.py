@@ -17,6 +17,14 @@ state across trainers with no reconciliation. A device MESH is fine —
 the cache is then ONE logical array row-sharded over the mesh by GSPMD
 (see cached_train._row_sharding): still a single program, a single
 writer, and per-device HBM that scales down with the device count.
+
+Single-CONTROLLER only, enforced upstream: ``TrainCtx._ensure_cache``
+raises NotImplementedError when ``jax.process_count() > 1``. On a
+multi-process mesh the cache arrays' rows live on remote hosts this
+process cannot address for miss imports / eviction write-backs, and
+each process would run its own divergent sign->slot mapper. Lifting
+this needs per-process row ownership (mapper sharded by
+``jax.process_index``), not just GSPMD on the arrays.
 """
 
 import queue

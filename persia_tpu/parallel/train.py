@@ -194,6 +194,13 @@ def make_packed_train_step(
     return jax.jit(step, donate_argnums=(0,))
 
 
+# int8_ef quantization bucket: one f32 scale per this many elements.
+# Scale overhead on the wire is 4B/1024B ≈ 0.4%; accuracy gain is large
+# whenever parameter groups differ in gradient magnitude (one outlier
+# layer no longer crushes every other layer's resolution).
+_EF_BUCKET = 1024
+
+
 def _ef_int8_mean(p: jnp.ndarray, axis_name: str, world: int):
     """Two-phase int8-compressed gradient mean over ``axis_name``.
 
@@ -203,14 +210,18 @@ def _ef_int8_mean(p: jnp.ndarray, axis_name: str, world: int):
     bottleneck and 4x fewer bytes buys real throughput. Scheme:
 
     1. quantize the (error-compensated) local gradient to int8 with a
-       per-replica per-bucket scale;
-    2. ``all_to_all`` the int8 shards (each device receives every
-       replica's copy of ITS shard — int8 on the wire), dequantize with
-       the gathered scales, sum in f32;
-    3. requantize the mean shard to int8 and ``all_gather`` it back.
+       per-replica scale per 1024-element bucket (``_EF_BUCKET``); the
+       vector is zero-padded to a multiple of ``world * _EF_BUCKET`` so
+       buckets never straddle shard boundaries;
+    2. ``all_to_all`` the int8 shards AND their bucket scales (each
+       device receives every replica's copy of ITS shard — int8 plus
+       ~0.4% of scale floats on the wire), dequantize per bucket, sum
+       in f32;
+    3. requantize the mean shard per bucket and ``all_gather`` it back
+       with its scales.
 
-    Total wire bytes ~= 2 x size x 1B vs 2 x size x 4B for a ring f32
-    all-reduce. BOTH quantization stages feed back into ``err``
+    Total wire bytes ~= 2 x size x 1B x 1.004 vs 2 x size x 4B for a
+    ring f32 all-reduce. BOTH quantization stages feed back into ``err``
     (error-feedback SGD: the residual re-enters the next step's
     gradient, so the bias of deterministic rounding averages out and
     convergence tracks the uncompressed trajectory): stage 1 locally on
@@ -222,30 +233,38 @@ def _ef_int8_mean(p: jnp.ndarray, axis_name: str, world: int):
     both f32 of p's shape.
     """
     n = p.shape[0]
-    pad = (-n) % world
+    pad = (-n) % (world * _EF_BUCKET)
     flat = jnp.pad(p, (0, pad))
-    scale = jnp.maximum(jnp.max(jnp.abs(flat)) / 127.0, 1e-30)
-    q = jnp.clip(jnp.round(flat / scale), -127, 127).astype(jnp.int8)
-    err1 = flat - q.astype(jnp.float32) * scale
-    chunk = flat.shape[0] // world
+    chunk = flat.shape[0] // world          # shard length, % _EF_BUCKET == 0
+    nb_per = chunk // _EF_BUCKET            # buckets per shard
+    buckets = flat.reshape(world * nb_per, _EF_BUCKET)
+    scale = jnp.maximum(
+        jnp.max(jnp.abs(buckets), axis=1) / 127.0, 1e-30)  # (world*nb_per,)
+    q = jnp.clip(jnp.round(buckets / scale[:, None]),
+                 -127, 127).astype(jnp.int8)
+    err1 = (buckets - q.astype(jnp.float32) * scale[:, None]).reshape(-1)
     qs = q.reshape(world, chunk)
     # rows of recv are indexed by source replica: recv[s] = replica s's
-    # int8 copy of THIS device's shard
+    # int8 copy of THIS device's shard; srecv[s] = that copy's bucket
+    # scales (all_to_all routes both identically)
     recv = jax.lax.all_to_all(qs, axis_name, split_axis=0, concat_axis=0)
-    scales = jax.lax.all_gather(scale, axis_name)          # (world,)
-    shard_mean = jnp.sum(
-        recv.astype(jnp.float32) * scales[:, None], axis=0) / world
-    s2 = jnp.maximum(jnp.max(jnp.abs(shard_mean)) / 127.0, 1e-30)
-    q2 = jnp.clip(jnp.round(shard_mean / s2), -127, 127).astype(jnp.int8)
+    srecv = jax.lax.all_to_all(scale.reshape(world, nb_per), axis_name,
+                               split_axis=0, concat_axis=0)
+    deq = (recv.reshape(world, nb_per, _EF_BUCKET).astype(jnp.float32)
+           * srecv[:, :, None])
+    shard_mean = jnp.sum(deq, axis=0).reshape(chunk) / world
+    mb = shard_mean.reshape(nb_per, _EF_BUCKET)
+    s2 = jnp.maximum(jnp.max(jnp.abs(mb), axis=1) / 127.0, 1e-30)
+    q2 = jnp.clip(jnp.round(mb / s2[:, None]), -127, 127).astype(jnp.int8)
     # stage-2 residual: this device owns shard `me` of the decoded mean
-    err2 = (shard_mean - q2.astype(jnp.float32) * s2) * world
+    err2 = (mb - q2.astype(jnp.float32) * s2[:, None]).reshape(chunk) * world
     me = jax.lax.axis_index(axis_name)
     own = jax.lax.dynamic_slice(err1, (me * chunk,), (chunk,))
     new_err = jax.lax.dynamic_update_slice(
         err1, own + err2, (me * chunk,))[:n]
-    q2g = jax.lax.all_gather(q2, axis_name)                # (world, chunk)
-    s2g = jax.lax.all_gather(s2, axis_name)                # (world,)
-    mean = (q2g.astype(jnp.float32) * s2g[:, None]).reshape(-1)[:n]
+    q2g = jax.lax.all_gather(q2, axis_name)   # (world, nb_per, _EF_BUCKET)
+    s2g = jax.lax.all_gather(s2, axis_name)   # (world, nb_per)
+    mean = (q2g.astype(jnp.float32) * s2g[:, :, None]).reshape(-1)[:n]
     return mean, new_err
 
 

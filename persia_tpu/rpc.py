@@ -147,6 +147,17 @@ class RpcServer:
     at-most-once per server incarnation, NOT exactly-once across
     restarts. Restart recovery instead relies on the worker tiers'
     restore-on-failure + re-arm paths (worker.py / worker_server.cc).
+
+    ``concurrent_streams > 1`` enables per-connection read-ahead: up to
+    that many requests from ONE connection execute concurrently in a
+    shared pool while responses still go out in request order (the wire
+    has no response tags, so order is the correlation). Existing
+    blocking clients never pipeline, so the default of 1 keeps the
+    exact serial per-connection behavior; the inference server opts in
+    so a single ``call_many`` client can keep its micro-batcher full.
+    The handler contract is unchanged — handlers already must tolerate
+    cross-connection concurrency, and read-ahead only adds same-
+    connection concurrency under the same rule.
     """
 
     DEDUP_CACHE_SIZE = 8192
@@ -155,9 +166,13 @@ class RpcServer:
     # DedupCache in native/src/net.h).
     DEDUP_CACHE_BYTES = 256 << 20
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 concurrent_streams: int = 1):
         from collections import OrderedDict
 
+        self._concurrent_streams = max(1, int(concurrent_streams))
+        self._stream_pool = None  # built lazily on the first connection
+        self._stream_pool_lock = threading.Lock()
         self._handlers: Dict[str, Callable[[bytes], bytes]] = {}
         self._dedup: "OrderedDict[bytes, bytes]" = OrderedDict()
         self._dedup_bytes = 0
@@ -209,7 +224,103 @@ class RpcServer:
             threading.Thread(target=self._serve_conn, args=(conn,),
                              daemon=True).start()
 
+    def _handle_one(self, method: str, payload: bytes,
+                    req_id) -> Tuple[list, bytes]:
+        """Run one request to a (envelope, body) response pair."""
+        try:
+            handler = self._handlers.get(method)
+            if handler is None:
+                raise RpcError(f"no such method {method!r}")
+            if req_id is None:
+                result = handler(payload)
+            else:
+                result = self._execute_once(handler, payload, req_id)
+            return ["ok"], result
+        except BaseException as e:
+            return ["err", f"{type(e).__name__}: {e}"], b""
+
+    def _serve_conn_concurrent(self, conn: socket.socket):
+        """Read-ahead variant: this thread reads requests and submits
+        them to the shared pool; a writer thread sends the results back
+        strictly in request order. The bounded pending queue caps
+        read-ahead at ``concurrent_streams`` so a fast sender cannot
+        pile unbounded work into the pool."""
+        import queue as _queue
+        from concurrent.futures import ThreadPoolExecutor
+
+        with self._stream_pool_lock:
+            if not self._running:
+                # stop() already ran: creating a pool here would leak an
+                # executor nothing ever shuts down
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
+            if self._stream_pool is None:
+                self._stream_pool = ThreadPoolExecutor(
+                    max_workers=max(32, self._concurrent_streams),
+                    thread_name_prefix="rpc-stream")
+            pool = self._stream_pool
+        compress = not _is_loopback(conn)
+        pending: "_queue.Queue" = _queue.Queue(
+            maxsize=self._concurrent_streams)
+        conn_dead = threading.Event()
+
+        def writer():
+            while True:
+                item = pending.get()
+                if item is None:
+                    return
+                if item == "shutdown":
+                    try:
+                        _send_msg(conn, ["ok"], b"", False)
+                    except OSError:
+                        pass
+                    self.stop()
+                    if self._shutdown_cb is not None:
+                        self._shutdown_cb()
+                    return
+                env, body = item.result()
+                if conn_dead.is_set():
+                    continue  # drain remaining futures without sending
+                try:
+                    _send_msg(conn, env, body,
+                              compress if env[0] == "ok" else False)
+                except OSError:
+                    conn_dead.set()
+
+        wt = threading.Thread(target=writer, daemon=True,
+                              name="rpc-stream-writer")
+        wt.start()
+        try:
+            with conn:
+                while self._running and not conn_dead.is_set():
+                    try:
+                        env, payload = _recv_msg(conn)
+                    except (ConnectionError, OSError):
+                        break
+                    method = env[0]
+                    if method == "__shutdown__":
+                        pending.put("shutdown")
+                        wt.join()
+                        return
+                    req_id = env[1] if len(env) >= 3 else None
+                    try:
+                        fut = pool.submit(
+                            self._handle_one, method, payload, req_id)
+                    except RuntimeError:
+                        # stop() shut the pool down between recv and
+                        # submit; the server is closing anyway
+                        break
+                    pending.put(fut)
+        finally:
+            pending.put(None)
+
     def _serve_conn(self, conn: socket.socket):
+        if self._concurrent_streams > 1:
+            self._serve_conn_concurrent(conn)
+            return
         compress = not _is_loopback(conn)
         with conn:
             while self._running:
@@ -283,6 +394,10 @@ class RpcServer:
             self._sock.close()
         except OSError:
             pass
+        with self._stream_pool_lock:
+            pool, self._stream_pool = self._stream_pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
 
 
 class RpcClient:
@@ -391,6 +506,60 @@ class RpcClient:
         if env[0] != "ok":
             raise RpcError(f"{self.addr} {method}: {env[1]}")
         return result
+
+    def call_many(self, method: str, payloads: List[bytes],
+                  window: int = 16) -> List[bytes]:
+        """Pipelined calls on this thread's pooled connection: up to
+        ``window`` requests are on the wire before the first response is
+        read (responses arrive in request order — the framing has no
+        tags). Against a ``concurrent_streams`` server the requests
+        execute concurrently; against a default server they execute
+        serially but still save the per-call round-trip gaps.
+
+        The window bounds the responses the server may have to buffer
+        while we are still sending (kernel-socket-buffer deadlock
+        guard). No retry: a connection failure mid-pipeline is raised
+        as-is because the completed prefix is ambiguous — use only for
+        idempotent methods (predict, lookups). An APPLICATION error is
+        raised only after every in-flight response has been read, so
+        the pooled connection stays in sync for subsequent calls."""
+        if not payloads:
+            return []
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = self._local.conn = self._dial()
+        compress = getattr(self._local, "compress", True)
+        results: List[bytes] = []
+        first_err: Optional[str] = None
+        try:
+            i_send = 0
+            while len(results) < len(payloads):
+                while (i_send < len(payloads)
+                       and i_send - len(results) < window):
+                    _send_msg(conn, [method], payloads[i_send], compress)
+                    i_send += 1
+                env, result = _recv_msg(conn)
+                if env[0] != "ok":
+                    # keep draining: an unread tail would desynchronize
+                    # the NEXT call's request/response pairing
+                    if first_err is None:
+                        first_err = f"{self.addr} {method}: {env[1]}"
+                    result = b""
+                results.append(result)
+        except (ConnectionError, OSError):
+            try:
+                conn.close()
+            except OSError:
+                pass
+            with self._conns_lock:
+                me = threading.current_thread()
+                if self._conn_by_thread.get(me) is conn:
+                    del self._conn_by_thread[me]
+            self._local.conn = None
+            raise
+        if first_err is not None:
+            raise RpcError(first_err)
+        return results
 
     def call_msg(self, method: str, **kwargs) -> dict:
         """msgpack-dict convenience call."""

@@ -51,6 +51,7 @@ class WorkerService:
         s.register("forward_batched", self._forward_batched)
         s.register("forward_batch_id", self._forward_batch_id)
         s.register("forward_batched_direct", self._forward_batched_direct)
+        s.register("lookup_signs", self._lookup_signs)
         s.register("update_gradients", self._update_gradients)
         s.register("configure", self._configure)
         s.register("register_optimizer", self._register_optimizer)
@@ -78,6 +79,15 @@ class WorkerService:
         result = self.worker.lookup_direct(feats,
                                            training=meta.get("training", False))
         return ser.pack_lookup_result(result)
+
+    def _lookup_signs(self, payload: bytes) -> bytes:
+        """Dedup'd eval row lookup — the inference hot-row cache's miss
+        fetch (read-only: absent signs zero-fill, nothing is created)."""
+        from persia_tpu.rpc import pack_arrays, unpack_arrays
+
+        meta, (signs,) = unpack_arrays(payload)
+        rows = self.worker.lookup_signs(signs, meta["dim"])
+        return pack_arrays({}, [rows])
 
     def _update_gradients(self, payload: bytes) -> bytes:
         meta, grads = ser.unpack_gradients(payload)
@@ -171,6 +181,18 @@ class RemoteEmbeddingWorker:
                                        {"training": training})
         return ser.unpack_lookup_result(
             self._clients[addr].call("forward_batched_direct", payload))
+
+    def lookup_signs(self, signs: np.ndarray, dim: int) -> np.ndarray:
+        """Serving-tier miss fetch (see EmbeddingWorker.lookup_signs):
+        idempotent read, so no dedup id; round-robin across replicas."""
+        from persia_tpu.rpc import pack_arrays, unpack_arrays
+
+        addr = self._next_addr()
+        resp = self._clients[addr].call(
+            "lookup_signs",
+            pack_arrays({"dim": int(dim)},
+                        [np.ascontiguousarray(signs, np.uint64)]))
+        return unpack_arrays(resp)[1][0]
 
     def lookup_direct_training(self, id_type_features):
         ref = self.put_batch(id_type_features)

@@ -1,4 +1,4 @@
-"""Online inference serving.
+"""Online inference serving: the high-throughput predict path.
 
 The reference serves through TorchServe: a PersiaHandler holds an
 InferCtx, deserializes PersiaBatch bytes, does a direct embedding lookup
@@ -9,29 +9,382 @@ PersiaBatch bytes (the same PTB2 wire clients already produce) and
 returns the model outputs; embedding workers are resolved via
 :mod:`persia_tpu.service_discovery`.
 
+Beyond the reference's one-request-one-forward handler, the server has a
+throughput path built from three pieces (all opt-in, all off by default
+so the legacy serialized behavior is bit-identical):
+
+- **Adaptive micro-batching** (``max_batch_rows > 0``): concurrent
+  ``predict`` requests are coalesced by a dispatcher thread into ONE
+  merged PersiaBatch -> one embedding lookup -> one jitted forward, and
+  the per-request row slices are scattered back. The linger window
+  (``max_wait_us``) is adaptive: it only waits for stragglers when the
+  recent coalescing EWMA says traffic is actually concurrent, so an idle
+  server adds no latency to serial requests.
+- **Shape bucketing**: merged batches are padded with empty rows (no
+  signs, zero dense features) up to a small set of bucket sizes, so the
+  jitted eval step compiles once per bucket instead of retracing for
+  every distinct coalesced request count. Padding rows cannot leak:
+  summed slots pool zero ids to zero vectors, raw slots emit all-padding
+  index rows, and only the first ``rows`` outputs are scattered back.
+- **Cross-request sign dedup + a read-only hot-row TTL cache**
+  (``cache_rows > 0``): the merged batch is preprocessed locally
+  (dedup/hashstack/prefix — the same middleware transforms the worker
+  would run), distinct post-transform signs are served from an in-process
+  LRU, and only the misses travel to the embedding worker through ONE
+  deduplicated ``lookup_signs`` RPC per dim. Entries expire after
+  ``cache_ttl_sec`` so rows hot-loaded by :mod:`persia_tpu.inc_update`
+  on the PS tier become visible within the TTL; the cache is never
+  written by the serving path (read-only), so it cannot diverge from the
+  PS beyond that staleness bound.
+
+Serving counters use the reference's ``*_time_cost_sec`` metric style
+and are exported through :mod:`persia_tpu.metrics` (labeled per server
+port) plus a ``stats`` RPC for scrapers and ``bench.py --mode infer``.
+
 Typical wiring::
 
-    server = InferenceServer(model, state, schema, worker_addrs, port=8501)
+    server = InferenceServer(model, state, schema, worker_addrs,
+                             port=8501, max_batch_rows=256,
+                             cache_rows=1_000_000, cache_ttl_sec=30.0)
     server.serve_forever()
 
     client = InferenceClient("host:8501")
     preds = client.predict(persia_batch)
 """
 
-from typing import Optional, Sequence
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Dict, List, Optional, Sequence, Tuple
 
+import msgpack
 import numpy as np
 
 from persia_tpu.config import EmbeddingSchema
 from persia_tpu.ctx import InferCtx
-from persia_tpu.data.batch import PersiaBatch
+from persia_tpu.data.batch import (
+    MAX_BATCH_SIZE,
+    IDTypeFeature,
+    NonIDTypeFeature,
+    PersiaBatch,
+)
 from persia_tpu.logger import get_default_logger
-from persia_tpu.rpc import RpcClient, RpcServer, pack_arrays, unpack_arrays
+from persia_tpu.rpc import RpcClient, RpcError, RpcServer, pack_arrays, \
+    unpack_arrays
 
 _logger = get_default_logger(__name__)
 
 
+# --- batch merging / padding (the micro-batcher's data plane) ------------
+
+
+def _merge_id_features(feats: Sequence[IDTypeFeature]) -> IDTypeFeature:
+    """CSR concatenation of the same feature across requests."""
+    total_rows = sum(f.batch_size for f in feats)
+    offsets = np.empty(total_rows + 1, np.uint32)
+    offsets[0] = 0
+    signs_parts: List[np.ndarray] = []
+    pos, nnz = 1, 0
+    for f in feats:
+        bs = f.batch_size
+        offsets[pos:pos + bs] = (
+            f.offsets[1:].astype(np.int64) + nnz).astype(np.uint32)
+        pos += bs
+        nnz += int(f.offsets[-1])
+        signs_parts.append(f.signs)
+    signs = (np.concatenate(signs_parts) if nnz
+             else np.empty(0, np.uint64))
+    return IDTypeFeature.from_csr(feats[0].name, offsets, signs)
+
+
+def merge_batches(
+    batches: Sequence[PersiaBatch],
+) -> Tuple[PersiaBatch, List[int]]:
+    """Concatenate per-request batches into one batch + the row sizes
+    needed to scatter predictions back. Labels are dropped (predict
+    never reads them). Callers must pre-group by schema signature —
+    every batch needs the same feature names/order and dense shapes."""
+    sizes = [b.batch_size for b in batches]
+    if len(batches) == 1:
+        return batches[0], sizes
+    id_feats = [
+        _merge_id_features([b.id_type_features[i] for b in batches])
+        for i in range(len(batches[0].id_type_features))
+    ]
+    non_id = [
+        NonIDTypeFeature(
+            np.concatenate([b.non_id_type_features[i].data
+                            for b in batches]),
+            name=batches[0].non_id_type_features[i].name)
+        for i in range(len(batches[0].non_id_type_features))
+    ]
+    return PersiaBatch(id_feats, non_id_type_features=non_id,
+                       requires_grad=False), sizes
+
+
+def pad_batch(batch: PersiaBatch, target_rows: int) -> PersiaBatch:
+    """Pad to ``target_rows`` with EMPTY samples: id features gain rows
+    with zero signs (offsets repeat — nothing new is looked up, so the
+    padding can never touch the PS or pollute the hot-row cache), dense
+    features gain zero rows. Model outputs for padded rows are simply
+    never scattered back."""
+    extra = target_rows - batch.batch_size
+    if extra <= 0:
+        return batch
+    id_feats = []
+    for f in batch.id_type_features:
+        offsets = np.concatenate([
+            f.offsets,
+            np.full(extra, f.offsets[-1], np.uint32),
+        ])
+        id_feats.append(IDTypeFeature.from_csr(f.name, offsets, f.signs))
+    non_id = [
+        NonIDTypeFeature(
+            np.concatenate([
+                x.data,
+                np.zeros((extra,) + x.data.shape[1:], x.data.dtype),
+            ]),
+            name=x.name)
+        for x in batch.non_id_type_features
+    ]
+    return PersiaBatch(id_feats, non_id_type_features=non_id,
+                       requires_grad=False)
+
+
+def _batch_signature(batch: PersiaBatch) -> tuple:
+    """Merge-compatibility key: feature names/order + dense geometry."""
+    return (
+        tuple(f.name for f in batch.id_type_features),
+        tuple((x.name, x.data.dtype.str, x.data.shape[1:])
+              for x in batch.non_id_type_features),
+    )
+
+
+def default_buckets(max_rows: int) -> Tuple[int, ...]:
+    """Power-of-two ladder up to ``max_rows`` (4 sizes): enough shape
+    reuse that the eval step compiles a handful of times, small enough
+    that fill ratio stays high."""
+    out = []
+    b = max_rows
+    for _ in range(4):
+        if b < 1:
+            break
+        out.append(b)
+        b //= 2
+    return tuple(sorted(set(out)))
+
+
+# --- hot-row cache -------------------------------------------------------
+
+
+class HotRowCache:
+    """Read-only LRU of (dim, sign) -> embedding row with a TTL.
+
+    The serving path NEVER writes rows back, so the only consistency
+    question is staleness vs the training tier's incremental updates
+    (:mod:`persia_tpu.inc_update` hot-loads packets into the infer PS):
+    every entry expires ``ttl_sec`` after it was fetched, so a PS-side
+    update becomes visible after at most one TTL. Absent signs cache as
+    zero rows under the same TTL (the PS eval lookup's zero-fill),
+    which also bounds how long a not-yet-admitted sign serves zeros.
+    """
+
+    def __init__(self, capacity: int, ttl_sec: float):
+        self.capacity = int(capacity)
+        self.ttl_sec = float(ttl_sec)
+        self._od: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def __len__(self) -> int:
+        return len(self._od)
+
+    def gather(self, signs: np.ndarray, dim: int,
+               out: np.ndarray) -> np.ndarray:
+        """Fill ``out`` rows for cached signs; return miss positions."""
+        now = time.monotonic()
+        miss: List[int] = []
+        with self._lock:
+            od = self._od
+            for i, s in enumerate(signs):
+                key = (dim, int(s))
+                item = od.get(key)
+                if item is None or item[1] < now:
+                    miss.append(i)
+                else:
+                    out[i] = item[0]
+                    od.move_to_end(key)
+            self.hits += len(signs) - len(miss)
+            self.misses += len(miss)
+        return np.asarray(miss, np.int64)
+
+    def put(self, signs: np.ndarray, dim: int, rows: np.ndarray):
+        if self.capacity <= 0:
+            return
+        expires = time.monotonic() + self.ttl_sec
+        with self._lock:
+            od = self._od
+            for s, row in zip(signs, rows):
+                key = (dim, int(s))
+                od[key] = (np.array(row, np.float32), expires)
+                od.move_to_end(key)
+            while len(od) > self.capacity:
+                od.popitem(last=False)
+
+    @property
+    def hit_rate(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
+
+# --- micro-batcher -------------------------------------------------------
+
+
+class _PendingRequest:
+    __slots__ = ("batch", "done", "pred", "error", "t_enqueue")
+
+    def __init__(self, batch: PersiaBatch):
+        self.batch = batch
+        self.done = threading.Event()
+        self.pred: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
+        self.t_enqueue = time.perf_counter()
+
+
+class _MicroBatcher:
+    """Coalesce concurrent predict requests into merged forwards.
+
+    RPC handler threads park in :meth:`submit`; one dispatcher thread
+    drains the queue, merges schema-compatible requests up to
+    ``max_rows``, and runs the server's merged forward. The linger is
+    adaptive: when the recent coalescing EWMA is ~1 (serial traffic)
+    the dispatcher never sleeps, so an unloaded server serves at
+    serialized-path latency; under concurrency the execution time of
+    the previous merged forward naturally accumulates the next batch,
+    and the EWMA unlocks a bounded ``max_wait`` linger for stragglers.
+    """
+
+    def __init__(self, run_merged, max_rows: int, max_wait_s: float):
+        self._run_merged = run_merged
+        self.max_rows = int(max_rows)
+        self.max_wait_s = float(max_wait_s)
+        self._queue: "deque[_PendingRequest]" = deque()
+        self._cond = threading.Condition()
+        self._running = True
+        self._ewma = 1.0
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="infer-microbatcher")
+        self._thread.start()
+
+    def submit(self, batch: PersiaBatch,
+               timeout: float = 120.0) -> np.ndarray:
+        req = _PendingRequest(batch)
+        with self._cond:
+            if not self._running:
+                raise RpcError("inference server is shutting down")
+            self._queue.append(req)
+            self._cond.notify_all()
+        if not req.done.wait(timeout):
+            # shed the abandoned request: the client already got an
+            # error, so leaving it queued would make an overloaded
+            # dispatcher do extra lookup+forward work nobody reads
+            with self._cond:
+                try:
+                    self._queue.remove(req)
+                except ValueError:
+                    pass  # already dispatched (in flight)
+            raise RpcError("micro-batch dispatch timed out")
+        if req.error is not None:
+            raise req.error
+        return req.pred
+
+    def _pending_rows(self) -> int:
+        return sum(r.batch.batch_size for r in self._queue)
+
+    def _collect(self) -> List[_PendingRequest]:
+        with self._cond:
+            while self._running and not self._queue:
+                self._cond.wait(0.25)
+            if not self._queue:
+                return []
+            if self.max_wait_s > 0 and self._ewma > 1.05:
+                deadline = time.monotonic() + self.max_wait_s
+                while self._pending_rows() < self.max_rows:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(remaining)
+            if not self._queue:
+                # the linger released the lock; a timed-out submit()
+                # may have shed the last pending request meanwhile
+                return []
+            sig0 = _batch_signature(self._queue[0].batch)
+            reqs: List[_PendingRequest] = []
+            rows = 0
+            while self._queue:
+                r = self._queue[0]
+                rb = r.batch.batch_size
+                if reqs and (rows + rb > min(self.max_rows, MAX_BATCH_SIZE)
+                             or _batch_signature(r.batch) != sig0):
+                    break  # stays queued for the next dispatch
+                reqs.append(self._queue.popleft())
+                rows += rb
+            self._ewma = 0.8 * self._ewma + 0.2 * len(reqs)
+        return reqs
+
+    def _loop(self):
+        # the dispatcher must never die: a dead dispatcher bricks the
+        # server (every predict parks in submit() until timeout), so
+        # even a _collect bug only costs this iteration
+        while True:
+            try:
+                reqs = self._collect()
+            except Exception:
+                _logger.exception("micro-batcher collect failed")
+                time.sleep(0.05)  # never spin on a persistent bug
+                reqs = []
+            if not reqs:
+                if not self._running:
+                    return
+                continue
+            try:
+                self._run_merged(reqs)
+            except BaseException as e:  # fail whatever hasn't completed
+                for r in reqs:
+                    if not r.done.is_set():
+                        r.error = e
+                        r.done.set()
+
+    def close(self):
+        with self._cond:
+            self._running = False
+            self._cond.notify_all()
+        self._thread.join(timeout=5.0)
+        # fail anything still parked (submit after close raises upfront)
+        with self._cond:
+            while self._queue:
+                r = self._queue.popleft()
+                r.error = RpcError("inference server closed")
+                r.done.set()
+
+
+# --- the server ----------------------------------------------------------
+
+_SERVER_SEQ = 0
+_SERVER_SEQ_LOCK = threading.Lock()
+
+
 class InferenceServer:
+    """RPC predict server over an InferCtx.
+
+    ``max_batch_rows=0`` (default) keeps the legacy serialized
+    one-request-one-forward path; ``cache_rows=0`` (default) keeps the
+    worker RPC on every lookup. Either can be enabled independently.
+    ``worker=`` injects an in-process worker object (tests, single-node
+    serving, bench) instead of dialing ``worker_addrs``.
+    """
+
     def __init__(
         self,
         model,
@@ -40,34 +393,225 @@ class InferenceServer:
         worker_addrs: Optional[Sequence[str]] = None,
         host: str = "127.0.0.1",
         port: int = 0,
+        worker=None,
+        max_batch_rows: int = 0,
+        max_wait_us: int = 2000,
+        buckets: Optional[Sequence[int]] = None,
+        cache_rows: int = 0,
+        cache_ttl_sec: float = 30.0,
+        concurrent_streams: Optional[int] = None,
     ):
-        from persia_tpu.service.worker_service import RemoteEmbeddingWorker
-        from persia_tpu.service_discovery import get_embedding_worker_services
+        # Opt-in contract: a default (serialized) server keeps the
+        # legacy thread-per-connection RPC loop with NO shared-pool cap
+        # on in-flight predicts; read-ahead streams only make sense when
+        # the micro-batcher exists to coalesce them. Note the stream
+        # pool also bounds how many requests can be parked in the
+        # batcher at once (rpc.py sizes it at max(32, streams)), so
+        # extreme coalescing targets should raise this too.
+        if concurrent_streams is None:
+            concurrent_streams = 32 if max_batch_rows > 0 else 1
+        if worker is None:
+            from persia_tpu.service.worker_service import \
+                RemoteEmbeddingWorker
+            from persia_tpu.service_discovery import \
+                get_embedding_worker_services
 
-        addrs = list(worker_addrs) if worker_addrs else \
-            get_embedding_worker_services()
-        worker = RemoteEmbeddingWorker(addrs)
-        worker.schema = schema
+            addrs = list(worker_addrs) if worker_addrs else \
+                get_embedding_worker_services()
+            worker = RemoteEmbeddingWorker(addrs)
+            worker.schema = schema
+        self.worker = worker
+        self.schema = schema
         self.ctx = InferCtx(model, state, schema, worker)
-        self.server = RpcServer(host, port)
+        # concurrent_streams lets ONE pipelined client connection keep
+        # many predicts in flight (rpc.py read-ahead) — without it the
+        # micro-batcher could only coalesce across connections
+        self.server = RpcServer(host, port,
+                                concurrent_streams=concurrent_streams)
         self.server.register("predict", self._predict)
         self.server.register("health", lambda p: b"ok")
+        self.server.register("stats", self._stats)
+
+        self.max_batch_rows = min(int(max_batch_rows), MAX_BATCH_SIZE)
+        if self.max_batch_rows > 0:
+            self.buckets = tuple(sorted(
+                buckets if buckets else default_buckets(self.max_batch_rows)))
+            self._batcher: Optional[_MicroBatcher] = _MicroBatcher(
+                self._run_merged, self.max_batch_rows, max_wait_us / 1e6)
+        else:
+            self.buckets = ()
+            self._batcher = None
+        self.cache = (HotRowCache(cache_rows, cache_ttl_sec)
+                      if cache_rows > 0 else None)
+
+        from persia_tpu.metrics import default_registry
+
+        # the run label disambiguates a server RESTARTED on the same
+        # port in the same process (fixed --port, tests): the registry
+        # is process-wide and keyed by (name, labels), so without it a
+        # fresh server would inherit — and blend into — the dead
+        # server's counters
+        global _SERVER_SEQ
+        with _SERVER_SEQ_LOCK:
+            _SERVER_SEQ += 1
+            seq = _SERVER_SEQ
+        labels = {"server": self.addr.rsplit(":", 1)[1], "run": str(seq)}
+        reg = default_registry()
+        self._m_requests = reg.counter("inference_requests_total", labels)
+        self._m_batches = reg.counter("inference_batches_total", labels)
+        self._m_rows = reg.counter("inference_rows_total", labels)
+        self._m_padded = reg.counter("inference_padded_rows_total", labels)
+        self._t_e2e = reg.histogram("inference_request_time_cost_sec",
+                                    labels)
+        self._t_queue = reg.histogram(
+            "inference_queue_wait_time_cost_sec", labels)
+        self._t_lookup = reg.histogram("inference_lookup_time_cost_sec",
+                                       labels)
+        self._t_forward = reg.histogram(
+            "inference_forward_time_cost_sec", labels)
 
     @property
     def addr(self) -> str:
         return self.server.addr
 
+    # --- predict paths ---------------------------------------------------
+
     def _predict(self, payload: bytes) -> bytes:
+        t0 = time.perf_counter()
         batch = PersiaBatch.from_bytes(payload)
-        pred, _labels = self.ctx.forward(batch)
-        return pack_arrays({}, [np.asarray(pred)])
+        self._m_requests.inc()
+        if self._batcher is not None:
+            pred = self._batcher.submit(batch)
+        else:
+            pred = self._forward(batch)
+            self._m_batches.inc()
+            self._m_rows.inc(batch.batch_size)
+        self._t_e2e.observe(time.perf_counter() - t0)
+        return pack_arrays({}, [np.ascontiguousarray(pred)])
+
+    def _bucket_for(self, rows: int) -> int:
+        for b in self.buckets:
+            if rows <= b:
+                return b
+        return rows  # oversized request: exact shape, no padding
+
+    def _run_merged(self, reqs: List[_PendingRequest]):
+        """Dispatcher entry: merge -> pad to bucket -> one lookup + one
+        jitted forward -> scatter per-request row slices."""
+        now = time.perf_counter()
+        for r in reqs:
+            self._t_queue.observe(now - r.t_enqueue)
+        merged, sizes = merge_batches([r.batch for r in reqs])
+        rows = merged.batch_size
+        bucket = self._bucket_for(rows)
+        padded = pad_batch(merged, bucket)
+        pred = self._forward(padded)
+        self._m_batches.inc()
+        self._m_rows.inc(rows)
+        self._m_padded.inc(bucket - rows)
+        off = 0
+        for r, s in zip(reqs, sizes):
+            r.pred = pred[off:off + s]
+            off += s
+            r.done.set()
+
+    def _forward(self, batch: PersiaBatch) -> np.ndarray:
+        with self._t_lookup.timer():
+            lookup = self._lookup(batch.id_type_features)
+        with self._t_forward.timer():
+            pred, _labels = self.ctx.forward_prepared(batch, lookup)
+            return np.asarray(pred)
+
+    # --- cached lookup path ----------------------------------------------
+
+    def _lookup(self, id_type_features: List[IDTypeFeature]):
+        if self.cache is None:
+            return self.worker.lookup_direct(id_type_features,
+                                             training=False)
+        return self._lookup_cached(id_type_features)
+
+    def _lookup_cached(self, id_type_features: List[IDTypeFeature]):
+        """Preprocess locally (the same dedup/hashstack/prefix transforms
+        the worker runs, so cache keys are post-transform signs — the
+        exact PS keyspace inc_update writes), serve distinct signs from
+        the LRU, and fetch only the misses through ONE deduplicated
+        ``lookup_signs`` RPC per dim. Because requests were merged
+        before this runs, the dedup is cross-request for free."""
+        from persia_tpu.worker import middleware as mw
+
+        feats = mw.preprocess_batch(id_type_features, self.schema)
+        mats: List[np.ndarray] = []
+        misses: Dict[int, list] = {}
+        for f in feats:
+            dim = self.schema.get_slot(f.name).dim
+            mat = np.zeros((f.num_distinct, dim), np.float32)
+            miss_pos = self.cache.gather(f.distinct_signs, dim, mat)
+            if len(miss_pos):
+                misses.setdefault(dim, []).append(
+                    (mat, miss_pos, f.distinct_signs[miss_pos]))
+            mats.append(mat)
+        for dim, parts in misses.items():
+            all_signs = np.concatenate([p[2] for p in parts])
+            uniq, inverse = np.unique(all_signs, return_inverse=True)
+            rows = self.worker.lookup_signs(uniq, dim)
+            self.cache.put(uniq, dim, rows)
+            pos = 0
+            for mat, miss_pos, s in parts:
+                mat[miss_pos] = rows[inverse[pos:pos + len(s)]]
+                pos += len(s)
+        out = {}
+        for f, mat in zip(feats, mats):
+            out[f.name] = mw.postprocess_feature(
+                f, self.schema.get_slot(f.name), mat)
+        return out
+
+    # --- observability ---------------------------------------------------
+
+    def _stats(self, payload: bytes) -> bytes:
+        req = self._m_requests.value
+        bat = self._m_batches.value
+        rows = self._m_rows.value
+        padded = self._m_padded.value
+        d = {
+            "requests": req,
+            "batches": bat,
+            "rows": rows,
+            "padded_rows": padded,
+            "avg_coalesce": req / bat if bat else 0.0,
+            "batch_fill_ratio": rows / (rows + padded) if rows else 0.0,
+            "queue_wait_p50_ms": self._t_queue.percentile(50) * 1e3,
+            "queue_wait_p99_ms": self._t_queue.percentile(99) * 1e3,
+            "request_p50_ms": self._t_e2e.percentile(50) * 1e3,
+            "request_p99_ms": self._t_e2e.percentile(99) * 1e3,
+            "compiled_buckets": sorted(self.ctx.eval_batch_rows_seen),
+            "buckets": list(self.buckets),
+        }
+        if self.cache is not None:
+            d.update(cache_hit_rate=self.cache.hit_rate,
+                     cache_hits=self.cache.hits,
+                     cache_misses=self.cache.misses,
+                     cache_rows_resident=len(self.cache))
+        return msgpack.packb(d)
+
+    # --- lifecycle -------------------------------------------------------
 
     def serve_background(self):
         self.server.serve_background()
 
     def serve_forever(self):
-        _logger.info("inference server listening on %s", self.addr)
+        _logger.info(
+            "inference server listening on %s (max_batch_rows=%d "
+            "buckets=%s cache_rows=%s)", self.addr, self.max_batch_rows,
+            list(self.buckets),
+            # `is not None`, not truthiness: an EMPTY cache is falsy
+            # through __len__
+            self.cache.capacity if self.cache is not None else 0)
         self.server.serve_forever()
+
+    def stop(self):
+        self.server.stop()
+        if self._batcher is not None:
+            self._batcher.close()
 
 
 class InferenceClient:
@@ -75,9 +619,23 @@ class InferenceClient:
         self.client = RpcClient(addr)
 
     def predict(self, batch: PersiaBatch) -> np.ndarray:
-        _, (pred,) = unpack_arrays(
-            self.client.call("predict", batch.to_bytes()))
+        return self.predict_bytes(batch.to_bytes())
+
+    def predict_bytes(self, payload: bytes) -> np.ndarray:
+        _, (pred,) = unpack_arrays(self.client.call("predict", payload))
         return pred
+
+    def predict_many(self, batches: Sequence) -> List[np.ndarray]:
+        """Pipelined predicts on one connection (rpc.py ``call_many``):
+        with the server's read-ahead streams, a single client can keep
+        the micro-batcher full without threads."""
+        payloads = [b if isinstance(b, (bytes, bytearray)) else b.to_bytes()
+                    for b in batches]
+        return [unpack_arrays(r)[1][0]
+                for r in self.client.call_many("predict", payloads)]
+
+    def stats(self) -> dict:
+        return msgpack.unpackb(self.client.call("stats"), raw=False)
 
     def healthy(self) -> bool:
         try:
@@ -140,6 +698,17 @@ def main(argv=None):
     """Serve a trained model (reference: the torchserve handler wiring,
     examples/src/adult-income/launch_ts.sh + serve_handler.py)."""
     import argparse
+    import os
+
+    # same local-verification escape hatch as bench.py / nn_worker.py:
+    # the axon platform plugin re-pins jax.config via sitecustomize, so
+    # the plain env var alone is silently ignored
+    forced = os.environ.get("PERSIA_FORCE_JAX_PLATFORM") or (
+        "cpu" if os.environ.get("JAX_PLATFORMS") == "cpu" else None)
+    if forced:
+        import jax
+
+        jax.config.update("jax_platforms", forced)
 
     from persia_tpu.models import DCNv2, DLRM, DNN, DeepFM, WideAndDeep
 
@@ -156,6 +725,15 @@ def main(argv=None):
     p.add_argument("--port", type=int, default=8501)
     p.add_argument("--worker-addrs", default=None,
                    help="comma-separated; default EMBEDDING_WORKER_SERVICE")
+    p.add_argument("--max-batch-rows", type=int, default=0,
+                   help="enable micro-batching up to this many coalesced "
+                        "rows (0 = serialized legacy path)")
+    p.add_argument("--max-wait-us", type=int, default=2000,
+                   help="adaptive linger window for straggler coalescing")
+    p.add_argument("--cache-rows", type=int, default=0,
+                   help="hot-row LRU capacity (0 = no cache)")
+    p.add_argument("--cache-ttl-sec", type=float, default=30.0,
+                   help="hot-row TTL; bounds staleness vs inc_update")
     args = p.parse_args(argv)
 
     schema = EmbeddingSchema.load(args.embedding_config)
@@ -167,7 +745,11 @@ def main(argv=None):
         addrs = [a.strip() for a in args.worker_addrs.split(",")
                  if a.strip()]
     server = InferenceServer(model, state, schema, worker_addrs=addrs,
-                             host=args.host, port=args.port)
+                             host=args.host, port=args.port,
+                             max_batch_rows=args.max_batch_rows,
+                             max_wait_us=args.max_wait_us,
+                             cache_rows=args.cache_rows,
+                             cache_ttl_sec=args.cache_ttl_sec)
     server.serve_forever()
 
 

@@ -84,7 +84,10 @@ class StageTimer:
     Metric names follow the reference's gauge names
     (lookup_preprocess_time_cost_sec, lookup_rpc_time_cost_sec,
     lookup_postprocess_time_cost_sec, forward_client_time_cost_sec,
-    backward_client_time_cost_sec, ...).
+    backward_client_time_cost_sec, ...; the serving tier adds
+    inference_request_time_cost_sec, inference_queue_wait_time_cost_sec,
+    inference_lookup_time_cost_sec, inference_forward_time_cost_sec —
+    see serving.py).
     """
 
     def __init__(self, name: str):

@@ -401,6 +401,41 @@ class EmbeddingWorker:
         _logger.warning("refreshed PS client list after connection failure")
         self._rearm_unready_clients()
 
+    # --- raw row access (inference hot-row cache miss path) --------------
+
+    def lookup_signs(self, signs: np.ndarray, dim: int) -> np.ndarray:
+        """Eval-mode row lookup for ALREADY-PREPROCESSED distinct signs
+        (the serving tier runs dedup/hashstack/prefix itself and sends
+        only its cache misses here — one deduplicated call instead of a
+        full per-request lookup fan-out). Shard-routed by the same
+        farmhash split as every other lookup; absent signs zero-fill
+        (PS eval semantics) and are NEVER created — the serving path is
+        read-only."""
+        from persia_tpu.hashing import sign_to_shard
+
+        signs = np.ascontiguousarray(signs, dtype=np.uint64)
+        out = np.zeros((len(signs), dim), np.float32)
+        if len(signs) == 0:
+            return out
+        shards = sign_to_shard(signs, self.replica_size)
+        groups = [np.nonzero(shards == r)[0] for r in np.unique(shards)]
+        replicas = [int(shards[sel[0]]) for sel in groups]
+
+        def fetch_all():
+            if self._fanout is None or len(groups) <= 1:
+                return [self.ps_clients[r].lookup(signs[sel], dim, False)
+                        for r, sel in zip(replicas, groups)]
+            return list(self._fanout.map(
+                lambda rs: self.ps_clients[rs[0]].lookup(
+                    signs[rs[1]], dim, False),
+                zip(replicas, groups)))
+
+        with self._t_rpc.timer():
+            results = self._with_ps_retry(fetch_all)
+        for sel, rows in zip(groups, results):
+            out[sel] = rows
+        return out
+
     # --- checkpoint fan-out ----------------------------------------------
 
     # --- raw row access (device-cache miss/write-back path) --------------

@@ -30,10 +30,28 @@ else:
     # watchdog as bench.py: a hung remote compile must self-exit (claim
     # stays releasable), never be killed externally (round-4 lesson —
     # an external kill mid-compile wedged the accelerator claim).
+    #
+    # The watchdog is RE-ARMED before every test rather than armed once
+    # for the session: a single budget sized for one hung compile
+    # (default 1500s) used to hard-kill healthy suite runs that simply
+    # had many tests (>25 min total). Per-test re-arming keeps the
+    # guarantee that matters — no single hung test can wedge the claim
+    # for more than the budget — while letting an N-test suite run
+    # N x budget in the healthy case.
     from persia_tpu.utils import arm_watchdog
 
-    arm_watchdog(int(os.environ.get("PERSIA_TPU_WATCHDOG_SEC", "1500")),
-                 label="pytest[PERSIA_TEST_TPU]")
+    _WD_SEC = int(os.environ.get("PERSIA_TPU_WATCHDOG_SEC", "1500"))
+    # collection itself (imports may touch the backend) gets one budget
+    _wd_cancel = arm_watchdog(_WD_SEC,
+                              label="pytest[PERSIA_TEST_TPU] collection")
+
+    @pytest.fixture(autouse=True)
+    def _rearm_tpu_watchdog(request):
+        global _wd_cancel
+        _wd_cancel()
+        _wd_cancel = arm_watchdog(
+            _WD_SEC, label=f"pytest[PERSIA_TEST_TPU] {request.node.name}")
+        yield
 
 
 @pytest.fixture(scope="session")

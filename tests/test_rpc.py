@@ -124,6 +124,103 @@ def test_rpc_request_dedup_at_most_once():
         server.stop()
 
 
+def test_call_many_pipelined_in_order():
+    """Windowed pipelining on one connection: responses come back in
+    request order against both a default (serial) server and a
+    read-ahead (concurrent_streams) server."""
+    for streams in (1, 8):
+        srv = RpcServer(concurrent_streams=streams)
+        srv.register("echo", lambda p: p)
+        srv.serve_background()
+        try:
+            c = RpcClient(srv.addr)
+            payloads = [b"m%03d" % i for i in range(40)]
+            assert c.call_many("echo", payloads, window=8) == payloads
+            # plain calls still work on the same connection afterwards
+            assert c.call("echo", b"tail") == b"tail"
+        finally:
+            srv.stop()
+
+
+def test_concurrent_streams_ordering_under_skew():
+    """Read-ahead executes requests concurrently, but responses MUST
+    still arrive in request order (the wire has no response tags): a
+    slow first request cannot be overtaken by fast later ones."""
+    srv = RpcServer(concurrent_streams=8)
+    started = threading.Event()
+
+    def handler(p):
+        if p == b"slow":
+            started.set()
+            time.sleep(0.3)
+        return p
+    srv.register("work", handler)
+    srv.serve_background()
+    try:
+        c = RpcClient(srv.addr)
+        payloads = [b"slow"] + [b"f%d" % i for i in range(10)]
+        t0 = time.perf_counter()
+        out = c.call_many("work", payloads, window=16)
+        elapsed = time.perf_counter() - t0
+        assert out == payloads  # in-order despite skewed latencies
+        # the fast requests ran DURING the slow one (read-ahead), so the
+        # whole pipeline costs ~one slow call, not slow + 10 x fast
+        assert started.is_set() and elapsed < 1.0
+    finally:
+        srv.stop()
+
+
+def test_call_many_app_error_keeps_connection_in_sync():
+    """An application error mid-pipeline must drain the remaining
+    responses before raising — an unread tail would pair the NEXT
+    call's request with a stale response."""
+    srv = RpcServer(concurrent_streams=4)
+
+    def maybe(p):
+        if p == b"bad":
+            raise ValueError("poisoned")
+        return p
+    srv.register("maybe", maybe)
+    srv.serve_background()
+    try:
+        c = RpcClient(srv.addr)
+        with pytest.raises(RpcError, match="poisoned"):
+            c.call_many("maybe", [b"a", b"bad", b"c", b"d"], window=4)
+        # the pooled connection must still be usable and in sync
+        assert c.call("maybe", b"after") == b"after"
+        assert c.call_many("maybe", [b"x", b"y"]) == [b"x", b"y"]
+    finally:
+        srv.stop()
+
+
+def test_concurrent_streams_error_and_dedup_still_work():
+    """err envelopes and at-most-once dedup survive the read-ahead
+    path (they share _handle_one with the serial loop)."""
+    import socket
+
+    from persia_tpu.rpc import _recv_msg, _send_msg
+
+    calls = []
+    srv = RpcServer(concurrent_streams=4)
+    srv.register("bump", lambda p: (calls.append(1), b"%d" % len(calls))[1])
+    srv.register("boom", lambda p: (_ for _ in ()).throw(ValueError("no")))
+    srv.serve_background()
+    try:
+        c = RpcClient(srv.addr)
+        with pytest.raises(RpcError, match="no"):
+            c.call("boom")
+        host, port = srv.addr.rsplit(":", 1)
+        with socket.create_connection((host, int(port))) as conn:
+            rid = b"z" * 12
+            _send_msg(conn, ["bump", rid], b"", False)
+            _send_msg(conn, ["bump", rid], b"", False)
+            _, r1 = _recv_msg(conn)
+            _, r2 = _recv_msg(conn)
+            assert r1 == r2 == b"1" and len(calls) == 1
+    finally:
+        srv.stop()
+
+
 def test_dataflow_receiver_waits_for_all_senders_eos():
     """With N data-loader replicas, the stream must end only after all N
     report end-of-stream (a fast loader's EOS must not cut off slower
