@@ -185,7 +185,11 @@ def bench_roofline(batch_size, steps, warmup):
     # (worst-case) throughput — that is what t_worker and the serialized
     # prediction below use; the steady-state hit variant (the converged
     # production regime) is only logged alongside for the roofline table
-    worker_sps = bench_worker(batch_size, max(steps // 2, 5))
+    # rpc_paths=False: the roofline model only needs the in-process
+    # worker-cycle ceiling — the PS-subprocess A/B compare would burn
+    # minutes of the roofline's watchdog budget for an unused number
+    worker_sps = bench_worker(batch_size, max(steps // 2, 5),
+                              rpc_paths=False)
     t_worker = batch_size / worker_sps  # all-miss s/batch
     predicted_1core = batch_size / (t_step + t_worker)
 
@@ -368,7 +372,309 @@ def bench_device(batch_size, steps, warmup, vocab=1 << 20):
     return steps * batch_size / elapsed
 
 
-def bench_worker(batch_size, steps, n_ps=2, dim=DIM):
+_RPC_ECHO_SERVER = r"""
+import sys
+import time
+import numpy as np
+from persia_tpu.rpc import (RpcServer, pack_arrays, pack_arrays_sg,
+                            unpack_arrays)
+rows, dim, streams = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+resp = np.random.default_rng(1).normal(size=(rows, dim)).astype(np.float32)
+def reply(p):
+    meta, (s,) = unpack_arrays(p)
+    if meta.get("sleep_ms"):  # a slow internal shard (GIL-free wait,
+        time.sleep(meta["sleep_ms"] / 1e3)  # like native store work)
+    return resp[:len(s)]
+srv = RpcServer(concurrent_streams=streams)
+srv.register("lookup_legacy", lambda p: pack_arrays({}, [reply(p)]))
+srv.register("lookup_sg", lambda p: pack_arrays_sg({}, [reply(p)]))
+print(srv.addr, flush=True)
+srv.serve_forever()
+"""
+
+
+def bench_rpc(batch_size, steps, smoke=False):
+    """CPU-tier RPC microbench: msgs/s + MB/s against a REAL server
+    process (the PS topology — in-process loopback would share one GIL
+    and measure nothing), on a lookup-shaped exchange (request = signs,
+    response = (n, dim) f32 rows):
+
+    - ``serialized``: untagged in-order wire against a serial
+      per-connection server, ``pack_arrays`` copies on both sides — the
+      pre-PR-2 plane.
+    - ``multiplexed``: tagged frames, windowed out-of-order completion
+      (``call_many`` against a dispatch-pool server), legacy framing.
+    - ``zero-copy``: multiplexed + scatter-gather framing
+      (``pack_arrays_sg`` -> sendmsg; recv_into -> array views).
+    """
+    import subprocess
+
+    from persia_tpu.rpc import (
+        RpcClient,
+        pack_arrays,
+        pack_arrays_sg,
+        unpack_arrays,
+    )
+
+    n_msgs = 64 if smoke else max(steps * 16, 480)
+    window = 32
+    rng = np.random.default_rng(0)
+    results = {}
+
+    def spawn_server(rows, streams):
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _RPC_ECHO_SERVER, str(rows), str(DIM),
+             str(streams)],
+            stdout=subprocess.PIPE, text=True, cwd=os.path.dirname(
+                os.path.abspath(__file__)))
+        addr = proc.stdout.readline().strip()
+        if not addr:
+            raise RuntimeError("rpc echo server failed to start")
+        return proc, addr
+
+    def measure(name, rows, streams, tags, method, payloads, pipelined,
+                entry, per_msg_bytes):
+        proc, addr = spawn_server(rows, streams)
+        client = RpcClient(addr, enable_tags=tags)
+        try:
+            def run():
+                if pipelined:
+                    for r in client.call_many(method, payloads,
+                                              window=window):
+                        unpack_arrays(r)
+                else:
+                    for p in payloads:
+                        unpack_arrays(client.call(method, p))
+
+            run()  # warm (dial + negotiate + allocator)
+            t0 = time.perf_counter()
+            run()
+            msgs = len(payloads) / (time.perf_counter() - t0)
+        finally:
+            client.shutdown_server()
+            proc.wait(timeout=10)
+        entry[name] = {
+            "msgs_per_sec": round(msgs, 1),
+            "mb_per_sec": round(msgs * per_msg_bytes / 1e6, 1),
+        }
+        log(f"rpc[rows={rows}] {name}: {msgs:,.0f} msgs/s, "
+            f"{msgs * per_msg_bytes / 1e6:,.0f} MB/s")
+        return msgs
+
+    for rows in ((256,) if smoke else (256, batch_size)):
+        signs = rng.integers(0, 1 << 40, size=rows, dtype=np.uint64)
+        legacy_payload = pack_arrays({"dim": DIM}, [signs])
+        sg_payload = pack_arrays_sg({"dim": DIM}, [signs])
+        per_msg_bytes = len(legacy_payload) + rows * DIM * 4
+        uniform_legacy = [legacy_payload] * n_msgs
+        uniform_sg = [sg_payload] * n_msgs
+        entry = {}
+        # wire planes (work-free handlers; the serial server isolates
+        # framing + pipelining cost — dispatch-pool effects on REAL
+        # store work are what `--mode worker` measures)
+        measure("serialized", rows, 1, False, "lookup_legacy",
+                uniform_legacy, False, entry, per_msg_bytes)
+        measure("multiplexed", rows, 1, True, "lookup_legacy",
+                uniform_legacy, True, entry, per_msg_bytes)
+        measure("zero-copy", rows, 1, True, "lookup_sg",
+                uniform_sg, True, entry, per_msg_bytes)
+        # the slow-shard case out-of-order completion exists for: every
+        # 8th request stalls 20 ms server-side (a slow internal shard /
+        # straggler replica). In-order wire: each straggler head-of-line
+        # blocks the responses behind it. Tagged wire + dispatch pool:
+        # stragglers overlap each other and fast traffic flows past.
+        # Both legs use the SAME legacy framing so the ratio isolates
+        # out-of-order completion (framing is A/B'd above).
+        slow_legacy = [
+            pack_arrays({"dim": DIM, "sleep_ms": 20 if i % 8 == 0 else 0},
+                        [signs])
+            for i in range(n_msgs)
+        ]
+        measure("skew-inorder", rows, 8, False, "lookup_legacy",
+                slow_legacy, True, entry, per_msg_bytes)
+        measure("skew-ooo", rows, 8, True, "lookup_legacy",
+                slow_legacy, True, entry, per_msg_bytes)
+        results[rows] = entry
+    rows = max(results)
+    speedup = (results[rows]["zero-copy"]["msgs_per_sec"]
+               / results[rows]["serialized"]["msgs_per_sec"])
+    hol = (results[rows]["skew-ooo"]["msgs_per_sec"]
+           / results[rows]["skew-inorder"]["msgs_per_sec"])
+    log(f"rpc: multiplexed+zero-copy {speedup:.2f}x serialized on uniform "
+        f"loopback traffic; out-of-order {hol:.2f}x in-order under a "
+        f"1-in-8 slow-shard skew (rows={rows}) — the skew case is the "
+        f"one the tagged wire exists for")
+    return results[rows]["skew-ooo"]["msgs_per_sec"], hol, results
+
+
+def _worker_rpc_stack(schema, n_ps, overlapped):
+    """Build one worker + a REAL PS-process stack (subprocess per
+    replica — in-process services would share the worker's GIL and
+    measure a topology that never ships) with the data plane either
+    fully serialized (pre-PR-2: untagged wire, legacy pack_arrays
+    framing, in-order servers, serial shard execution,
+    gather-then-scatter worker) or fully overlapped (tagged
+    multiplexing, dispatch-pool servers, shard-parallel PS execution,
+    zero-copy framing, streaming worker)."""
+    import subprocess
+    import tempfile
+
+    from persia_tpu.service.ps_service import PsClient
+    from persia_tpu.worker.worker import EmbeddingWorker
+
+    env = dict(os.environ)
+    env["PERSIA_PS_SHARD_PARALLEL"] = "1" if overlapped else "0"
+    env["PERSIA_PS_LEGACY_FRAMES"] = "0" if overlapped else "1"
+    env.pop("JAX_PLATFORMS", None)  # the PS binary never touches jax
+    procs = []
+    addr_files = []
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        for i in range(n_ps):
+            f = tempfile.NamedTemporaryFile(suffix=".addr", delete=False)
+            f.close()
+            os.unlink(f.name)
+            addr_files.append(f.name)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "persia_tpu.service.ps_service",
+                 "--port", "0", "--replica-index", str(i),
+                 "--replica-size", str(n_ps), "--addr-file", f.name,
+                 "--concurrent-streams", "16" if overlapped else "1"],
+                env=env, cwd=here,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+        addrs = []
+        deadline = time.monotonic() + 60
+        for path in addr_files:
+            while not os.path.exists(path):
+                if time.monotonic() > deadline:
+                    raise RuntimeError("PS replica failed to start")
+                time.sleep(0.05)
+            with open(path) as fh:
+                addrs.append(fh.read().strip())
+            os.unlink(path)
+    except BaseException:
+        for p in procs:  # don't orphan already-spawned replicas
+            p.kill()
+        raise
+    clients = [PsClient(a, enable_tags=overlapped,
+                        legacy_frames=not overlapped)
+               for a in addrs]
+    worker = EmbeddingWorker(schema, clients, streaming=overlapped)
+    worker.configure_parameter_servers(
+        "bounded_uniform", {"lower": -0.01, "upper": 0.01}, 1.0, 10.0)
+    worker.register_optimizer({
+        "type": "adagrad", "lr": 0.02, "initialization": 0.1,
+        "g_square_momentum": 1.0, "vectorwise_shared": False,
+    })
+    return worker, (clients, procs)
+
+
+def _worker_cycle_rpc_compare(batch_size, steps, n_ps, dim):
+    """A/B the serialized vs overlapped data planes over real PS
+    sockets, INTERLEAVED round-robin (this host's background noise
+    drifts ~2x over minutes — sequential A-then-B would measure the
+    weather, not the plane). Returns {plane: {ms_per_batch, breakdown}}
+    using per-round medians."""
+    import statistics
+
+    from persia_tpu.config import EmbeddingSchema, SlotConfig
+    from persia_tpu.data.batch import IDTypeFeatureWithSingleID
+
+    # mixed dims (real CTR schemas mix slot widths): several
+    # (shard, dim) groups per replica, so the overlapped plane's
+    # per-connection multiplexing and ship-as-aggregated streaming have
+    # the structure they exist for
+    dims = (dim // 2, dim, 2 * dim, 4 * dim)
+    schema = EmbeddingSchema(slots_config={
+        f"slot_{s}": SlotConfig(name=f"slot_{s}", dim=dims[s % len(dims)])
+        for s in range(NUM_SLOTS)
+    })
+    stacks = {}
+    rng = np.random.default_rng(0)
+
+    def batch():
+        return [
+            IDTypeFeatureWithSingleID(
+                f"slot_{s}",
+                rng.integers(0, 1 << 40, size=batch_size,
+                             dtype=np.uint64))
+            for s in range(NUM_SLOTS)
+        ]
+
+    def cycle(worker, b):
+        ref = worker.put_batch(b)
+        lk = worker.lookup(ref)
+        worker.update_gradients(
+            ref, {k: v.embeddings for k, v in lk.items()})
+
+    try:
+        # built inside the try so a failed second stack still tears the
+        # first one's PS subprocesses down
+        stacks["serialized"] = _worker_rpc_stack(schema, n_ps,
+                                                 overlapped=False)
+        stacks["overlapped"] = _worker_rpc_stack(schema, n_ps,
+                                                 overlapped=True)
+        regimes = ("all-miss", "steady")
+        per_round = {(k, reg): [] for k in stacks for reg in regimes}
+        snaps = {}
+        rounds = max(6, steps // 2)
+        per_round_steps = 2
+        hot = batch()  # steady-state regime reuses one batch (all hits)
+        for k, (worker, _) in stacks.items():
+            for _ in range(3):
+                cycle(worker, batch())
+            cycle(worker, hot)
+            snaps[k] = worker.stage_snapshot()
+        order = list(stacks)
+        ratios = {reg: [] for reg in regimes}
+        for r in range(rounds):
+            round_batches = [batch() for _ in range(per_round_steps)]
+            times = {}
+            # alternate which plane runs first so within-round drift
+            # (throttling, cache weather) cannot systematically favor
+            # either plane
+            for k in (order if r % 2 == 0 else order[::-1]):
+                worker, _ = stacks[k]
+                t0 = time.perf_counter()
+                for b in round_batches:
+                    cycle(worker, b)
+                times[(k, "all-miss")] = (
+                    (time.perf_counter() - t0) / per_round_steps)
+                t0 = time.perf_counter()
+                for _ in range(per_round_steps):
+                    cycle(worker, hot)
+                times[(k, "steady")] = (
+                    (time.perf_counter() - t0) / per_round_steps)
+                for reg in regimes:
+                    per_round[(k, reg)].append(times[(k, reg)])
+            for reg in regimes:
+                ratios[reg].append(times[("serialized", reg)]
+                                   / times[("overlapped", reg)])
+        out = {"speedup": {reg: statistics.median(ratios[reg])
+                           for reg in regimes}}
+        for k, (worker, _) in stacks.items():
+            breakdown = worker.stage_breakdown(snaps[k],
+                                               worker.stage_snapshot())
+            out[k] = {
+                "ms_per_batch": {
+                    reg: statistics.median(per_round[(k, reg)]) * 1e3
+                    for reg in regimes},
+                "breakdown": breakdown,
+            }
+            worker.close()
+        return out
+    finally:
+        for _, (clients, procs) in stacks.values():
+            for c in clients:
+                c.shutdown()
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except Exception:
+                    p.kill()
+
+
+def bench_worker(batch_size, steps, n_ps=2, dim=DIM, rpc_paths=True):
     """Host-side worker cycle (put+lookup+update through the C++ store),
     all-miss worst case — the middleware throughput ceiling per core
     (reference's equivalent tier: the Rust embedding worker)."""
@@ -421,6 +727,27 @@ def bench_worker(batch_size, steps, n_ps=2, dim=DIM):
     hot_elapsed = time.perf_counter() - t0
     log(f"worker: {hot_elapsed / steps * 1e3:.1f} ms/batch steady-state "
         f"(all hits)")
+    if rpc_paths:
+        # the PR-2 comparison: the same cycle over REAL PS sockets,
+        # serialized plane vs multiplexed+shard-parallel+streaming plane,
+        # with the per-stage breakdown (preprocess/rpc/postprocess/
+        # aggregate/ship) from the metrics registry
+        cmp = _worker_cycle_rpc_compare(batch_size, steps, n_ps, dim)
+        for label in ("serialized", "overlapped"):
+            ms = cmp[label]["ms_per_batch"]
+            stages = "  ".join(
+                f"{k}={v['avg_ms']:.1f}ms"
+                for k, v in cmp[label]["breakdown"].items() if v["count"])
+            log(f"worker-rpc[{label}]: all-miss {ms['all-miss']:.1f} "
+                f"ms/batch, steady-state {ms['steady']:.1f} ms/batch  "
+                f"{stages}")
+        for reg in ("all-miss", "steady"):
+            base_ms = cmp["serialized"]["ms_per_batch"][reg]
+            over_ms = cmp["overlapped"]["ms_per_batch"][reg]
+            log(f"worker-rpc[{reg}]: overlapped plane "
+                f"{cmp['speedup'][reg]:.2f}x serialized (worker cycle "
+                f"{base_ms:.1f} -> {over_ms:.1f} ms/batch; median of "
+                f"paired interleaved rounds)")
     return steps * batch_size / elapsed
 
 
@@ -974,7 +1301,7 @@ def main():
     p.add_argument("--mode",
                    choices=["hybrid", "device", "cached", "attn", "wire",
                             "worker", "worker-svc", "store", "roofline",
-                            "infer"],
+                            "infer", "rpc"],
                    default="device")
     p.add_argument("--clients", type=int, default=8,
                    help="infer mode: concurrent closed-loop clients")
@@ -1002,6 +1329,7 @@ def main():
         "attn": ("flash_attention_tflops_chip", "TFLOP/sec"),
         "roofline": ("dlrm_hybrid_best_samples_per_sec", "samples/sec"),
         "infer": ("infer_microbatched_qps", "req/sec"),
+        "rpc": ("rpc_out_of_order_msgs_per_sec", "msgs/sec"),
     }[args.mode]
 
     # Shared two-tier watchdog (persia_tpu.utils.arm_watchdog — the same
@@ -1020,7 +1348,7 @@ def main():
     if args.smoke:
         args.batch_size, args.steps, args.warmup = 256, 3, 1
 
-    if args.mode not in ("wire", "worker", "worker-svc", "store"):  # host-only modes skip jax
+    if args.mode not in ("wire", "worker", "worker-svc", "store", "rpc"):  # host-only modes skip jax
         # local verification escape hatch (nn_worker.py honors the same
         # variable); plain JAX_PLATFORMS=cpu also counts — the axon
         # platform plugin re-pins jax.config via sitecustomize, so the
@@ -1066,6 +1394,15 @@ def main():
         # host-side metric: no meaningful ratio against the chip-throughput
         # baseline constant, so pin 1.0 like wire mode
         vs_baseline = 1.0
+    elif args.mode == "rpc":
+        value, speedup, detail = bench_rpc(args.batch_size,
+                                           max(args.steps, 5),
+                                           smoke=args.smoke)
+        # no published RPC baseline; the in-order wire on the same
+        # skewed traffic IS the baseline, so vs_baseline = the
+        # out-of-order speedup under a 1-in-8 slow-shard skew
+        vs_baseline = speedup
+        extra["detail"] = {str(k): v for k, v in detail.items()}
     elif args.mode == "worker-svc":
         py = bench_worker_service(args.batch_size, max(args.steps, 5),
                                   native_worker=False)

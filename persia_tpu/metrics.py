@@ -71,6 +71,23 @@ class Histogram:
     def timer(self):
         return _Timer(self)
 
+    @property
+    def sum(self) -> float:
+        """Total of observed values (Prometheus ``_sum`` series)."""
+        return self._sum
+
+    @property
+    def count(self) -> int:
+        """Number of observations (Prometheus ``_count`` series)."""
+        return self._total
+
+    def snapshot(self) -> Tuple[int, float]:
+        """(count, sum) pair — diff two snapshots to attribute time to a
+        bounded region (the worker-cycle breakdown does this, since the
+        registry's histograms are process-shared)."""
+        with self._lock:
+            return self._total, self._sum
+
     def percentile(self, q: float) -> float:
         """Approximate quantile from the bucket counts (linear
         interpolation inside the winning bucket, Prometheus

@@ -116,7 +116,16 @@ def flush_backward_engines(worker, timeout: Optional[float] = None):
 
 
 class BackwardEngine:
-    """Async gradient return path (reference backward.rs:233-354)."""
+    """Async gradient return path (reference backward.rs:233-354).
+
+    Each backward worker thread's ``worker.update_gradients`` call runs
+    the streaming data plane underneath (PR 2): per-(shard,dim) gradient
+    groups ship as soon as their features aggregate, over tagged
+    multiplexed connections when the PS tier supports them, and the
+    aggregate/ship split is exported per worker through the metrics
+    registry (``update_aggregate_time_cost_sec`` /
+    ``update_ship_time_cost_sec``) next to this engine's
+    ``backward_client_time_cost_sec``."""
 
     def __init__(self, worker, num_workers: int = 2,
                  staleness_sem: Optional[threading.Semaphore] = None,

@@ -149,6 +149,11 @@ class NativeEmbeddingHolder:
     """Drop-in replacement for :class:`persia_tpu.ps.store.EmbeddingHolder`
     backed by the C++ store."""
 
+    # ctypes drops the GIL for the duration of every foreign call, so
+    # the service tier's shard-parallel dispatch gets real parallelism
+    # from one process (ps_service.ShardParallelDispatcher keys on this)
+    releases_gil = True
+
     def __init__(self, capacity: int = 1_000_000_000, num_internal_shards: int = 8):
         lib = load_native_lib()
         if lib is None:

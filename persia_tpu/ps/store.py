@@ -95,6 +95,11 @@ class EmbeddingHolder:
     (reference: persia-embedding-holder/src/lib.rs:28-101).
     """
 
+    # Python-level data-plane calls hold the GIL throughout, so the
+    # service tier's shard-parallel dispatch gains nothing here (the
+    # native holder sets True and releases the GIL in ctypes calls)
+    releases_gil = False
+
     def __init__(self, capacity: int = 1_000_000_000, num_internal_shards: int = 8):
         if num_internal_shards <= 0:
             raise ValueError("num_internal_shards must be positive")
@@ -111,9 +116,19 @@ class EmbeddingHolder:
         self.weight_bound: float = 10.0
         self.enable_weight_bound: bool = True
         self.configured = False
-        # metrics
-        self.index_miss_count = 0
-        self.gradient_id_miss_count = 0
+        # metrics: per-shard cells, each only ever written under its
+        # shard's lock (a single shared int was += 1'd under DIFFERENT
+        # shard locks — concurrent increments lost updates); readers sum
+        self._index_miss = [0] * num_internal_shards
+        self._gradient_id_miss = [0] * num_internal_shards
+
+    @property
+    def index_miss_count(self) -> int:
+        return sum(self._index_miss)
+
+    @property
+    def gradient_id_miss_count(self) -> int:
+        return sum(self._gradient_id_miss)
 
     # --- control plane -------------------------------------------------
 
@@ -183,16 +198,16 @@ class EmbeddingHolder:
                     if entry is not None and entry[0] == dim:
                         out[pos] = entry[1][:dim]
                     elif not training:
-                        self.index_miss_count += 1
+                        self._index_miss[shard_idx] += 1
                     elif entry is None and not admitted[pos]:
-                        self.index_miss_count += 1
+                        self._index_miss[shard_idx] += 1
                     else:
                         # admitted miss, or dim mismatch (reinitialized
                         # unconditionally, reference mod.rs:213-228)
                         vec = init_vecs[pos].copy()
                         out[pos] = vec[:dim]
                         shard.insert(sign, dim, vec)
-                        self.index_miss_count += 1
+                        self._index_miss[shard_idx] += 1
         return out
 
     def update_gradients(self, signs: np.ndarray, grads: np.ndarray, dim: int):
@@ -240,7 +255,7 @@ class EmbeddingHolder:
                             found_pos.append(pos)
                             found_entries.append(entry[1])
                     else:
-                        self.gradient_id_miss_count += 1
+                        self._gradient_id_miss[shard_idx] += 1
                 if not found_pos:
                     continue
                 # fast path (no duplicates): one batched optimizer call

@@ -3,22 +3,40 @@
 Replaces the reference's hyper-HTTP RPC with speedy + lz4
 (rust/others/persia-rpc/src/lib.rs:68-145). Wire format per message:
 
-    u32 frame_len | u8 flags | msgpack envelope | raw payload
+    u32 frame_len | u8 flags | u16 env_len | [u32 tag] | envelope | payload
 
 Envelope: ``[method, payload_len]`` for requests, ``[status, payload_len]``
 for responses; the payload is raw bytes (numpy buffers travel uncopied
 into the socket). flags bit 0 = payload is zstd-compressed (mirrors the
-reference's ``_compressed`` method variants).
+reference's ``_compressed`` method variants); flags bit 1 = the frame
+carries a u32 sequence **tag** between the fixed header and the envelope.
+
+Tags make responses self-describing — a response carries the tag of the
+request it answers — which lets the server complete requests
+**out of order** (slow shard no longer head-of-line blocks fast ones)
+and lets the client multiplex many requests on one connection
+(:meth:`RpcClient.call_future`). Tagged framing is negotiated per
+connection: a client that wants it sends a ``__tags__`` request first;
+servers that support tags answer ``ok``, legacy peers (e.g. the C++
+``ps_server``) answer "no such method" and the connection stays
+untagged — fully backward compatible in both directions.
 
 Numpy arrays are framed with :func:`pack_arrays` / :func:`unpack_arrays`.
+:func:`pack_arrays_sg` is the zero-copy twin: it returns a buffer LIST
+that ``sendmsg``/writev hands to the kernel without the ``tobytes()``
+concatenation copies, and the receive side reads each frame with
+``recv_into`` into one preallocated buffer so ``unpack_arrays`` returns
+views — bytes on the wire are bit-identical either way.
+
 The server runs a thread per connection (clients hold few, long-lived
 connections — trainers and workers, not end users).
 """
 
+import select
 import socket
 import struct
 import threading
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple, Union
 
 import msgpack
 import numpy as np
@@ -46,7 +64,16 @@ except ImportError:  # pragma: no cover
     zstandard = None
 
 _FLAG_COMPRESSED = 1
+_FLAG_TAGGED = 2
+# request hint: more requests may already be in flight on this
+# connection — the dispatch-pool server must NOT execute inline on the
+# reader thread (a slow handler would head-of-line block the others)
+_FLAG_PIPELINED = 4
 COMPRESS_THRESHOLD = 1 << 16
+
+# A payload is bytes, OR a buffer list from pack_arrays_sg (scatter-
+# gather: written with one sendmsg instead of concatenated first).
+Payload = Union[bytes, bytearray, memoryview, list, tuple]
 
 
 def _is_loopback(sock: socket.socket) -> bool:
@@ -57,6 +84,11 @@ def _is_loopback(sock: socket.socket) -> bool:
         peer = sock.getpeername()[0]
     except OSError:
         return False
+    if peer.startswith("::ffff:"):
+        # IPv4-mapped IPv6 (dual-stack listeners hand these out for
+        # plain 127.0.0.1 connects); strip the mapping prefix so local
+        # traffic is not mis-billed the zstd CPU
+        peer = peer[7:]
     return peer.startswith("127.") or peer == "::1"
 
 
@@ -78,7 +110,29 @@ def pack_arrays(meta: dict, arrays: List[np.ndarray]) -> bytes:
     return b"".join(out)
 
 
-def unpack_arrays(payload: bytes) -> Tuple[dict, List[np.ndarray]]:
+def pack_arrays_sg(meta: dict, arrays: List[np.ndarray]) -> list:
+    """Zero-copy twin of :func:`pack_arrays`: returns a buffer list
+    ``[prefix, *array buffers]`` that :func:`_send_msg` writes with one
+    ``sendmsg`` — the array bytes go socketward without the
+    ``tobytes()``/join concatenation copies. The byte stream is
+    bit-identical to ``pack_arrays`` output (``unpack_arrays`` cannot
+    tell them apart). The caller must not mutate the arrays until the
+    send completes (all in-repo callers send synchronously)."""
+    heads = []
+    bufs = []
+    for a in arrays:
+        a = np.ascontiguousarray(a)
+        heads.append((str(a.dtype), list(a.shape)))
+        bufs.append(memoryview(a).cast("B"))
+    head = msgpack.packb({"m": meta, "a": heads}, use_bin_type=True)
+    return [struct.pack("<I", len(head)) + head] + bufs
+
+
+def unpack_arrays(payload) -> Tuple[dict, List[np.ndarray]]:
+    """Parse a pack_arrays/pack_arrays_sg byte stream. Accepts any
+    bytes-like object; the returned arrays are VIEWS into it (the
+    receive path hands in the per-frame buffer, so no copy happens
+    between socket and numpy)."""
     (head_len,) = struct.unpack_from("<I", payload, 0)
     head = msgpack.unpackb(payload[4 : 4 + head_len], raw=False)
     arrays = []
@@ -92,41 +146,128 @@ def unpack_arrays(payload: bytes) -> Tuple[dict, List[np.ndarray]]:
     return head["m"], arrays
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    chunks = []
-    while n:
-        chunk = sock.recv(min(n, 1 << 20))
-        if not chunk:
-            raise ConnectionError("socket closed")
-        chunks.append(chunk)
-        n -= len(chunk)
-    return b"".join(chunks)
+def _payload_nbytes(payload: Payload) -> int:
+    if isinstance(payload, (bytes, bytearray)):
+        return len(payload)
+    if isinstance(payload, memoryview):
+        return payload.nbytes
+    return sum(_payload_nbytes(b) for b in payload)
 
 
-def _send_msg(sock: socket.socket, envelope: list, payload: bytes,
-              compress: bool):
-    flags = 0
-    if compress and zstandard is not None and len(payload) > COMPRESS_THRESHOLD:
-        payload = _zstd_c().compress(payload)
+def _payload_bytes(payload: Payload) -> bytes:
+    """Flatten a payload (possibly a buffer list) to one bytes object —
+    only needed on the compression path, which copies anyway."""
+    if isinstance(payload, bytes):
+        return payload
+    if isinstance(payload, (bytearray, memoryview)):
+        return bytes(payload)
+    return b"".join(b if isinstance(b, bytes) else bytes(b) for b in payload)
+
+
+def _as_byte_view(b) -> memoryview:
+    mv = b if isinstance(b, memoryview) else memoryview(b)
+    if mv.format != "B" or mv.ndim != 1:
+        mv = mv.cast("B")
+    return mv
+
+
+def _sendmsg_all(sock: socket.socket, bufs: List[memoryview]):
+    """Vectored send of the whole buffer list (handles short writes and
+    IOV_MAX); the scatter-gather half of the zero-copy framing."""
+    sendmsg = getattr(sock, "sendmsg", None)
+    if sendmsg is None:  # pragma: no cover — non-POSIX fallback
+        sock.sendall(b"".join(bytes(b) for b in bufs))
+        return
+    while bufs:
+        n = sendmsg(bufs[:1024])
+        while n and bufs:
+            if n >= bufs[0].nbytes:
+                n -= bufs[0].nbytes
+                bufs.pop(0)
+            else:
+                bufs[0] = bufs[0][n:]
+                n = 0
+
+
+def _send_msg(sock: socket.socket, envelope: list, payload: Payload,
+              compress: bool, tag: Optional[int] = None,
+              pipelined: bool = False):
+    flags = _FLAG_PIPELINED if pipelined else 0
+    nbytes = _payload_nbytes(payload)
+    if compress and zstandard is not None and nbytes > COMPRESS_THRESHOLD:
+        payload = _zstd_c().compress(_payload_bytes(payload))
+        nbytes = len(payload)
         flags |= _FLAG_COMPRESSED
-    env = msgpack.packb(envelope + [len(payload)], use_bin_type=True)
+    env = msgpack.packb(envelope + [nbytes], use_bin_type=True)
     # frame_len counts everything after the u32: flags+env_len fields (3
-    # bytes, already consumed by the fixed 7-byte header read) + env + payload
-    frame_len = 3 + len(env) + len(payload)
-    header = struct.pack("<IBH", frame_len, flags, len(env))
-    sock.sendall(header + env + payload)
+    # bytes, already consumed by the fixed 7-byte header read) + the
+    # optional 4-byte tag + env + payload
+    if tag is None:
+        header = struct.pack("<IBH", 3 + len(env) + nbytes, flags, len(env))
+    else:
+        flags |= _FLAG_TAGGED
+        header = struct.pack("<IBHI", 7 + len(env) + nbytes, flags,
+                             len(env), tag & 0xFFFFFFFF)
+    if isinstance(payload, bytes) and nbytes <= (1 << 14):
+        # small single-buffer frames: one concatenated sendall beats the
+        # sendmsg bookkeeping
+        sock.sendall(header + env + payload)
+        return
+    bufs = [_as_byte_view(header + env)]
+    if isinstance(payload, (list, tuple)):
+        bufs.extend(_as_byte_view(b) for b in payload)
+    else:
+        bufs.append(_as_byte_view(payload))
+    _sendmsg_all(sock, [b for b in bufs if b.nbytes])
 
 
-def _recv_msg(sock: socket.socket) -> Tuple[list, bytes]:
-    head = _recv_exact(sock, 7)
+def _recv_exact_into(sock: socket.socket, view: memoryview):
+    n = view.nbytes
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:])
+        if not r:
+            raise ConnectionError("socket closed")
+        got += r
+
+
+def _recv_msg_full(sock: socket.socket) -> Tuple[list, Payload,
+                                                 Optional[int], int]:
+    """Read one frame: (envelope, payload view, tag-or-None, flags).
+    The whole body lands in ONE fresh buffer via recv_into (no
+    chunk-join copy); the payload is a view into it, which
+    unpack_arrays turns into numpy views — socket to array without an
+    intermediate copy."""
+    head = bytearray(7)
+    _recv_exact_into(sock, memoryview(head))
     frame_len, flags, env_len = struct.unpack("<IBH", head)
-    body = _recv_exact(sock, frame_len - 3)
-    env = msgpack.unpackb(body[:env_len], raw=False)
-    payload = body[env_len:]
+    extra = 4 if flags & _FLAG_TAGGED else 0
+    if frame_len < 3 + extra + env_len:
+        raise ConnectionError("bad frame header")
+    body = bytearray(frame_len - 3)
+    view = memoryview(body)
+    _recv_exact_into(sock, view)
+    tag = None
+    if extra:
+        (tag,) = struct.unpack_from("<I", body, 0)
+        view = view[4:]
+    env = msgpack.unpackb(view[:env_len], raw=False)
+    payload: Payload = view[env_len:]
     if flags & _FLAG_COMPRESSED:
         if zstandard is None:  # pragma: no cover
             raise RpcError("compressed payload but zstandard unavailable")
         payload = _zstd_d().decompress(payload)
+    return env, payload, tag, flags
+
+
+def _recv_msg_tagged(sock: socket.socket) -> Tuple[list, Payload,
+                                                   Optional[int]]:
+    env, payload, tag, _ = _recv_msg_full(sock)
+    return env, payload, tag
+
+
+def _recv_msg(sock: socket.socket) -> Tuple[list, Payload]:
+    env, payload, _, _ = _recv_msg_full(sock)
     return env, payload
 
 
@@ -135,6 +276,8 @@ class RpcServer:
 
     Handlers take ``(payload: bytes) -> bytes`` and run concurrently;
     state they touch must be internally synchronized (the stores are).
+    Handlers may also return a buffer LIST (:func:`pack_arrays_sg`) for
+    zero-copy responses.
 
     Requests carrying a request id (``RpcClient.call(dedup=True)``) are
     executed at most once: a bounded LRU of recently-served ids returns
@@ -148,16 +291,18 @@ class RpcServer:
     restarts. Restart recovery instead relies on the worker tiers'
     restore-on-failure + re-arm paths (worker.py / worker_server.cc).
 
-    ``concurrent_streams > 1`` enables per-connection read-ahead: up to
-    that many requests from ONE connection execute concurrently in a
-    shared pool while responses still go out in request order (the wire
-    has no response tags, so order is the correlation). Existing
-    blocking clients never pipeline, so the default of 1 keeps the
-    exact serial per-connection behavior; the inference server opts in
-    so a single ``call_many`` client can keep its micro-batcher full.
-    The handler contract is unchanged — handlers already must tolerate
-    cross-connection concurrency, and read-ahead only adds same-
-    connection concurrency under the same rule.
+    ``concurrent_streams > 1`` enables the per-connection dispatch pool:
+    up to that many requests from ONE connection execute concurrently in
+    a shared pool. On an untagged connection responses still go out in
+    request order (the legacy wire has no response tags, so order is the
+    correlation). On a TAGGED connection (client negotiated ``__tags__``)
+    responses carry the request's tag and are sent in COMPLETION order —
+    a slow handler no longer head-of-line blocks fast ones. Existing
+    blocking clients never pipeline, so the default of 1 keeps the exact
+    serial per-connection behavior. The handler contract is unchanged —
+    handlers already must tolerate cross-connection concurrency, and the
+    dispatch pool only adds same-connection concurrency under the same
+    rule.
     """
 
     DEDUP_CACHE_SIZE = 8192
@@ -167,10 +312,14 @@ class RpcServer:
     DEDUP_CACHE_BYTES = 256 << 20
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 concurrent_streams: int = 1):
+                 concurrent_streams: int = 1, enable_tags: bool = True):
         from collections import OrderedDict
 
         self._concurrent_streams = max(1, int(concurrent_streams))
+        # enable_tags=False emulates a legacy (pre-tag) peer: the
+        # ``__tags__`` negotiation answers "no such method" and clients
+        # negotiate down to untagged framing (compat tests use this)
+        self._enable_tags = enable_tags
         self._stream_pool = None  # built lazily on the first connection
         self._stream_pool_lock = threading.Lock()
         self._handlers: Dict[str, Callable[[bytes], bytes]] = {}
@@ -224,7 +373,7 @@ class RpcServer:
             threading.Thread(target=self._serve_conn, args=(conn,),
                              daemon=True).start()
 
-    def _handle_one(self, method: str, payload: bytes,
+    def _handle_one(self, method: str, payload,
                     req_id) -> Tuple[list, bytes]:
         """Run one request to a (envelope, body) response pair."""
         try:
@@ -240,13 +389,15 @@ class RpcServer:
             return ["err", f"{type(e).__name__}: {e}"], b""
 
     def _serve_conn_concurrent(self, conn: socket.socket):
-        """Read-ahead variant: this thread reads requests and submits
-        them to the shared pool; a writer thread sends the results back
-        strictly in request order. The bounded pending queue caps
-        read-ahead at ``concurrent_streams`` so a fast sender cannot
-        pile unbounded work into the pool."""
+        """Dispatch-pool variant: this thread reads requests and submits
+        them to the shared pool; a writer thread sends the results back.
+        Untagged requests answer strictly in request order (enqueued at
+        submit time, the writer blocks on each future); tagged requests
+        answer in COMPLETION order (enqueued by a done-callback). The
+        ``inflight`` semaphore caps read-ahead at ``concurrent_streams``
+        so a fast sender cannot pile unbounded work into the pool."""
         import queue as _queue
-        from concurrent.futures import ThreadPoolExecutor
+        from concurrent.futures import Future, ThreadPoolExecutor
 
         with self._stream_pool_lock:
             if not self._running:
@@ -263,32 +414,64 @@ class RpcServer:
                     thread_name_prefix="rpc-stream")
             pool = self._stream_pool
         compress = not _is_loopback(conn)
-        pending: "_queue.Queue" = _queue.Queue(
-            maxsize=self._concurrent_streams)
+        pending: "_queue.Queue" = _queue.Queue()
+        inflight = threading.BoundedSemaphore(self._concurrent_streams)
+        # responses may leave from the reader (inline fast path), the
+        # writer (untagged in-order) or a pool thread (tagged,
+        # completion order) — the lock keeps frames from interleaving
+        send_lock = threading.Lock()
+        # count of requests admitted whose response has not been sent
+        # yet; when 0 the reader may execute+respond INLINE (a blocking
+        # one-at-a-time client then never pays the pool tax — byte- and
+        # order-identical to the serial server)
+        queued = [0]
+        queued_lock = threading.Lock()
         conn_dead = threading.Event()
 
+        def send_response(env, body, tag):
+            if conn_dead.is_set():
+                return
+            try:
+                with send_lock:
+                    _send_msg(conn, env, body,
+                              compress if env[0] == "ok" else False,
+                              tag=tag)
+            except OSError:
+                conn_dead.set()
+
+        def handle_direct(method, payload, req_id, tag):
+            """Tagged request in a pool thread: handle and send straight
+            from here, in COMPLETION order — no queue hop, no writer
+            wakeup (out-of-order is the tag wire's whole point)."""
+            env, body = self._handle_one(method, payload, req_id)
+            send_response(env, body, tag)
+            with queued_lock:
+                queued[0] -= 1
+            inflight.release()
+
         def writer():
+            """Untagged responses must go out in REQUEST order (the
+            legacy wire has no tags, so order is the correlation)."""
             while True:
                 item = pending.get()
                 if item is None:
                     return
-                if item == "shutdown":
+                if item[0] == "__SHUTDOWN__":
                     try:
-                        _send_msg(conn, ["ok"], b"", False)
+                        with send_lock:
+                            _send_msg(conn, ["ok"], b"", False, tag=item[1])
                     except OSError:
                         pass
                     self.stop()
                     if self._shutdown_cb is not None:
                         self._shutdown_cb()
                     return
-                env, body = item.result()
-                if conn_dead.is_set():
-                    continue  # drain remaining futures without sending
-                try:
-                    _send_msg(conn, env, body,
-                              compress if env[0] == "ok" else False)
-                except OSError:
-                    conn_dead.set()
+                tag, fut = item
+                env, body = fut.result()
+                send_response(env, body, tag)
+                with queued_lock:
+                    queued[0] -= 1
+                inflight.release()
 
         wt = threading.Thread(target=writer, daemon=True,
                               name="rpc-stream-writer")
@@ -297,23 +480,72 @@ class RpcServer:
             with conn:
                 while self._running and not conn_dead.is_set():
                     try:
-                        env, payload = _recv_msg(conn)
+                        env, payload, tag, flags = _recv_msg_full(conn)
                     except (ConnectionError, OSError):
                         break
                     method = env[0]
                     if method == "__shutdown__":
-                        pending.put("shutdown")
+                        pending.put(("__SHUTDOWN__", tag))
                         wt.join()
                         return
+                    if method == "__tags__" and self._enable_tags:
+                        inflight.acquire()
+                        with queued_lock:
+                            queued[0] += 1
+                        ack: Future = Future()
+                        ack.set_result((["ok"], b""))
+                        pending.put((tag, ack))
+                        continue
                     req_id = env[1] if len(env) >= 3 else None
+                    if flags & _FLAG_PIPELINED:
+                        # the client declared more requests may be in
+                        # flight: executing inline would head-of-line
+                        # block them behind this handler
+                        idle = False
+                    else:
+                        with queued_lock:
+                            idle = queued[0] == 0
+                        if idle:
+                            # ...and the client has not already pipelined
+                            # the NEXT request (buffered data means
+                            # read-ahead has value; handling inline would
+                            # serialize an actively-pipelining client)
+                            try:
+                                idle = not select.select([conn], [], [],
+                                                         0)[0]
+                            except ValueError:
+                                # fd >= FD_SETSIZE: select() can't watch
+                                # it — take the pooled path, never kill
+                                # the connection thread
+                                idle = False
+                    if idle:
+                        # nothing in flight on this connection and no
+                        # request queued behind this one: respond from
+                        # the reader thread
+                        renv, rbody = self._handle_one(method, payload,
+                                                       req_id)
+                        send_response(renv, rbody, tag)
+                        if conn_dead.is_set():
+                            break
+                        continue
+                    inflight.acquire()
+                    with queued_lock:
+                        queued[0] += 1
                     try:
-                        fut = pool.submit(
-                            self._handle_one, method, payload, req_id)
+                        if tag is None:
+                            fut = pool.submit(
+                                self._handle_one, method, payload, req_id)
+                            pending.put((None, fut))
+                        else:
+                            pool.submit(handle_direct, method, payload,
+                                        req_id, tag)
                     except RuntimeError:
                         # stop() shut the pool down between recv and
                         # submit; the server is closing anyway
+                        with queued_lock:
+                            queued[0] -= 1
+                        inflight.release()
                         break
-                    pending.put(fut)
         finally:
             pending.put(None)
 
@@ -325,18 +557,24 @@ class RpcServer:
         with conn:
             while self._running:
                 try:
-                    env, payload = _recv_msg(conn)
+                    env, payload, tag = _recv_msg_tagged(conn)
                 except (ConnectionError, OSError):
                     return
                 method = env[0]
                 req_id = env[1] if len(env) >= 3 else None
                 try:
                     if method == "__shutdown__":
-                        _send_msg(conn, ["ok"], b"", False)
+                        _send_msg(conn, ["ok"], b"", False, tag=tag)
                         self.stop()
                         if self._shutdown_cb is not None:
                             self._shutdown_cb()
                         return
+                    if method == "__tags__" and self._enable_tags:
+                        # serial server: tags are echoed but responses
+                        # stay in order (valid — tags enable reordering,
+                        # they do not promise it)
+                        _send_msg(conn, ["ok"], b"", False, tag=tag)
+                        continue
                     handler = self._handlers.get(method)
                     if handler is None:
                         raise RpcError(f"no such method {method!r}")
@@ -344,15 +582,15 @@ class RpcServer:
                         result = handler(payload)
                     else:
                         result = self._execute_once(handler, payload, req_id)
-                    _send_msg(conn, ["ok"], result, compress)
+                    _send_msg(conn, ["ok"], result, compress, tag=tag)
                 except BaseException as e:
                     try:
                         _send_msg(conn, ["err", f"{type(e).__name__}: {e}"],
-                                  b"", False)
+                                  b"", False, tag=tag)
                     except OSError:
                         return
 
-    def _execute_once(self, handler, payload: bytes, req_id: bytes) -> bytes:
+    def _execute_once(self, handler, payload, req_id: bytes) -> bytes:
         """At-most-once execution for an id, including the concurrent
         window: a duplicate delivery waits for the in-flight original
         and returns its cached result. If the original ERRORED, nothing
@@ -377,13 +615,13 @@ class RpcServer:
             raise
         with self._dedup_lock:
             self._dedup[req_id] = result
-            self._dedup_bytes += len(result)
+            self._dedup_bytes += _payload_nbytes(result)
             while len(self._dedup) > self.DEDUP_CACHE_SIZE or (
                 self._dedup_bytes > self.DEDUP_CACHE_BYTES
                 and len(self._dedup) > 1
             ):
                 _, old = self._dedup.popitem(last=False)
-                self._dedup_bytes -= len(old)
+                self._dedup_bytes -= _payload_nbytes(old)
             self._inflight.pop(req_id, None)
         mine.set()
         return result
@@ -400,6 +638,71 @@ class RpcServer:
             pool.shutdown(wait=False)
 
 
+class _ConnState:
+    """One pooled connection + its negotiated framing + tag bookkeeping.
+    Owned by exactly one thread (the client pools one per thread), so
+    none of this state needs a lock."""
+
+    __slots__ = ("sock", "compress", "tagged", "next_tag", "outstanding",
+                 "done", "evicted", "dead")
+
+    def __init__(self, sock: socket.socket, compress: bool):
+        self.sock = sock
+        self.compress = compress
+        self.tagged = False
+        self.next_tag = 1
+        self.outstanding = set()  # tags sent, reply not yet claimed
+        self.done: Dict[int, tuple] = {}  # tag -> (env, payload) parked
+        self.evicted = set()  # parked replies dropped at DONE_PARK_LIMIT
+        self.dead = False
+
+
+class RpcFuture:
+    """Tag-matched pending reply on a multiplexed connection.
+
+    ``result()`` must be called from the thread that issued the call
+    (connections are pooled per thread; the waiting thread drives the
+    socket and parks replies for other tags — no reader thread)."""
+
+    __slots__ = ("_client", "_cs", "_tag", "_method", "_resolved", "_value",
+                 "_error")
+
+    def __init__(self, client, cs, tag, method):
+        self._client = client
+        self._cs = cs
+        self._tag = tag
+        self._method = method
+        self._resolved = False
+        self._value = None
+        self._error = None
+
+    @classmethod
+    def completed(cls, value=None, error=None) -> "RpcFuture":
+        f = cls(None, None, None, None)
+        f._resolved = True
+        f._value = value
+        f._error = error
+        return f
+
+    def result(self):
+        if not self._resolved:
+            self._resolved = True
+            try:
+                env, payload = self._client._wait_tag(self._cs, self._tag)
+            except (ConnectionError, OSError) as e:
+                self._error = e
+                self._client._drop_conn(self._cs)
+                raise
+            if env[0] != "ok":
+                self._error = RpcError(
+                    f"{self._client.addr} {self._method}: {env[1]}")
+            else:
+                self._value = payload
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
 class RpcClient:
     """Blocking client with one pooled connection per thread.
 
@@ -409,44 +712,151 @@ class RpcClient:
     every caller gets it). Application-level errors (RpcError) never
     retry. At-least-once semantics: a request may be re-sent if the
     connection died after the server processed it.
+
+    With ``enable_tags`` (default) each fresh connection negotiates
+    tagged framing (``__tags__`` probe); against a tag-capable server,
+    :meth:`call_future` multiplexes many in-flight requests on the one
+    connection with tag-matched completion, and :meth:`call_many`
+    windows requests that the server may execute out of order. Legacy
+    peers negotiate down to the untagged wire transparently.
     """
 
     def __init__(self, addr: str, timeout: float = 60.0,
-                 max_retries: int = 5, retry_backoff: float = 0.2):
+                 max_retries: int = 5, retry_backoff: float = 0.2,
+                 enable_tags: bool = True):
         self.addr = addr
         host, port = addr.rsplit(":", 1)
         self._target = (host, int(port))
         self.timeout = timeout
         self.max_retries = max_retries
         self.retry_backoff = retry_backoff
+        self.enable_tags = enable_tags
         self._local = threading.local()
         # one pooled conn per calling thread, keyed by the Thread object,
         # so close() (and GC via __del__) can release every socket
         # deterministically and conns of exited threads are swept instead
         # of leaking fds for the client's lifetime
-        self._conn_by_thread: Dict[threading.Thread, socket.socket] = {}
+        self._conn_by_thread: Dict[threading.Thread, _ConnState] = {}
         self._conns_lock = threading.Lock()
 
-    def _dial(self) -> socket.socket:
-        conn = socket.create_connection(self._target, timeout=self.timeout)
-        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._local.compress = not _is_loopback(conn)
+    def _dial(self) -> _ConnState:
+        sock = socket.create_connection(self._target, timeout=self.timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        cs = _ConnState(sock, compress=not _is_loopback(sock))
+        if self.enable_tags:
+            try:
+                # negotiate tagged framing; a legacy peer answers
+                # "no such method __tags__" and the connection stays
+                # untagged (negotiate-down, both directions compatible)
+                _send_msg(sock, ["__tags__"], b"", False)
+                env, _, _ = _recv_msg_tagged(sock)
+                cs.tagged = env[0] == "ok"
+            except BaseException:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                raise
         me = threading.current_thread()
         dead = []
         with self._conns_lock:
-            self._conn_by_thread[me] = conn
+            self._conn_by_thread[me] = cs
             for t in list(self._conn_by_thread):
                 if not t.is_alive() and t is not me:
                     dead.append(self._conn_by_thread.pop(t))
         for c in dead:
             try:
-                c.close()
+                c.sock.close()
             except OSError:
                 pass
-        return conn
+        self._local.cs = cs
+        return cs
 
-    def call(self, method: str, payload: bytes = b"",
-             dedup: bool = False) -> bytes:
+    def _conn(self) -> _ConnState:
+        cs = getattr(self._local, "cs", None)
+        if cs is None or cs.dead:
+            cs = self._dial()
+        return cs
+
+    def _drop_conn(self, cs: Optional[_ConnState]):
+        if cs is None:
+            return
+        cs.dead = True
+        try:
+            cs.sock.close()
+        except OSError:
+            pass
+        with self._conns_lock:
+            me = threading.current_thread()
+            if self._conn_by_thread.get(me) is cs:
+                del self._conn_by_thread[me]
+        if getattr(self._local, "cs", None) is cs:
+            self._local.cs = None
+
+    def _take_tag(self, cs: _ConnState) -> int:
+        tag = cs.next_tag
+        cs.next_tag = ((tag + 1) & 0xFFFFFFFF) or 1
+        return tag
+
+    # parked replies nobody has claimed yet; beyond this, the oldest are
+    # evicted — replies for ABANDONED futures (e.g. a windowed burst cut
+    # short by an earlier error) would otherwise accumulate on the
+    # pooled connection for the client's lifetime. An evicted tag that
+    # IS later claimed fails loudly (RpcError from _wait_tag), never
+    # hangs — the dict cannot distinguish abandoned from merely
+    # slow-to-resolve, so keep in-flight call_future bursts under this.
+    DONE_PARK_LIMIT = 1024
+
+    def _park_one(self, cs: _ConnState):
+        """Read ONE reply and park it for whichever tag it answers."""
+        env, payload, rtag = _recv_msg_tagged(cs.sock)
+        if rtag is None:
+            raise ConnectionError("untagged reply on tagged connection")
+        if rtag in cs.outstanding:
+            cs.outstanding.discard(rtag)
+            cs.done[rtag] = (env, payload)
+            while len(cs.done) > self.DONE_PARK_LIMIT:
+                old = next(iter(cs.done))
+                cs.done.pop(old)
+                cs.evicted.add(old)
+                while len(cs.evicted) > 8 * self.DONE_PARK_LIMIT:
+                    cs.evicted.pop()
+        # unknown tags (abandoned futures) are dropped
+
+    def _drain_ready(self, cs: _ConnState):
+        """Park any responses already sitting in the kernel buffer.
+        Called before each pipelined SEND: if the client only ever reads
+        after its whole send burst, its unread responses can fill both
+        sockets' kernel buffers and stall the server's writer (and with
+        it the server's read-ahead semaphore) — the classic duplex-pipe
+        deadlock. Draining keeps the response direction flowing, so
+        sends never face a stalled peer."""
+        try:
+            while cs.outstanding and select.select([cs.sock], [], [], 0)[0]:
+                self._park_one(cs)
+        except ValueError:
+            # fd >= FD_SETSIZE: select() can't watch it; skip the
+            # opportunistic drain (the eventual blocking reads still
+            # make progress)
+            pass
+
+    def _wait_tag(self, cs: _ConnState, tag: int) -> tuple:
+        """Read replies until ``tag``'s arrives; replies for other
+        outstanding tags are parked for their futures. Single-owner-
+        thread demultiplexing: whoever waits drives the socket."""
+        while True:
+            if tag in cs.done:
+                return cs.done.pop(tag)
+            if tag in cs.evicted:
+                cs.evicted.discard(tag)
+                raise RpcError(
+                    f"{self.addr}: reply for tag {tag} was evicted "
+                    f"(more than {self.DONE_PARK_LIMIT} unresolved "
+                    f"futures parked on one connection)")
+            self._park_one(cs)
+
+    def call(self, method: str, payload: Payload = b"",
+             dedup: bool = False):
         """``dedup=True`` attaches a per-request id that the server uses
         to execute the request at most once (RpcServer's LRU of served
         ids): required for non-idempotent methods (gradient updates,
@@ -469,11 +879,13 @@ class RpcClient:
         delay = self.retry_backoff
         attempts_left = self.max_retries
         while True:
-            conn = getattr(self._local, "conn", None)
-            fresh = conn is None
+            cs = getattr(self._local, "cs", None)
+            if cs is not None and cs.dead:
+                cs = None
+            fresh = cs is None
             if fresh:
                 try:
-                    conn = self._local.conn = self._dial()
+                    cs = self._dial()
                 except (ConnectionError, OSError):
                     if attempts_left <= 0:
                         raise
@@ -481,21 +893,25 @@ class RpcClient:
                     time.sleep(delay)
                     delay = min(delay * 2, 5.0)
                     continue
+            others_inflight = bool(cs.outstanding)
             try:
-                _send_msg(conn, envelope, payload,
-                          getattr(self._local, "compress", True))
-                env, result = _recv_msg(conn)
+                if cs.tagged:
+                    tag = self._take_tag(cs)
+                    _send_msg(cs.sock, envelope, payload, cs.compress,
+                              tag=tag)
+                    cs.outstanding.add(tag)
+                    env, result = self._wait_tag(cs, tag)
+                else:
+                    _send_msg(cs.sock, envelope, payload, cs.compress)
+                    env, result = _recv_msg(cs.sock)
                 break
             except (ConnectionError, OSError):
-                try:
-                    conn.close()
-                except OSError:
-                    pass
-                with self._conns_lock:
-                    me = threading.current_thread()
-                    if self._conn_by_thread.get(me) is conn:
-                        del self._conn_by_thread[me]
-                self._local.conn = None
+                self._drop_conn(cs)
+                if others_inflight:
+                    # tag-matched calls were in flight on this
+                    # connection; a transparent re-send cannot know
+                    # their completion state — surface the failure
+                    raise
                 if not fresh:
                     continue  # stale pooled socket: redial once, no sleep
                 if attempts_left <= 0:
@@ -507,14 +923,49 @@ class RpcClient:
             raise RpcError(f"{self.addr} {method}: {env[1]}")
         return result
 
-    def call_many(self, method: str, payloads: List[bytes],
-                  window: int = 16) -> List[bytes]:
+    def call_future(self, method: str, payload: Payload = b"",
+                    dedup: bool = False) -> RpcFuture:
+        """Issue a request and return a tag-matched :class:`RpcFuture`
+        without waiting for the reply — many can be in flight on this
+        thread's one connection, and a tag-capable server completes them
+        out of order (no head-of-line blocking on a slow method).
+        ``result()`` must be called from this same thread. No transport
+        retry (the completed prefix of a multiplexed burst is ambiguous);
+        against a legacy untagged peer this degrades to a synchronous
+        call returning an already-completed future."""
+        import os
+
+        cs = self._conn()
+        if not cs.tagged:
+            try:
+                return RpcFuture.completed(
+                    value=self.call(method, payload, dedup=dedup))
+            except (RpcError, ConnectionError, OSError) as e:
+                return RpcFuture.completed(error=e)
+        envelope: list = [method]
+        if dedup:
+            envelope.append(os.urandom(12))
+        tag = self._take_tag(cs)
+        try:
+            self._drain_ready(cs)  # keep the reply direction flowing
+            _send_msg(cs.sock, envelope, payload, cs.compress, tag=tag,
+                      pipelined=True)
+        except (ConnectionError, OSError):
+            self._drop_conn(cs)
+            raise
+        cs.outstanding.add(tag)
+        return RpcFuture(self, cs, tag, method)
+
+    def call_many(self, method: str, payloads: List[Payload],
+                  window: int = 16) -> list:
         """Pipelined calls on this thread's pooled connection: up to
         ``window`` requests are on the wire before the first response is
-        read (responses arrive in request order — the framing has no
-        tags). Against a ``concurrent_streams`` server the requests
-        execute concurrently; against a default server they execute
-        serially but still save the per-call round-trip gaps.
+        read. On a tagged connection the server may execute and answer
+        them OUT OF ORDER (tags restore the pairing); results still
+        return in request order. On a legacy untagged connection the
+        responses arrive in request order — the framing has no tags —
+        and a ``concurrent_streams`` server still executes them
+        concurrently.
 
         The window bounds the responses the server may have to buffer
         while we are still sending (kernel-socket-buffer deadlock
@@ -525,20 +976,20 @@ class RpcClient:
         the pooled connection stays in sync for subsequent calls."""
         if not payloads:
             return []
-        conn = getattr(self._local, "conn", None)
-        if conn is None:
-            conn = self._local.conn = self._dial()
-        compress = getattr(self._local, "compress", True)
-        results: List[bytes] = []
+        cs = self._conn()
+        if cs.tagged:
+            return self._call_many_tagged(cs, method, payloads, window)
+        results: list = []
         first_err: Optional[str] = None
         try:
             i_send = 0
             while len(results) < len(payloads):
                 while (i_send < len(payloads)
                        and i_send - len(results) < window):
-                    _send_msg(conn, [method], payloads[i_send], compress)
+                    _send_msg(cs.sock, [method], payloads[i_send],
+                              cs.compress, pipelined=True)
                     i_send += 1
-                env, result = _recv_msg(conn)
+                env, result = _recv_msg(cs.sock)
                 if env[0] != "ok":
                     # keep draining: an unread tail would desynchronize
                     # the NEXT call's request/response pairing
@@ -547,15 +998,39 @@ class RpcClient:
                     result = b""
                 results.append(result)
         except (ConnectionError, OSError):
-            try:
-                conn.close()
-            except OSError:
-                pass
-            with self._conns_lock:
-                me = threading.current_thread()
-                if self._conn_by_thread.get(me) is conn:
-                    del self._conn_by_thread[me]
-            self._local.conn = None
+            self._drop_conn(cs)
+            raise
+        if first_err is not None:
+            raise RpcError(first_err)
+        return results
+
+    def _call_many_tagged(self, cs: _ConnState, method: str,
+                          payloads: List[Payload], window: int) -> list:
+        results: list = []
+        tags: List[int] = []
+        first_err: Optional[str] = None
+        try:
+            i_send = 0
+            while len(results) < len(payloads):
+                while (i_send < len(payloads)
+                       and i_send - len(results) < window):
+                    self._drain_ready(cs)  # keep the reply direction flowing
+                    tag = self._take_tag(cs)
+                    _send_msg(cs.sock, [method], payloads[i_send],
+                              cs.compress, tag=tag, pipelined=True)
+                    cs.outstanding.add(tag)
+                    tags.append(tag)
+                    i_send += 1
+                # claim in request order; out-of-order arrivals park in
+                # cs.done, so a slow request never blocks the server
+                env, result = self._wait_tag(cs, tags[len(results)])
+                if env[0] != "ok":
+                    if first_err is None:
+                        first_err = f"{self.addr} {method}: {env[1]}"
+                    result = b""
+                results.append(result)
+        except (ConnectionError, OSError):
+            self._drop_conn(cs)
             raise
         if first_err is not None:
             raise RpcError(first_err)
@@ -579,12 +1054,13 @@ class RpcClient:
         with self._conns_lock:
             conns = list(self._conn_by_thread.values())
             self._conn_by_thread.clear()
-        for conn in conns:
+        for cs in conns:
+            cs.dead = True
             try:
-                conn.close()
+                cs.sock.close()
             except OSError:
                 pass
-        self._local.conn = None
+        self._local.cs = None
 
     def __del__(self):
         try:

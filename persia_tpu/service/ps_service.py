@@ -19,24 +19,165 @@ without code changes.
 import argparse
 import os
 import threading
-from typing import Optional
+from typing import List, Optional
 
 import msgpack
 import numpy as np
 
 from persia_tpu.logger import get_default_logger
-from persia_tpu.rpc import RpcClient, RpcServer, pack_arrays, unpack_arrays
+from persia_tpu.rpc import (
+    RpcClient,
+    RpcServer,
+    pack_arrays,
+    pack_arrays_sg,
+    unpack_arrays,
+)
 from persia_tpu.service.coordinator import ROLE_PS, CoordinatorClient
 
 _logger = get_default_logger(__name__)
 
 
+class ShardParallelDispatcher:
+    """Executes holder lookups/updates in parallel across the holder's
+    INTERNAL shards (thread pool sized to ``num_internal_shards``,
+    capped at the host's core count — extra workers on a small host are
+    pure scheduling tax).
+
+    The split buckets shards with the same ``internal_shard_of`` hash
+    both store backends use, so sub-calls touch DISJOINT internal
+    shards — per-shard mutexes never contend across pool threads, and
+    every per-shard operation sequence is identical to the serial call
+    (duplicates of a sign land in one sub-batch in original order;
+    per-shard LRU/eviction order is unchanged — the parity tests pin
+    this). Effective with the native C++ holder, whose ctypes calls
+    release the GIL; the pure-Python holder computes under the GIL, so
+    it falls back to the plain serial call (``force=True`` overrides,
+    for the parity tests).
+
+    The native store already parallelizes internally for batches >=
+    NATIVE_INTERNAL_N (store.h parallel_shards, capped at 8 threads),
+    so the dispatcher only adds value where that does not reach: small
+    batches (native runs them serial) and hosts with more than 8 cores
+    (the service pool is sized to num_internal_shards).
+    """
+
+    # below this many signs the split/scatter overhead beats the win
+    MIN_PARALLEL = 512
+    # native/src/store.h parallel_shards engages at this batch size
+    # with min(8, hw_concurrency) threads
+    NATIVE_INTERNAL_N = 4096
+    NATIVE_INTERNAL_THREADS = 8
+
+    def __init__(self, holder, enabled: Optional[bool] = None,
+                 force: bool = False):
+        self.holder = holder
+        self.force = force
+        n = int(getattr(holder, "num_internal_shards", 1))
+        self._releases_gil = bool(getattr(holder, "releases_gil", False))
+        if enabled is None:
+            enabled = self._releases_gil
+        cpus = os.cpu_count() or 1
+        self._workers = min(n, max(cpus, 1))
+        # a 2-core host is already saturated by thread-per-connection
+        # request concurrency; pool.map dispatch there costs more than
+        # the split wins (measured: +26 ms/batch at bs=256 on 2 cores),
+        # so the dispatcher needs headroom to engage
+        self.enabled = bool(
+            (force or enabled)
+            and n > 1
+            and (force or cpus >= 4)
+            and os.environ.get("PERSIA_PS_SHARD_PARALLEL") != "0"
+        )
+        self._pool = None
+        if self.enabled:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = ThreadPoolExecutor(
+                max_workers=self._workers,
+                thread_name_prefix="ps-shard")
+
+    def _engage(self, n_signs: int) -> bool:
+        if not self.enabled or n_signs < self.MIN_PARALLEL:
+            return False
+        if self.force:
+            return True
+        if (self._releases_gil and n_signs >= self.NATIVE_INTERNAL_N
+                and self._workers <= self.NATIVE_INTERNAL_THREADS):
+            # the native store's own parallel_shards already covers this
+            # batch with as many threads as this host has — splitting
+            # here would only disable it and add dispatch overhead
+            return False
+        return True
+
+    def _shard_buckets(self, signs: np.ndarray) -> List[np.ndarray]:
+        from persia_tpu.ps.rng import internal_shard_of
+
+        n_shards = self.holder.num_internal_shards
+        shard_ids = internal_shard_of(signs, n_shards)
+        # contiguous shard-id ranges -> one bucket per pool worker;
+        # stable sort keeps duplicate signs in original order inside
+        # their bucket — sequential-duplicate semantics hold
+        buckets = (shard_ids * self._workers) // n_shards
+        order = np.argsort(buckets, kind="stable")
+        sorted_ids = buckets[order]
+        cuts = np.nonzero(np.diff(sorted_ids))[0] + 1
+        return np.split(order, cuts)
+
+    def lookup(self, signs: np.ndarray, dim: int,
+               training: bool) -> np.ndarray:
+        signs = np.ascontiguousarray(signs, dtype=np.uint64)
+        if not self._engage(len(signs)):
+            return self.holder.lookup(signs, dim, training)
+        groups = self._shard_buckets(signs)
+        if len(groups) <= 1:
+            return self.holder.lookup(signs, dim, training)
+        out = np.empty((len(signs), dim), dtype=np.float32)
+
+        def run(sel):
+            out[sel] = self.holder.lookup(signs[sel], dim, training)
+
+        # pool.map raises the first sub-call error after all complete
+        list(self._pool.map(run, groups))
+        return out
+
+    def update_gradients(self, signs: np.ndarray, grads: np.ndarray,
+                         dim: int):
+        signs = np.ascontiguousarray(signs, dtype=np.uint64)
+        grads = np.ascontiguousarray(grads, dtype=np.float32)
+        if not self._engage(len(signs)):
+            return self.holder.update_gradients(signs, grads, dim)
+        groups = self._shard_buckets(signs)
+        if len(groups) <= 1:
+            return self.holder.update_gradients(signs, grads, dim)
+
+        def run(sel):
+            self.holder.update_gradients(signs[sel], grads[sel], dim)
+
+        list(self._pool.map(run, groups))
+
+    def close(self):
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+
+
 class PsService:
     def __init__(self, holder, host: str = "127.0.0.1", port: int = 0,
-                 inc_dumper=None):
+                 inc_dumper=None, shard_parallel: Optional[bool] = None,
+                 concurrent_streams: int = 8, legacy_frames: bool = False):
         self.holder = holder
         self.inc_dumper = inc_dumper
-        self.server = RpcServer(host, port)
+        # concurrent_streams opts into the per-connection dispatch pool:
+        # a multiplexing worker (tagged framing) gets out-of-order
+        # completion, so one slow lookup never convoys the connection;
+        # legacy blocking clients see the exact serial behavior
+        self.server = RpcServer(host, port,
+                                concurrent_streams=concurrent_streams)
+        self._dispatch = ShardParallelDispatcher(holder,
+                                                 enabled=shard_parallel)
+        # legacy_frames reverts responses to the concatenating
+        # pack_arrays — the pre-zero-copy plane, kept as the A/B lever
+        # for bench.py --mode worker's serialized baseline
+        self._pack = pack_arrays if legacy_frames else pack_arrays_sg
         self.status = "Idle"  # Idle | Dumping | Loading | Failed (model mgr)
         self._status_lock = threading.Lock()
         s = self.server
@@ -59,6 +200,10 @@ class PsService:
     def addr(self):
         return self.server.addr
 
+    def stop(self):
+        self.server.stop()
+        self._dispatch.close()
+
     def _configure(self, payload: bytes) -> bytes:
         req = msgpack.unpackb(payload, raw=False)
         self.holder.configure(
@@ -79,12 +224,14 @@ class PsService:
 
     def _lookup(self, payload: bytes) -> bytes:
         meta, (signs,) = unpack_arrays(payload)
-        out = self.holder.lookup(signs, meta["dim"], meta["training"])
-        return pack_arrays({}, [out])
+        out = self._dispatch.lookup(signs, meta["dim"], meta["training"])
+        # scatter-gather response (default): the (n, dim) result goes
+        # to the socket without a tobytes() concatenation copy
+        return self._pack({}, [out])
 
     def _update_gradients(self, payload: bytes) -> bytes:
         meta, (signs, grads) = unpack_arrays(payload)
-        self.holder.update_gradients(signs, grads, meta["dim"])
+        self._dispatch.update_gradients(signs, grads, meta["dim"])
         if self.inc_dumper is not None:
             self.inc_dumper.commit(signs)
         return b""
@@ -111,7 +258,7 @@ class PsService:
         meta, (signs,) = unpack_arrays(payload)
         found, vecs = self.holder.get_entries(
             signs, meta["width"])
-        return pack_arrays({}, [found.astype(np.uint8), vecs])
+        return self._pack({}, [found.astype(np.uint8), vecs])
 
     def _set_entries(self, payload: bytes) -> bytes:
         meta, (signs, vecs) = unpack_arrays(payload)
@@ -177,11 +324,23 @@ class PsService:
 
 
 class PsClient:
-    """RPC twin of the in-process holder interface."""
+    """RPC twin of the in-process holder interface.
 
-    def __init__(self, addr: str):
+    ``enable_tags`` (default) negotiates tagged framing per connection:
+    lookups/updates can then be issued as futures
+    (:meth:`lookup_future` / :meth:`update_gradients_future`) that
+    multiplex on one socket, and a dispatch-pool server completes them
+    out of order. Legacy servers (e.g. the C++ ``ps_server``) negotiate
+    down transparently; the future methods then degrade to synchronous
+    calls."""
+
+    def __init__(self, addr: str, enable_tags: bool = True,
+                 legacy_frames: bool = False):
         self.addr = addr
-        self.client = RpcClient(addr)
+        self.client = RpcClient(addr, enable_tags=enable_tags)
+        # legacy_frames reverts request framing to the concatenating
+        # pack_arrays (pre-zero-copy A/B lever; see PsService)
+        self._pack = pack_arrays if legacy_frames else pack_arrays_sg
 
     def configure(self, init_method, init_params, admit_probability=1.0,
                   weight_bound=10.0, enable_weight_bound=True):
@@ -198,18 +357,52 @@ class PsClient:
         )
 
     def lookup(self, signs: np.ndarray, dim: int, training: bool) -> np.ndarray:
-        payload = pack_arrays({"dim": int(dim), "training": bool(training)},
-                              [np.ascontiguousarray(signs, np.uint64)])
+        payload = self._pack({"dim": int(dim), "training": bool(training)},
+                                 [np.ascontiguousarray(signs, np.uint64)])
         _, (out,) = unpack_arrays(self.client.call("lookup", payload))
         return out.reshape(len(signs), dim)
 
+    def lookup_future(self, signs: np.ndarray, dim: int, training: bool):
+        """Issue the lookup without waiting; returns a zero-arg resolver
+        producing the (n, dim) matrix. Multiple in-flight lookups
+        multiplex on this thread's one connection (tag-matched), so a
+        slow (shard, dim) group no longer blocks the fast ones."""
+        n = len(signs)
+        payload = self._pack({"dim": int(dim), "training": bool(training)},
+                                 [np.ascontiguousarray(signs, np.uint64)])
+        fut = self.client.call_future("lookup", payload)
+
+        def resolve() -> np.ndarray:
+            _, (out,) = unpack_arrays(fut.result())
+            return out.reshape(n, dim)
+
+        return resolve
+
     def update_gradients(self, signs: np.ndarray, grads: np.ndarray, dim: int):
-        payload = pack_arrays({"dim": int(dim)}, [
+        payload = self._pack({"dim": int(dim)}, [
             np.ascontiguousarray(signs, np.uint64),
             np.ascontiguousarray(grads, np.float32),
         ])
         # non-idempotent: dedup id makes the retry at-most-once server-side
+        # (blocking path keeps the client's full retry-with-backoff)
         self.client.call("update_gradients", payload, dedup=True)
+
+    def update_gradients_future(self, signs: np.ndarray, grads: np.ndarray,
+                                dim: int):
+        """Issue the gradient push without waiting; returns a zero-arg
+        resolver that raises on failure. Already-aggregated groups ship
+        while later ones are still aggregating (worker streaming)."""
+        payload = self._pack({"dim": int(dim)}, [
+            np.ascontiguousarray(signs, np.uint64),
+            np.ascontiguousarray(grads, np.float32),
+        ])
+        # non-idempotent: dedup id makes the retry at-most-once server-side
+        fut = self.client.call_future("update_gradients", payload, dedup=True)
+
+        def resolve():
+            fut.result()
+
+        return resolve
 
     def __len__(self) -> int:
         return msgpack.unpackb(self.client.call("len"), raw=False)["len"]
@@ -228,7 +421,7 @@ class PsClient:
         ))
 
     def get_entries(self, signs: np.ndarray, width: int):
-        payload = pack_arrays({"width": int(width)}, [
+        payload = self._pack({"width": int(width)}, [
             np.ascontiguousarray(signs, np.uint64)])
         _, (found, vecs) = unpack_arrays(
             self.client.call("get_entries", payload))
@@ -236,7 +429,7 @@ class PsClient:
                 vecs.reshape(len(signs), width).astype(np.float32))
 
     def set_entries(self, signs: np.ndarray, dim: int, vecs: np.ndarray):
-        self.client.call("set_entries", pack_arrays({"dim": int(dim)}, [
+        self.client.call("set_entries", self._pack({"dim": int(dim)}, [
             np.ascontiguousarray(signs, np.uint64),
             np.ascontiguousarray(vecs, np.float32),
         ]), dedup=True)
@@ -279,6 +472,13 @@ def main():
     p.add_argument("--addr-file", default=None,
                    help="write the bound address here after listen (with "
                         "--port 0: race-free port handoff to a parent)")
+    p.add_argument("--concurrent-streams", type=int,
+                   default=int(os.environ.get(
+                       "PERSIA_PS_CONCURRENT_STREAMS", 8)),
+                   help="per-connection dispatch pool depth (1 = the "
+                        "legacy strictly-serial per-connection loop); "
+                        "shard-parallel execution is controlled "
+                        "separately by PERSIA_PS_SHARD_PARALLEL=0/1")
     args = p.parse_args()
     from persia_tpu.tracing import start_deadlock_detection
 
@@ -304,7 +504,11 @@ def main():
                 buffer_size=gc.parameter_server.incremental_buffer_size,
                 replica_index=args.replica_index,
             )
-    service = PsService(holder, args.host, args.port, inc_dumper=inc_dumper)
+    service = PsService(
+        holder, args.host, args.port, inc_dumper=inc_dumper,
+        concurrent_streams=args.concurrent_streams,
+        # A/B lever for the worker-cycle bench's serialized baseline
+        legacy_frames=os.environ.get("PERSIA_PS_LEGACY_FRAMES") == "1")
     if args.initial_checkpoint:
         holder.load_file(args.initial_checkpoint)
         _logger.info("loaded initial checkpoint from %s",

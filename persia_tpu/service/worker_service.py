@@ -44,9 +44,13 @@ _logger = get_default_logger(__name__)
 
 class WorkerService:
     def __init__(self, worker: EmbeddingWorker, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, concurrent_streams: int = 8):
         self.worker = worker
-        self.server = RpcServer(host, port)
+        # dispatch pool: a pipelining trainer/data-loader connection
+        # (tagged framing) gets out-of-order completion, so one slow
+        # lookup fan-out does not convoy the next batch's ingestion
+        self.server = RpcServer(host, port,
+                                concurrent_streams=concurrent_streams)
         s = self.server
         s.register("forward_batched", self._forward_batched)
         s.register("forward_batch_id", self._forward_batch_id)
@@ -83,11 +87,11 @@ class WorkerService:
     def _lookup_signs(self, payload: bytes) -> bytes:
         """Dedup'd eval row lookup — the inference hot-row cache's miss
         fetch (read-only: absent signs zero-fill, nothing is created)."""
-        from persia_tpu.rpc import pack_arrays, unpack_arrays
+        from persia_tpu.rpc import pack_arrays_sg, unpack_arrays
 
         meta, (signs,) = unpack_arrays(payload)
         rows = self.worker.lookup_signs(signs, meta["dim"])
-        return pack_arrays({}, [rows])
+        return pack_arrays_sg({}, [rows])
 
     def _update_gradients(self, payload: bytes) -> bytes:
         meta, grads = ser.unpack_gradients(payload)

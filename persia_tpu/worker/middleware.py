@@ -259,25 +259,42 @@ def _feature_runs(feature_idx: np.ndarray):
         yield int(a), int(b), int(feature_idx[a])
 
 
+def alloc_lookup_mats(
+    feats: List[DedupedFeature], schema: EmbeddingSchema
+) -> List[np.ndarray]:
+    """Per-feature (num_distinct, dim) result matrices for the scatter."""
+    return [
+        np.zeros((f.num_distinct, schema.get_slot(f.name).dim), dtype=np.float32)
+        for f in feats
+    ]
+
+
+def scatter_group(mats: List[np.ndarray], group: ShardGroup,
+                  res: np.ndarray):
+    """Scatter ONE shard group's lookup result into the per-feature
+    matrices — called per group as its RPC completes, so fast shards'
+    results land while slow shards are still in flight. Groups partition
+    the distinct signs, so concurrent scatters from different fan-out
+    threads write disjoint rows."""
+    res = np.ascontiguousarray(res, dtype=np.float32)
+    native = _mw_native()
+    for a, b, fi in _feature_runs(group.feature_idx):
+        if native is not None:
+            native.scatter_rows(mats[fi], group.distinct_idx[a:b],
+                                res[a:b], group.dim)
+        else:
+            mats[fi][group.distinct_idx[a:b]] = res[a:b]
+
+
 def scatter_lookup_results(
     feats: List[DedupedFeature], schema: EmbeddingSchema,
     groups: List[ShardGroup], results: List[np.ndarray],
 ) -> List[np.ndarray]:
     """Assemble per-feature (num_distinct, dim) embedding matrices from the
     per-shard lookup results."""
-    mats = [
-        np.zeros((f.num_distinct, schema.get_slot(f.name).dim), dtype=np.float32)
-        for f in feats
-    ]
-    native = _mw_native()
+    mats = alloc_lookup_mats(feats, schema)
     for group, res in zip(groups, results):
-        res = np.ascontiguousarray(res, dtype=np.float32)
-        for a, b, fi in _feature_runs(group.feature_idx):
-            if native is not None:
-                native.scatter_rows(mats[fi], group.distinct_idx[a:b],
-                                    res[a:b], group.dim)
-            else:
-                mats[fi][group.distinct_idx[a:b]] = res[a:b]
+        scatter_group(mats, group, res)
     return mats
 
 
@@ -419,10 +436,20 @@ def shard_gradients(
     re-grouping every sign. Returns a list of (shard, dim, signs, grads)."""
     if groups is None:
         groups = shard_split(feats, schema, replica_size)
-    out = []
-    for g in groups:
-        grads = np.empty((len(g.signs), g.dim), dtype=np.float32)
-        for a, b, fi in _feature_runs(g.feature_idx):
-            grads[a:b] = per_feature_grads[fi][g.distinct_idx[a:b]]
-        out.append((g.shard, g.dim, g.signs, grads))
-    return out
+    return [
+        (g.shard, g.dim, g.signs, gather_group_grads(g, per_feature_grads))
+        for g in groups
+    ]
+
+
+def gather_group_grads(group: ShardGroup,
+                       per_feature_grads: List[np.ndarray]) -> np.ndarray:
+    """ONE shard group's (m, dim) gradient matrix from the per-feature
+    aggregates. feature_idx is nondecreasing (shard_split concatenates
+    features in order), so a group is ready as soon as its LAST feature
+    has aggregated — the streaming update path ships it then, while
+    later features are still aggregating."""
+    grads = np.empty((len(group.signs), group.dim), dtype=np.float32)
+    for a, b, fi in _feature_runs(group.feature_idx):
+        grads[a:b] = per_feature_grads[fi][group.distinct_idx[a:b]]
+    return grads

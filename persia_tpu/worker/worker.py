@@ -36,8 +36,23 @@ class ForwardBufferFull(RuntimeError):
     """Backpressure signal to data-loaders (reference mod.rs:1519-1521)."""
 
 
+_WORKER_SEQ = [0]
+_WORKER_SEQ_LOCK = threading.Lock()
+
+
 class EmbeddingWorker:
     """Stateless-ish middleware between trainers and parameter servers."""
+
+    # multiplex a replica's (shard,dim) group lookups on one connection
+    # only when there are at least this many — below it, a fan-out
+    # thread per group (server answers inline on the reader thread) is
+    # cheaper than the server-side dispatch pool
+    MUX_MIN_GROUPS = 3
+    # in-flight bound per multiplexed connection: keeps the replica's
+    # concurrent handler count comparable to the thread-per-group plane
+    # (unbounded fan-in made insert-heavy lookups CONTEND on the
+    # store's shard mutexes and the allocator, measured slower)
+    MUX_WINDOW = 2
 
     def __init__(
         self,
@@ -47,6 +62,7 @@ class EmbeddingWorker:
         buffered_data_expired_sec: int = 1800,
         enable_monitor: bool = False,
         ps_resolver=None,
+        streaming: Optional[bool] = None,
     ):
         self.schema = schema
         self.ps_clients = list(ps_clients)
@@ -98,10 +114,32 @@ class EmbeddingWorker:
         self.monitor = DistinctIdMonitor() if enable_monitor else None
         from persia_tpu.metrics import default_registry
 
+        # Streaming data plane (default on): per-(shard,dim) lookup
+        # results scatter into the output as each RPC completes, and
+        # aggregated gradient groups ship while later features are still
+        # aggregating. streaming=False restores the gather-then-scatter /
+        # aggregate-then-ship serialized plane (the bench baseline).
+        if streaming is None:
+            import os as _os
+
+            streaming = _os.environ.get("PERSIA_WORKER_STREAMING") != "0"
+        self.streaming = bool(streaming)
         reg = default_registry()
-        self._t_preprocess = reg.histogram("lookup_preprocess_time_cost_sec")
-        self._t_rpc = reg.histogram("lookup_rpc_time_cost_sec")
-        self._t_postprocess = reg.histogram("lookup_postprocess_time_cost_sec")
+        # each worker instance gets its own labeled series so two
+        # workers in one process (e.g. the bench's A/B stacks) don't
+        # blend their stage timings; the metric NAMES stay the
+        # reference's (grafana dashboard contract)
+        with _WORKER_SEQ_LOCK:
+            _WORKER_SEQ[0] += 1
+            labels = {"worker": str(_WORKER_SEQ[0])}
+        self._t_preprocess = reg.histogram(
+            "lookup_preprocess_time_cost_sec", labels)
+        self._t_rpc = reg.histogram("lookup_rpc_time_cost_sec", labels)
+        self._t_postprocess = reg.histogram(
+            "lookup_postprocess_time_cost_sec", labels)
+        self._t_aggregate = reg.histogram(
+            "update_aggregate_time_cost_sec", labels)
+        self._t_ship = reg.histogram("update_ship_time_cost_sec", labels)
         # periodic expiry sweep — ingestion-piggybacked expiry alone never
         # fires once the loaders die (see _sweep_loop)
         self._sweep_stop = threading.Event()
@@ -183,6 +221,37 @@ class EmbeddingWorker:
         """Stop the background sweep (tests; services just exit)."""
         self._sweep_stop.set()
 
+    # --- observability ---------------------------------------------------
+
+    STAGE_NAMES = ("preprocess", "rpc", "postprocess", "aggregate", "ship")
+
+    def _stage_hists(self):
+        return {
+            "preprocess": self._t_preprocess,
+            "rpc": self._t_rpc,
+            "postprocess": self._t_postprocess,
+            "aggregate": self._t_aggregate,
+            "ship": self._t_ship,
+        }
+
+    def stage_snapshot(self) -> Dict[str, tuple]:
+        """(count, total_sec) per worker-cycle stage. The histograms are
+        process-shared through the metrics registry, so benchmarks diff
+        two snapshots to attribute time to a bounded region."""
+        return {k: h.snapshot() for k, h in self._stage_hists().items()}
+
+    @staticmethod
+    def stage_breakdown(before: Dict[str, tuple],
+                        after: Dict[str, tuple]) -> Dict[str, dict]:
+        """Per-stage {count, total_sec, avg_ms} between two snapshots."""
+        out = {}
+        for k in before:
+            n = after[k][0] - before[k][0]
+            sec = after[k][1] - before[k][1]
+            out[k] = {"count": n, "total_sec": round(sec, 4),
+                      "avg_ms": round(sec / n * 1e3, 3) if n else 0.0}
+        return out
+
     # --- trainer side ----------------------------------------------------
 
     def lookup(self, ref_id: int, training: bool = True) -> Dict[str, object]:
@@ -233,23 +302,81 @@ class EmbeddingWorker:
                 self.monitor.observe(f.name, f.distinct_signs)
         with self._t_preprocess.timer():
             groups = mw.shard_split(feats, self.schema, self.replica_size)
-        def do_lookup():
+            mats = mw.alloc_lookup_mats(feats, self.schema)
+
+        def do_lookup_serialized():
+            # legacy plane: gather every shard's result, then scatter
             if self._fanout is None or len(groups) <= 1:
-                return [
+                results = [
                     self.ps_clients[g.shard].lookup(g.signs, g.dim, training)
                     for g in groups
                 ]
-            return list(self._fanout.map(
-                lambda g: self.ps_clients[g.shard].lookup(
-                    g.signs, g.dim, training),
-                groups,
-            ))
+            else:
+                results = list(self._fanout.map(
+                    lambda g: self.ps_clients[g.shard].lookup(
+                        g.signs, g.dim, training),
+                    groups,
+                ))
+            for g, res in zip(groups, results):
+                mw.scatter_group(mats, g, res)
 
+        def do_lookup_streaming():
+            # one fan-out task per REPLICA; inside it, the replica's
+            # (shard,dim) groups multiplex on the thread's one
+            # connection (PsClient.lookup_future, tag-matched) and each
+            # result scatters the moment it arrives — no gather
+            # barrier, and a slow shard never convoys the fast ones.
+            # Below MUX_MIN_GROUPS the per-request dispatch-pool cost
+            # on the server outweighs the saved connections (measured),
+            # so few-group replicas run one blocking task per group
+            # instead — still scatter-on-completion. Groups partition
+            # the distinct signs, so cross-thread scatters are
+            # disjoint.
+            by_shard: Dict[int, list] = {}
+            for g in groups:
+                by_shard.setdefault(g.shard, []).append(g)
+
+            def run_group(g):
+                mw.scatter_group(
+                    mats, g,
+                    self.ps_clients[g.shard].lookup(g.signs, g.dim,
+                                                    training))
+
+            def run_shard_mux(gs):
+                client = self.ps_clients[gs[0].shard]
+                pend = []
+                for g in gs:
+                    if len(pend) >= self.MUX_WINDOW:
+                        pg, resolve = pend.pop(0)
+                        mw.scatter_group(mats, pg, resolve())
+                    pend.append(
+                        (g, client.lookup_future(g.signs, g.dim, training)))
+                for g, resolve in pend:
+                    mw.scatter_group(mats, g, resolve())
+
+            tasks = []
+            for gs in by_shard.values():
+                can_mux = hasattr(self.ps_clients[gs[0].shard],
+                                  "lookup_future")
+                if can_mux and len(gs) >= self.MUX_MIN_GROUPS:
+                    tasks.append((run_shard_mux, gs))
+                else:
+                    tasks.extend((run_group, g) for g in gs)
+            if self._fanout is None or len(tasks) <= 1:
+                for fn, arg in tasks:
+                    fn(arg)
+                return
+            futures = [self._fanout.submit(fn, arg) for fn, arg in tasks]
+            for f in futures:
+                f.result()
+
+        # retries re-scatter every group into the same mats (idempotent
+        # row overwrites), so a mid-fan-out failure is safe either way
+        do_lookup = (do_lookup_streaming if self.streaming
+                     else do_lookup_serialized)
         with self._t_rpc.timer():
-            results = self._with_ps_retry(do_lookup)
+            self._with_ps_retry(do_lookup)
         with self._t_postprocess.timer():
-            mats = mw.scatter_lookup_results(feats, self.schema, groups,
-                                             results)
             out = {}
             for feat, mat in zip(feats, mats):
                 slot = self.schema.get_slot(feat.name)
@@ -282,18 +409,76 @@ class EmbeddingWorker:
 
     def _update_gradients_inner(self, ref_id, item, grads, loss_scale):
         feats, fwd_groups, _ = item
-        per_feature = []
+        # validate up front: a missing gradient must fail BEFORE any
+        # group ships (the streaming path ships incrementally)
         for feat in feats:
-            slot = self.schema.get_slot(feat.name)
             if feat.name not in grads:
                 raise KeyError(f"missing gradient for feature {feat.name!r}")
-            per_feature.append(
-                mw.aggregate_gradients(feat, slot, grads[feat.name], loss_scale)
+        if not self.streaming or self._fanout is None:
+            self._update_gradients_serialized(feats, fwd_groups, grads,
+                                              loss_scale)
+            return
+        groups = fwd_groups if fwd_groups is not None else mw.shard_split(
+            feats, self.schema, self.replica_size)
+        # a group is shippable once its LAST feature (feature_idx is
+        # nondecreasing) has aggregated
+        by_last: Dict[int, list] = {}
+        for g in groups:
+            last_fi = int(g.feature_idx[-1]) if len(g.feature_idx) else 0
+            by_last.setdefault(last_fi, []).append(g)
+        if len(by_last) <= 1:
+            # uniform-dim schema: every group waits for the last feature
+            # anyway, so "streaming" would only interleave gather with
+            # ship threads for no overlap — the batch path is strictly
+            # better
+            self._update_gradients_serialized(feats, fwd_groups, grads,
+                                              loss_scale)
+            return
+
+        def do_update_streaming():
+            futures = []
+            per_feature: list = [None] * len(feats)
+            agg_sec = 0.0
+            for fi, feat in enumerate(feats):
+                t0 = time.perf_counter()
+                per_feature[fi] = mw.aggregate_gradients(
+                    feat, self.schema.get_slot(feat.name), grads[feat.name],
+                    loss_scale)
+                ready = [(g, mw.gather_group_grads(g, per_feature))
+                         for g in by_last.get(fi, ())]
+                agg_sec += time.perf_counter() - t0
+                # ship already-aggregated groups while the remaining
+                # features are still aggregating (fan-out threads do the
+                # blocking sends; aggregation continues on this thread)
+                for g, gmat in ready:
+                    futures.append(self._fanout.submit(
+                        self._ship_group, g.shard, g.signs, gmat, g.dim))
+            self._t_aggregate.observe(agg_sec)
+            with self._t_ship.timer():
+                for f in futures:
+                    f.result()
+
+        # on retry the whole closure re-runs: groups that applied before
+        # the failure may re-apply (fresh dedup ids per call) — the same
+        # rare, bounded imprecision the restore-path already documents
+        self._with_ps_retry(do_update_streaming)
+
+    def _ship_group(self, shard, signs, gmat, dim):
+        self.ps_clients[shard].update_gradients(signs, gmat, dim)
+
+    def _update_gradients_serialized(self, feats, fwd_groups, grads,
+                                     loss_scale):
+        """Legacy plane: aggregate everything, then ship every group."""
+        with self._t_aggregate.timer():
+            per_feature = [
+                mw.aggregate_gradients(feat, self.schema.get_slot(feat.name),
+                                       grads[feat.name], loss_scale)
+                for feat in feats
+            ]
+            shard_groups = mw.shard_gradients(
+                feats, self.schema, per_feature, self.replica_size,
+                groups=fwd_groups,
             )
-        shard_groups = mw.shard_gradients(
-            feats, self.schema, per_feature, self.replica_size,
-            groups=fwd_groups,
-        )
 
         def do_update():
             if self._fanout is None or len(shard_groups) <= 1:
@@ -310,7 +495,8 @@ class EmbeddingWorker:
             for f in futures:
                 f.result()
 
-        self._with_ps_retry(do_update)
+        with self._t_ship.timer():
+            self._with_ps_retry(do_update)
 
     def _with_ps_retry(self, fn):
         """Run a PS fan-out, recovering from replica failures

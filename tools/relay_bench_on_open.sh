@@ -30,7 +30,15 @@ while [ "$(date +%s)" -lt "$END" ]; do
         tests/test_flash_attention.py -q -s
       # 3. attn bench: xla-scan vs pallas TFLOP/s
       step "bench attn" python bench.py --mode attn --max-seconds 1100
-      # 4. re-capture the headline near the end of the window
+      # 4. CPU-tier data-plane numbers on the TPU host (PR 2): the rpc
+      #    microbench (serialized vs multiplexed vs zero-copy vs
+      #    skew-OOO) and the worker-cycle breakdown — both host-only,
+      #    but the TPU host's core count is what the overlapped plane
+      #    was built for (the 2-core dev box saturates; BASELINE.md
+      #    round 7 documents the split)
+      step "bench rpc (data plane)" python bench.py --mode rpc --max-seconds 900
+      step "bench worker (cycle breakdown)" python bench.py --mode worker --max-seconds 1100
+      # 5. re-capture the headline near the end of the window
       step "re-capture: python bench.py" python bench.py
       echo "$(date -u +%FT%TZ) chip sequence complete — see BENCH_CAPTURE_r05.log" >> "$LOG"
       exit 0
