@@ -507,7 +507,8 @@ def bench_rpc(batch_size, steps, smoke=False):
     return results[rows]["skew-ooo"]["msgs_per_sec"], hol, results
 
 
-def _worker_rpc_stack(schema, n_ps, overlapped):
+def _worker_rpc_stack(schema, n_ps, overlapped, extra_env=None,
+                      collect_http=False):
     """Build one worker + a REAL PS-process stack (subprocess per
     replica — in-process services would share the worker's GIL and
     measure a topology that never ships) with the data plane either
@@ -515,7 +516,10 @@ def _worker_rpc_stack(schema, n_ps, overlapped):
     framing, in-order servers, serial shard execution,
     gather-then-scatter worker) or fully overlapped (tagged
     multiplexing, dispatch-pool servers, shard-parallel PS execution,
-    zero-copy framing, streaming worker)."""
+    zero-copy framing, streaming worker). ``extra_env`` adds env vars to
+    the PS subprocesses (trace mode sets PERSIA_TRACING=1);
+    ``collect_http`` also hands back each replica's observability
+    sidecar address (the third element of the teardown tuple)."""
     import subprocess
     import tempfile
 
@@ -525,33 +529,47 @@ def _worker_rpc_stack(schema, n_ps, overlapped):
     env = dict(os.environ)
     env["PERSIA_PS_SHARD_PARALLEL"] = "1" if overlapped else "0"
     env["PERSIA_PS_LEGACY_FRAMES"] = "0" if overlapped else "1"
+    env.update(extra_env or {})
     env.pop("JAX_PLATFORMS", None)  # the PS binary never touches jax
     procs = []
     addr_files = []
+    http_files = []
     here = os.path.dirname(os.path.abspath(__file__))
+
+    def tmpname():
+        f = tempfile.NamedTemporaryFile(suffix=".addr", delete=False)
+        f.close()
+        os.unlink(f.name)
+        return f.name
+
+    def read_addr(path, deadline):
+        while not os.path.exists(path):
+            if time.monotonic() > deadline:
+                raise RuntimeError("PS replica failed to start")
+            time.sleep(0.05)
+        with open(path) as fh:
+            addr = fh.read().strip()
+        os.unlink(path)
+        return addr
+
     try:
         for i in range(n_ps):
-            f = tempfile.NamedTemporaryFile(suffix=".addr", delete=False)
-            f.close()
-            os.unlink(f.name)
-            addr_files.append(f.name)
+            addr_files.append(tmpname())
+            argv = [sys.executable, "-m", "persia_tpu.service.ps_service",
+                    "--port", "0", "--replica-index", str(i),
+                    "--replica-size", str(n_ps),
+                    "--addr-file", addr_files[-1],
+                    "--concurrent-streams", "16" if overlapped else "1"]
+            if collect_http:
+                http_files.append(tmpname())
+                argv += ["--http-port", "0",
+                         "--http-addr-file", http_files[-1]]
             procs.append(subprocess.Popen(
-                [sys.executable, "-m", "persia_tpu.service.ps_service",
-                 "--port", "0", "--replica-index", str(i),
-                 "--replica-size", str(n_ps), "--addr-file", f.name,
-                 "--concurrent-streams", "16" if overlapped else "1"],
-                env=env, cwd=here,
+                argv, env=env, cwd=here,
                 stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
-        addrs = []
         deadline = time.monotonic() + 60
-        for path in addr_files:
-            while not os.path.exists(path):
-                if time.monotonic() > deadline:
-                    raise RuntimeError("PS replica failed to start")
-                time.sleep(0.05)
-            with open(path) as fh:
-                addrs.append(fh.read().strip())
-            os.unlink(path)
+        addrs = [read_addr(p, deadline) for p in addr_files]
+        http_addrs = [read_addr(p, deadline) for p in http_files]
     except BaseException:
         for p in procs:  # don't orphan already-spawned replicas
             p.kill()
@@ -566,7 +584,7 @@ def _worker_rpc_stack(schema, n_ps, overlapped):
         "type": "adagrad", "lr": 0.02, "initialization": 0.1,
         "g_square_momentum": 1.0, "vectorwise_shared": False,
     })
-    return worker, (clients, procs)
+    return worker, (clients, procs, http_addrs)
 
 
 def _worker_cycle_rpc_compare(batch_size, steps, n_ps, dim):
@@ -664,7 +682,7 @@ def _worker_cycle_rpc_compare(batch_size, steps, n_ps, dim):
             worker.close()
         return out
     finally:
-        for _, (clients, procs) in stacks.values():
+        for _, (clients, procs, _http) in stacks.values():
             for c in clients:
                 c.shutdown()
             for p in procs:
@@ -749,6 +767,156 @@ def bench_worker(batch_size, steps, n_ps=2, dim=DIM, rpc_paths=True):
                 f"{base_ms:.1f} -> {over_ms:.1f} ms/batch; median of "
                 f"paired interleaved rounds)")
     return steps * batch_size / elapsed
+
+
+def bench_trace(batch_size, steps, n_ps=2, dim=DIM,
+                trace_out="/tmp/persia_trace_capture.json"):
+    """Observability-mode bench: a REAL worker + PS-subprocess cycle
+    with tracing OFF vs ON, interleaved per round (same pairing
+    discipline as the PR-2 compare — this host's noise drifts), plus a
+    merged multi-process Chrome-trace export.
+
+    Reports (1) the tracing-on overhead vs the disabled path (the
+    disabled path IS the PR-2 data plane: every span site no-ops and
+    the ``__trace__`` probe is never sent, so its wire is
+    byte-identical), (2) the per-span breakdown of a traced cycle, and
+    (3) writes a Chrome-trace JSON where the driver's step span, the
+    worker stages, and BOTH PS replicas' handler spans share one
+    trace_id — the artifact the next perf PR reads."""
+    import statistics
+    import urllib.request
+
+    from persia_tpu import tracing
+    from persia_tpu.config import EmbeddingSchema, SlotConfig
+    from persia_tpu.data.batch import IDTypeFeatureWithSingleID
+
+    # mixed dims: several (shard, dim) groups per replica, so the traced
+    # cycle exercises the multiplexed fan-out paths the spans exist for
+    dims = (dim // 2, dim, 2 * dim, 4 * dim)
+    schema = EmbeddingSchema(slots_config={
+        f"slot_{s}": SlotConfig(name=f"slot_{s}", dim=dims[s % len(dims)])
+        for s in range(NUM_SLOTS)
+    })
+    rng = np.random.default_rng(0)
+
+    def batch():
+        return [
+            IDTypeFeatureWithSingleID(
+                f"slot_{s}",
+                rng.integers(0, 1 << 40, size=batch_size,
+                             dtype=np.uint64))
+            for s in range(NUM_SLOTS)
+        ]
+
+    tracing.set_service_name("trainer")
+    worker, (clients, procs, http_addrs) = _worker_rpc_stack(
+        schema, n_ps, overlapped=True,
+        extra_env={"PERSIA_TRACING": "1"}, collect_http=True)
+
+    def cycle(b):
+        ref = worker.put_batch(b)
+        lk = worker.lookup(ref)
+        worker.update_gradients(
+            ref, {k: v.embeddings for k, v in lk.items()})
+
+    def set_tracing(on):
+        """Toggle + force a redial so the per-connection __trace__
+        negotiation matches the new state (one untimed cycle redials
+        every pooled connection before the timed ones)."""
+        tracing.enable_tracing(on)
+        for c in clients:
+            c.client.close()
+        cycle(batch())
+
+    try:
+        for _ in range(3):
+            cycle(batch())
+        rounds = max(6, steps // 2)
+        per_round_steps = 2
+        times = {"off": [], "on": []}
+        for r in range(rounds):
+            round_batches = [batch() for _ in range(per_round_steps)]
+            for phase in (("off", "on") if r % 2 == 0 else ("on", "off")):
+                set_tracing(phase == "on")
+                t0 = time.perf_counter()
+                for b in round_batches:
+                    if phase == "on":
+                        with tracing.span("trainer/step", root=True):
+                            cycle(b)
+                    else:
+                        cycle(b)
+                times[phase].append(
+                    (time.perf_counter() - t0) / per_round_steps)
+        off_ms = statistics.median(times["off"]) * 1e3
+        on_ms = statistics.median(times["on"]) * 1e3
+        overhead_pct = (on_ms / off_ms - 1.0) * 100.0
+        log(f"trace: worker cycle {off_ms:.1f} ms/batch untraced, "
+            f"{on_ms:.1f} ms/batch traced ({overhead_pct:+.1f}% overhead, "
+            f"median of {rounds} paired interleaved rounds)")
+
+        # one final fully-traced cycle -> the exported artifact
+        set_tracing(True)
+        tracing.default_collector().clear()
+        with tracing.span("trainer/step", root=True) as root:
+            cycle(batch())
+        local = [s.to_dict() for s in tracing.default_collector().recent()]
+        remote = []
+        for addr in http_addrs:
+            with urllib.request.urlopen(
+                    f"http://{addr}/trace?n=8192&format=raw",
+                    timeout=10) as resp:
+                remote.extend(json.loads(resp.read()))
+        trace_hex = f"{root.trace_id:016x}"
+        merged = [s for s in local + remote if s["trace_id"] == trace_hex]
+        with open(trace_out, "w") as f:
+            json.dump(tracing.chrome_trace(merged), f)
+
+        # validate the acceptance property: one trace_id, resolvable
+        # parentage, spans from the driver + worker stages + every PS
+        by_id = {s["span_id"]: s for s in merged}
+        orphans = [s["name"] for s in merged
+                   if s["parent_id"] and s["parent_id"] not in by_id]
+        services = {s["service"] for s in merged}
+        names = {s["name"] for s in merged}
+        assert not orphans, f"unparented spans: {orphans}"
+        assert {"worker/preprocess", "worker/rpc",
+                "worker/postprocess"} <= names, names
+        assert len([s for s in services if s.startswith("ps")]) == n_ps, \
+            services
+        breakdown = {}
+        for s in merged:
+            d = breakdown.setdefault(
+                s["name"], {"count": 0, "total_ms": 0.0})
+            d["count"] += 1
+            d["total_ms"] += s["dur_ns"] / 1e6
+        for name in sorted(breakdown,
+                           key=lambda n: -breakdown[n]["total_ms"]):
+            d = breakdown[name]
+            d["total_ms"] = round(d["total_ms"], 3)
+            log(f"trace: span {name:<26} x{d['count']:<3} "
+                f"{d['total_ms']:8.2f} ms total")
+        log(f"trace: exported {len(merged)} spans across "
+            f"{sorted(services)} -> {trace_out}")
+        detail = {
+            "untraced_ms_per_batch": round(off_ms, 3),
+            "traced_ms_per_batch": round(on_ms, 3),
+            "overhead_pct": round(overhead_pct, 2),
+            "spans_exported": len(merged),
+            "services": sorted(services),
+            "breakdown": breakdown,
+            "trace_file": trace_out,
+        }
+        return overhead_pct, detail
+    finally:
+        tracing.enable_tracing(False)
+        worker.close()
+        for c in clients:
+            c.shutdown()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                p.kill()
 
 
 def bench_worker_service(batch_size, steps, native_worker, n_ps=2, dim=DIM):
@@ -1301,8 +1469,10 @@ def main():
     p.add_argument("--mode",
                    choices=["hybrid", "device", "cached", "attn", "wire",
                             "worker", "worker-svc", "store", "roofline",
-                            "infer", "rpc"],
+                            "infer", "rpc", "trace"],
                    default="device")
+    p.add_argument("--trace-out", default="/tmp/persia_trace_capture.json",
+                   help="trace mode: exported Chrome-trace JSON path")
     p.add_argument("--clients", type=int, default=8,
                    help="infer mode: concurrent closed-loop clients")
     p.add_argument("--entries", type=int, default=10_000_000,
@@ -1330,6 +1500,7 @@ def main():
         "roofline": ("dlrm_hybrid_best_samples_per_sec", "samples/sec"),
         "infer": ("infer_microbatched_qps", "req/sec"),
         "rpc": ("rpc_out_of_order_msgs_per_sec", "msgs/sec"),
+        "trace": ("trace_overhead_pct", "percent"),
     }[args.mode]
 
     # Shared two-tier watchdog (persia_tpu.utils.arm_watchdog — the same
@@ -1348,7 +1519,8 @@ def main():
     if args.smoke:
         args.batch_size, args.steps, args.warmup = 256, 3, 1
 
-    if args.mode not in ("wire", "worker", "worker-svc", "store", "rpc"):  # host-only modes skip jax
+    if args.mode not in ("wire", "worker", "worker-svc", "store", "rpc",
+                         "trace"):  # host-only modes skip jax
         # local verification escape hatch (nn_worker.py honors the same
         # variable); plain JAX_PLATFORMS=cpu also counts — the axon
         # platform plugin re-pins jax.config via sitecustomize, so the
@@ -1394,6 +1566,14 @@ def main():
         # host-side metric: no meaningful ratio against the chip-throughput
         # baseline constant, so pin 1.0 like wire mode
         vs_baseline = 1.0
+    elif args.mode == "trace":
+        value, detail = bench_trace(args.batch_size, max(args.steps, 5),
+                                    trace_out=args.trace_out)
+        # the contract is "tracing is ~free when on, exactly free when
+        # off": report the measured on-vs-off overhead against a 2%
+        # budget (vs_baseline < 1 means within budget)
+        vs_baseline = value / 2.0
+        extra["detail"] = detail
     elif args.mode == "rpc":
         value, speedup, detail = bench_rpc(args.batch_size,
                                            max(args.steps, 5),

@@ -202,6 +202,7 @@ class TrainCtx(EmbeddingCtx):
         seed: int = 0,
         grad_reduce_dtype: Optional[str] = None,
         device_cache_capacity: int = 0,
+        profiler=None,
     ):
         super().__init__(model=model, schema=schema, worker=worker,
                          embedding_config=embedding_config,
@@ -232,6 +233,15 @@ class TrainCtx(EmbeddingCtx):
         self._cache_engine = None
         self._cached_step = None
         self._cache_multi_id = False
+        # opt-in device profiler window (tracing.StepProfiler): a
+        # jax.profiler trace capture keyed to a step range, so the TPU
+        # timeline aligns with the host spans of exactly those steps.
+        # Defaults from PERSIA_PROFILE_DIR/_START_STEP/_NUM_STEPS.
+        from persia_tpu import tracing as _tracing
+
+        self.profiler = (profiler if profiler is not None
+                         else _tracing.profiler_from_env())
+        self._step_count = 0
 
     def __enter__(self):
         super().__enter__()
@@ -382,7 +392,26 @@ class TrainCtx(EmbeddingCtx):
         Embedding values/gradients cross the host<->device boundary as a
         single packed bf16 array in each direction (the TPU analogue of
         the reference's f16 wire, persia-common/src/lib.rs:85-113).
-        Returns (loss, pred)."""
+        Returns (loss, pred).
+
+        Observability: each step runs under a ``trainer/train_step``
+        span — joined to the batch's existing trace when it came through
+        the pipeline (the prefetch worker's lookup opened the root), a
+        fresh root otherwise — and drives the opt-in
+        :class:`~persia_tpu.tracing.StepProfiler` window."""
+        from persia_tpu import tracing
+        from persia_tpu.pipeline import LookedUpBatch
+
+        self._step_count += 1
+        if self.profiler is not None:
+            self.profiler.on_step(self._step_count)
+        tctx = batch.trace if isinstance(batch, LookedUpBatch) else None
+        kw = {"ctx": tctx} if tctx is not None else {"root": True}
+        with tracing.span("trainer/train_step", step=self._step_count,
+                          **kw):
+            return self._train_step_inner(batch)
+
+    def _train_step_inner(self, batch) -> Tuple[jnp.ndarray, jnp.ndarray]:
         from persia_tpu.parallel.train import unpack_embedding_grads
         from persia_tpu.pipeline import LookedUpBatch
 
@@ -606,6 +635,8 @@ class TrainCtx(EmbeddingCtx):
         # the flush thread; super().__exit__ must run even when the
         # flush raises, or the dead ctx stays on the _ctx_stack and
         # current_ctx() keeps returning it
+        if self.profiler is not None:
+            self.profiler.close()  # stop an open device-trace capture
         try:
             if self._cache_engine is not None:
                 try:

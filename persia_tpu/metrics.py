@@ -4,7 +4,9 @@ Re-design of rust/persia-metrics/src/lib.rs (PersiaMetricsManager over the
 prometheus crate with a push-gateway thread): a dependency-free registry
 with the same metric surface. ``push_loop`` PUTs the text exposition to a
 Prometheus push gateway (PERSIA_METRICS_GATEWAY_ADDR) at a fixed
-interval; in-process consumers can scrape ``render()`` directly.
+interval; scrapers pull ``render()`` through the HTTP sidecar
+(:mod:`persia_tpu.obs_http` serves it at ``/metrics``) or call it
+in-process.
 """
 
 import threading
@@ -32,11 +34,26 @@ class Counter:
 
 
 class Gauge:
+    """Settable AND incrementable: queue-depth gauges are bumped from
+    many threads (pipeline feeders, RPC handler pools), and an unlocked
+    read-modify-write there loses counts — so ``add``/``dec`` take the
+    lock. ``set`` locks too, so a concurrent ``set``/``add`` pair
+    cannot interleave mid-update."""
+
     def __init__(self):
         self._value = 0.0
+        self._lock = threading.Lock()
 
     def set(self, v: float):
-        self._value = v
+        with self._lock:
+            self._value = v
+
+    def add(self, by: float = 1.0):
+        with self._lock:
+            self._value += by
+
+    def dec(self, by: float = 1.0):
+        self.add(-by)
 
     @property
     def value(self) -> float:
@@ -87,6 +104,15 @@ class Histogram:
         registry's histograms are process-shared)."""
         with self._lock:
             return self._total, self._sum
+
+    def snapshot_full(self) -> Tuple[List[int], float, int]:
+        """(bucket counts, sum, count) read under ONE lock hold —
+        exposition must use this: reading ``_counts``/``_sum``/``_total``
+        field-by-field races ``observe`` and renders torn series (a
+        bucket incremented but the matching ``_count`` not yet, or
+        vice versa)."""
+        with self._lock:
+            return list(self._counts), self._sum, self._total
 
     def percentile(self, q: float) -> float:
         """Approximate quantile from the bucket counts (linear
@@ -156,7 +182,9 @@ class MetricsRegistry:
         return self._get("histogram", name, labels, Histogram)
 
     def render(self) -> str:
-        """Prometheus text exposition format."""
+        """Prometheus text exposition format. Histogram series are read
+        through ``snapshot_full()`` so a concurrent ``observe`` cannot
+        tear a bucket/count pair mid-render."""
         lines: List[str] = []
         with self._lock:
             items = sorted(self._metrics.items())
@@ -166,38 +194,43 @@ class MetricsRegistry:
             kind = kinds[name]
             if kind == "histogram":
                 assert isinstance(metric, Histogram)
+                counts, hsum, total = metric.snapshot_full()
                 cumulative = 0
-                for b, c in zip(metric.buckets, metric._counts):
+                for b, c in zip(metric.buckets, counts):
                     cumulative += c
                     lines.append(
                         f"{name}_bucket{_fmt({**all_labels, 'le': repr(b)})}"
                         f" {cumulative}"
                     )
-                cumulative += metric._counts[-1]
+                cumulative += counts[-1]
                 lines.append(
                     f"{name}_bucket{_fmt({**all_labels, 'le': '+Inf'})}"
                     f" {cumulative}"
                 )
-                lines.append(f"{name}_sum{_fmt(all_labels)} {metric._sum}")
-                lines.append(f"{name}_count{_fmt(all_labels)} {metric._total}")
+                lines.append(f"{name}_sum{_fmt(all_labels)} {hsum}")
+                lines.append(f"{name}_count{_fmt(all_labels)} {total}")
             else:
                 lines.append(f"{name}{_fmt(all_labels)} {metric.value}")
         return "\n".join(lines) + "\n"
 
     def push_loop(self, job: str, interval_sec: float = 10.0,
-                  gateway_addr: Optional[str] = None) -> threading.Thread:
+                  gateway_addr: Optional[str] = None
+                  ) -> Tuple[threading.Thread, threading.Event]:
         """Background pusher to a Prometheus push gateway
-        (reference lib.rs:96-144)."""
+        (reference lib.rs:96-144). Returns ``(thread, stop_event)`` —
+        set the event to end the loop (it wakes from its interval wait
+        immediately), so tests and clean shutdowns don't leak a pusher
+        thread for the process lifetime."""
         addr = gateway_addr or get_metrics_gateway_addr()
         if addr is None:
             raise ValueError("no metrics gateway address configured")
         url = f"http://{addr}/metrics/job/{job}"
+        stop = threading.Event()
 
         def run():
             import urllib.request
 
-            while True:
-                time.sleep(interval_sec)
+            while not stop.wait(interval_sec):
                 try:
                     req = urllib.request.Request(
                         url, data=self.render().encode(), method="PUT")
@@ -207,13 +240,23 @@ class MetricsRegistry:
 
         t = threading.Thread(target=run, daemon=True, name="metrics-pusher")
         t.start()
-        return t
+        return t, stop
+
+
+def _escape_label_value(v) -> str:
+    """Prometheus text-format escaping for label VALUES: backslash,
+    double quote, and line feed. Without it an adversarial value (an
+    address, a user-supplied job name) terminates the quoted string and
+    injects arbitrary series into the exposition."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
 
 
 def _fmt(labels: Dict[str, str]) -> str:
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"'
+                     for k, v in sorted(labels.items()))
     return "{" + inner + "}"
 
 

@@ -1,0 +1,182 @@
+"""HTTP observability sidecar: /metrics, /healthz, /trace.
+
+Every service process (PS replica, embedding worker, inference server)
+can start one of these next to its RPC socket. It replaces the
+push-gateway-only exposition (``MetricsRegistry.push_loop``) with a
+standard Prometheus pull endpoint, adds a health probe that reports the
+live internals a pager actually needs (queue depths, in-flight RPCs,
+last-activity age), and exposes the tracing ring buffer so a stuck or
+slow batch can be followed across tiers without restarting anything:
+
+- ``GET /metrics``  — Prometheus text exposition (``registry.render()``)
+- ``GET /healthz``  — JSON health document; merges the sidecar's own
+  fields (service name, pid, uptime) with whatever the service's
+  ``health_fn`` reports. Always HTTP 200 while the process can answer —
+  liveness is the TCP accept; the *content* carries the judgement.
+- ``GET /trace?n=K[&format=chrome|raw]`` — the most recent K spans from
+  the process-local trace collector. ``chrome`` (default) is a
+  Chrome-trace/Perfetto ``traceEvents`` JSON ready to load as-is;
+  ``raw`` is the span-dict list ``bench.py --mode trace`` scrapes to
+  merge multi-process captures into one timeline.
+
+Dependency-free (http.server), daemon-threaded, bound to an ephemeral
+port by default so test stacks never collide.
+"""
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+from urllib.parse import parse_qs, urlparse
+
+from persia_tpu.logger import get_default_logger
+
+_logger = get_default_logger(__name__)
+
+
+class ObservabilityServer:
+    """Sidecar HTTP server for one service process.
+
+    ``health_fn`` returns a JSON-serializable dict of live service
+    internals; it is called per /healthz request, so keep it cheap and
+    lock-light. ``registry`` defaults to the process-wide metrics
+    registry, ``collector`` to the process-wide trace collector.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 registry=None, collector=None,
+                 health_fn: Optional[Callable[[], Dict]] = None,
+                 service: str = "persia"):
+        if registry is None:
+            from persia_tpu.metrics import default_registry
+
+            registry = default_registry()
+        if collector is None:
+            from persia_tpu.tracing import default_collector
+
+            collector = default_collector()
+        self.registry = registry
+        self.collector = collector
+        self.health_fn = health_fn
+        self.service = service
+        self._t0 = time.monotonic()
+        sidecar = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # per-request stderr lines would swamp service logs
+            def log_message(self, *a):  # noqa: D102
+                pass
+
+            def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+                try:
+                    url = urlparse(self.path)
+                    if url.path == "/metrics":
+                        body = sidecar.registry.render().encode()
+                        ctype = "text/plain; version=0.0.4; charset=utf-8"
+                    elif url.path == "/healthz":
+                        body = json.dumps(sidecar._health()).encode()
+                        ctype = "application/json"
+                    elif url.path == "/trace":
+                        q = parse_qs(url.query)
+                        n = int(q.get("n", ["256"])[0])
+                        fmt = q.get("format", ["chrome"])[0]
+                        body = sidecar._trace(n, fmt).encode()
+                        ctype = "application/json"
+                    else:
+                        self.send_error(404, "unknown path")
+                        return
+                except Exception as e:  # noqa: BLE001 — surfaced as 500
+                    self.send_error(500, str(e))
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.addr = f"{host}:{self._httpd.server_address[1]}"
+        self._thread: Optional[threading.Thread] = None
+
+    def _health(self) -> Dict:
+        doc = {
+            "status": "ok",
+            "service": self.service,
+            "pid": os.getpid(),
+            "uptime_sec": round(time.monotonic() - self._t0, 3),
+        }
+        if self.health_fn is not None:
+            try:
+                doc.update(self.health_fn())
+            except Exception as e:  # health must never 500 on a bad probe
+                doc["status"] = "degraded"
+                doc["health_fn_error"] = repr(e)
+        return doc
+
+    def _trace(self, n: int, fmt: str) -> str:
+        spans = self.collector.recent(n)
+        if fmt == "raw":
+            return json.dumps([s.to_dict() for s in spans])
+        from persia_tpu.tracing import chrome_trace
+
+        return json.dumps(chrome_trace(spans))
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name=f"obs-http-{self.addr}")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except OSError:
+            pass
+
+
+def maybe_start(host: str, http_port: Optional[int], health_fn,
+                service: Optional[str] = None):
+    """The one sidecar-construction convention every service shares:
+    ``None`` keeps the sidecar off (in-process test instances), any port
+    number starts one (0 = ephemeral). Returns the started server or
+    None."""
+    if http_port is None:
+        return None
+    if service is None:
+        from persia_tpu.tracing import service_name
+
+        service = service_name()
+    return ObservabilityServer(host, http_port, health_fn=health_fn,
+                               service=service).start()
+
+
+def add_http_args(parser):
+    """Shared --http-port / --http-addr-file argparse wiring for the
+    service binaries (one place owns the 0/-1 convention and the
+    PERSIA_HTTP_PORT default)."""
+    parser.add_argument(
+        "--http-port", type=int,
+        default=int(os.environ.get("PERSIA_HTTP_PORT", 0)),
+        help="observability sidecar port (/metrics /healthz /trace); "
+             "0 = ephemeral, -1 = disabled")
+    parser.add_argument(
+        "--http-addr-file", default=None,
+        help="write the sidecar's bound address here (port handoff for "
+             "scrapers/benches, like --addr-file)")
+
+
+def port_from_args(args) -> Optional[int]:
+    """argparse value -> maybe_start port (the -1 = disabled rule)."""
+    return None if args.http_port < 0 else args.http_port
+
+
+def write_addr_file_from_args(sidecar, args):
+    if args.http_addr_file and sidecar is not None:
+        from persia_tpu.utils import write_addr_file
+
+        write_addr_file(sidecar.addr, args.http_addr_file)

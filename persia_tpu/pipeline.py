@@ -29,6 +29,7 @@ import weakref
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
+from persia_tpu import tracing
 from persia_tpu.data.batch import PersiaBatch
 from persia_tpu.logger import get_default_logger
 from persia_tpu.tracing import (
@@ -81,13 +82,18 @@ class LookedUpBatch:
 
     ``staged`` carries the device-resident inputs when the engine's
     prefetch worker already ran the host->device staging (the
-    postprocess_worker -> GPU move of forward.rs:572-638)."""
+    postprocess_worker -> GPU move of forward.rs:572-638). ``trace`` is
+    the batch's trace context ``(trace_id, span_id)`` opened by the
+    prefetch worker's lookup span, so the trainer's step span and the
+    async backward update join the SAME trace the worker/PS spans are
+    already on."""
 
     batch: PersiaBatch
     lookup: Dict[str, Any]
     ref_id: Optional[int]
     engine: Optional["ForwardEngine"] = None
     staged: Optional[tuple] = None
+    trace: Optional[Tuple[int, int]] = None
 
     @property
     def requires_grad(self) -> bool:
@@ -105,6 +111,32 @@ class _PackedGrads:
     shapes: Sequence[Tuple[int, ...]]
     names: Sequence[str]
     slot_dims: Optional[Sequence[int]] = None
+
+
+class _GaugedSemaphore:
+    """Semaphore that mirrors permits-in-use into a registry gauge (the
+    trainer-side staleness observable: pegged at the bound == the PS
+    tier is the bottleneck; near zero == the chip is)."""
+
+    def __init__(self, value: int, gauge):
+        self._sem = threading.Semaphore(value)
+        self._gauge = gauge
+
+    def acquire(self, *a, **kw):
+        got = self._sem.acquire(*a, **kw)
+        if got:
+            self._gauge.add(1)
+        return got
+
+    def release(self):
+        self._gauge.dec(1)
+        self._sem.release()
+
+    @property
+    def _value(self):
+        """Available-permit count, mirroring threading.Semaphore's
+        internal (the permit-leak tests assert on it)."""
+        return self._sem._value
 
 
 def flush_backward_engines(worker, timeout: Optional[float] = None):
@@ -142,6 +174,12 @@ class BackwardEngine:
         self._pending_cv = threading.Condition()
         self._errors: List[BaseException] = []
         self._timer_hist = StageTimer("backward_client_time_cost_sec").hist
+        from persia_tpu.metrics import default_registry
+
+        # pending-update depth (queued + executing): the backward lag
+        # observable next to the staleness gauge
+        self._g_pending = default_registry().gauge(
+            "pipeline_backward_pending_updates")
         # register on the worker so checkpoint dumps can quiesce us
         engines = getattr(worker, "_backward_engines", None)
         if engines is None:
@@ -160,8 +198,11 @@ class BackwardEngine:
             raise self._errors[0]
         with self._pending_cv:
             self._pending += 1
+        self._g_pending.add(1)
         work_started()
-        self._q.put((ref_id, grads))
+        # carry the submitting thread's trace context (the trainer's
+        # step span) into the backward worker thread
+        self._q.put((ref_id, grads, tracing.current_context()))
 
     def submit_packed(self, ref_id: int, flat_grads,
                       shapes: Sequence[Tuple[int, ...]],
@@ -193,9 +234,11 @@ class BackwardEngine:
             item = self._q.get()
             if item is _SENTINEL:
                 return
-            ref_id, grads = item
+            ref_id, grads, tctx = item
             try:
-                with self._timer_hist.timer():
+                with self._timer_hist.timer(), \
+                        tracing.span("pipeline/backward_update", ctx=tctx,
+                                     ref_id=ref_id):
                     if isinstance(grads, _PackedGrads):
                         from persia_tpu.parallel.train import (
                             unpack_embedding_grads,
@@ -216,6 +259,7 @@ class BackwardEngine:
                 self._errors.append(e)
             finally:
                 work_finished()
+                self._g_pending.dec(1)
                 if self.staleness_sem is not None:
                     self.staleness_sem.release()
                 with self._pending_cv:
@@ -254,10 +298,17 @@ class ForwardEngine:
         self.num_workers = num_workers
         self.buffer_size = buffer_size
         self.reproducible = reproducible
+        from persia_tpu.metrics import default_registry
+
+        reg = default_registry()
         self.staleness_sem = (
-            threading.Semaphore(embedding_staleness)
+            _GaugedSemaphore(
+                embedding_staleness,
+                reg.gauge("pipeline_staleness_permits_in_use"))
             if embedding_staleness is not None else None
         )
+        self._g_in_q = reg.gauge("pipeline_lookup_queue_depth")
+        self._g_out_q = reg.gauge("pipeline_ready_queue_depth")
         self.backward = BackwardEngine(
             self.worker, staleness_sem=self.staleness_sem
         )
@@ -316,6 +367,7 @@ class ForwardEngine:
                     if batch.requires_grad and self.staleness_sem is not None:
                         self.staleness_sem.acquire()
                     in_q.put((next(seq_counter), batch))
+                    self._g_in_q.add(1)
             except BaseException as e:
                 errors.append(e)
             finally:
@@ -328,6 +380,7 @@ class ForwardEngine:
                 if item is _SENTINEL:
                     out_q.put(_SENTINEL)
                     return
+                self._g_in_q.dec(1)
                 seq, batch = item
                 if stop.is_set():
                     # another worker hit a fatal error: drain, don't process
@@ -336,19 +389,30 @@ class ForwardEngine:
                     continue
                 work_started()
                 try:
-                    with self._forward_hist.timer():
-                        ref_id, lookup = self._lookup_with_recovery(
-                            batch, stop=stop)
-                    staged = None
-                    stage = getattr(self.ctx, "stage_batch", None)
-                    if stage is not None and batch.requires_grad:
-                        # host->device staging off the training thread;
-                        # device_put is async so the upload overlaps the
-                        # in-flight compute
-                        staged = stage(batch, lookup)
+                    # one ROOT span per batch: the trace every
+                    # downstream tier (worker stages, PS handlers, the
+                    # trainer step, the async backward update) joins.
+                    # The histogram timer stays on the LOOKUP alone —
+                    # forward_client_time_cost_sec predates this span
+                    # and dashboards compare it against the PR-2
+                    # baselines, so staging must not leak into it.
+                    with tracing.span("pipeline/lookup", root=True,
+                                      seq=seq) as sp:
+                        with self._forward_hist.timer():
+                            ref_id, lookup = self._lookup_with_recovery(
+                                batch, stop=stop)
+                        staged = None
+                        stage = getattr(self.ctx, "stage_batch", None)
+                        if stage is not None and batch.requires_grad:
+                            # host->device staging off the training
+                            # thread; device_put is async so the upload
+                            # overlaps the in-flight compute
+                            staged = stage(batch, lookup)
                     heartbeat()
                     out_q.put((seq, LookedUpBatch(batch, lookup, ref_id,
-                                                  self, staged)))
+                                                  self, staged,
+                                                  trace=sp.ctx)))
+                    self._g_out_q.add(1)
                 except BaseException as e:
                     # this batch will never train: its permit must not
                     # stay captive, and the feeder must stop acquiring
@@ -381,6 +445,7 @@ class ForwardEngine:
                 if item is _SENTINEL:
                     finished_workers += 1
                     continue
+                self._g_out_q.dec(1)
                 yield item[1]
         else:
             # reorder by seq so iteration order is stable even with
@@ -392,6 +457,7 @@ class ForwardEngine:
                 if item is _SENTINEL:
                     finished_workers += 1
                     continue
+                self._g_out_q.dec(1)
                 heapq.heappush(heap, item)
                 while heap and heap[0][0] == next_seq:
                     _, lb = heapq.heappop(heap)
@@ -429,6 +495,7 @@ class ForwardEngine:
             except queue.Empty:
                 break
             if item is not _SENTINEL:
+                self._g_out_q.dec(1)
                 release_for(item[1].batch)
         deadline = time.monotonic() + 10.0
         while feeder_thread.is_alive() or not in_q.empty():
@@ -439,6 +506,7 @@ class ForwardEngine:
                     break
                 continue
             if item is not _SENTINEL:
+                self._g_in_q.dec(1)
                 release_for(item[1])
 
     def flush(self, timeout: Optional[float] = None):

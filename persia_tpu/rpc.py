@@ -21,6 +21,17 @@ servers that support tags answer ``ok``, legacy peers (e.g. the C++
 ``ps_server``) answer "no such method" and the connection stays
 untagged — fully backward compatible in both directions.
 
+Trace context (:mod:`persia_tpu.tracing`) rides the envelope the same
+negotiated way: a client whose process has tracing ENABLED probes
+``__trace__`` at dial time; when the server acks, requests carry an
+extra ``[trace_id, parent_span_id]`` envelope slot and the server runs
+each handler under a child span — one ``trace_id`` then links a trainer
+step to its worker stages to the per-shard PS handlers, across both the
+serial and the out-of-order dispatch paths. Legacy peers answer the
+probe "no such method" and never see the extra slot; with tracing
+disabled (the default) the probe itself is never sent, so the wire is
+byte-identical to the untraced protocol.
+
 Numpy arrays are framed with :func:`pack_arrays` / :func:`unpack_arrays`.
 :func:`pack_arrays_sg` is the zero-copy twin: it returns a buffer LIST
 that ``sendmsg``/writev hands to the kernel without the ``tobytes()``
@@ -36,10 +47,13 @@ import select
 import socket
 import struct
 import threading
+import time as _time
 from typing import Callable, Dict, List, Optional, Tuple, Union
 
 import msgpack
 import numpy as np
+
+from persia_tpu import tracing
 
 try:
     import zstandard
@@ -312,17 +326,34 @@ class RpcServer:
     DEDUP_CACHE_BYTES = 256 << 20
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 concurrent_streams: int = 1, enable_tags: bool = True):
+                 concurrent_streams: int = 1, enable_tags: bool = True,
+                 enable_trace: bool = True):
         from collections import OrderedDict
 
         self._concurrent_streams = max(1, int(concurrent_streams))
         # enable_tags=False emulates a legacy (pre-tag) peer: the
         # ``__tags__`` negotiation answers "no such method" and clients
-        # negotiate down to untagged framing (compat tests use this)
+        # negotiate down to untagged framing (compat tests use this);
+        # enable_trace=False likewise refuses the ``__trace__`` probe so
+        # clients never attach the trace envelope slot
         self._enable_tags = enable_tags
+        self._handlers: Dict[str, Callable[[bytes], bytes]] = {}
+        if enable_trace:
+            self._handlers["__trace__"] = lambda payload: b""
+        # /healthz surface: in-flight + served handler counts and the
+        # age of the last request seen (scrapers distinguish "idle" from
+        # "wedged" by pairing this with their own traffic knowledge).
+        # Lock-guarded on purpose: inflight must not drift (a lost +=
+        # under a bytecode race would mis-report forever), and two
+        # uncontended acquisitions cost ~0.2us against the >=100us of
+        # real per-request work — noise next to the GIL this path
+        # already serializes on.
+        self._stats_lock = threading.Lock()
+        self._inflight_reqs = 0
+        self._served_reqs = 0
+        self._last_activity = _time.monotonic()
         self._stream_pool = None  # built lazily on the first connection
         self._stream_pool_lock = threading.Lock()
-        self._handlers: Dict[str, Callable[[bytes], bytes]] = {}
         self._dedup: "OrderedDict[bytes, bytes]" = OrderedDict()
         self._dedup_bytes = 0
         self._dedup_lock = threading.Lock()
@@ -373,20 +404,46 @@ class RpcServer:
             threading.Thread(target=self._serve_conn, args=(conn,),
                              daemon=True).start()
 
-    def _handle_one(self, method: str, payload,
-                    req_id) -> Tuple[list, bytes]:
-        """Run one request to a (envelope, body) response pair."""
+    def health(self) -> dict:
+        """Live-internals snapshot for the HTTP sidecar's /healthz."""
+        with self._stats_lock:
+            return {
+                "rpc_addr": self.addr,
+                "inflight_rpcs": self._inflight_reqs,
+                "served_rpcs": self._served_reqs,
+                "last_activity_age_sec": round(
+                    _time.monotonic() - self._last_activity, 3),
+            }
+
+    def _handle_one(self, method: str, payload, req_id,
+                    trace=None) -> Tuple[list, bytes]:
+        """Run one request to a (envelope, body) response pair — the
+        single execution point for BOTH the serial and dispatch-pool
+        paths. ``trace`` is the propagated ``(trace_id, parent_span)``
+        context from the envelope (None when the request is untraced):
+        the handler runs under a child span, so per-shard PS handler
+        work shows up parented to the caller's stage span even when a
+        pool thread answers out of order."""
+        with self._stats_lock:
+            self._inflight_reqs += 1
+            self._last_activity = _time.monotonic()
         try:
             handler = self._handlers.get(method)
             if handler is None:
                 raise RpcError(f"no such method {method!r}")
-            if req_id is None:
-                result = handler(payload)
-            else:
-                result = self._execute_once(handler, payload, req_id)
+            with tracing.span(f"rpc/{method}",
+                              ctx=tuple(trace) if trace else None):
+                if req_id is None:
+                    result = handler(payload)
+                else:
+                    result = self._execute_once(handler, payload, req_id)
             return ["ok"], result
         except BaseException as e:
             return ["err", f"{type(e).__name__}: {e}"], b""
+        finally:
+            with self._stats_lock:
+                self._inflight_reqs -= 1
+                self._served_reqs += 1
 
     def _serve_conn_concurrent(self, conn: socket.socket):
         """Dispatch-pool variant: this thread reads requests and submits
@@ -439,11 +496,11 @@ class RpcServer:
             except OSError:
                 conn_dead.set()
 
-        def handle_direct(method, payload, req_id, tag):
+        def handle_direct(method, payload, req_id, tag, trace):
             """Tagged request in a pool thread: handle and send straight
             from here, in COMPLETION order — no queue hop, no writer
             wakeup (out-of-order is the tag wire's whole point)."""
-            env, body = self._handle_one(method, payload, req_id)
+            env, body = self._handle_one(method, payload, req_id, trace)
             send_response(env, body, tag)
             with queued_lock:
                 queued[0] -= 1
@@ -497,6 +554,7 @@ class RpcServer:
                         pending.put((tag, ack))
                         continue
                     req_id = env[1] if len(env) >= 3 else None
+                    trace = env[2] if len(env) >= 4 else None
                     if flags & _FLAG_PIPELINED:
                         # the client declared more requests may be in
                         # flight: executing inline would head-of-line
@@ -523,7 +581,7 @@ class RpcServer:
                         # request queued behind this one: respond from
                         # the reader thread
                         renv, rbody = self._handle_one(method, payload,
-                                                       req_id)
+                                                       req_id, trace)
                         send_response(renv, rbody, tag)
                         if conn_dead.is_set():
                             break
@@ -534,11 +592,12 @@ class RpcServer:
                     try:
                         if tag is None:
                             fut = pool.submit(
-                                self._handle_one, method, payload, req_id)
+                                self._handle_one, method, payload, req_id,
+                                trace)
                             pending.put((None, fut))
                         else:
                             pool.submit(handle_direct, method, payload,
-                                        req_id, tag)
+                                        req_id, tag, trace)
                     except RuntimeError:
                         # stop() shut the pool down between recv and
                         # submit; the server is closing anyway
@@ -562,6 +621,7 @@ class RpcServer:
                     return
                 method = env[0]
                 req_id = env[1] if len(env) >= 3 else None
+                trace = env[2] if len(env) >= 4 else None
                 try:
                     if method == "__shutdown__":
                         _send_msg(conn, ["ok"], b"", False, tag=tag)
@@ -575,20 +635,16 @@ class RpcServer:
                         # they do not promise it)
                         _send_msg(conn, ["ok"], b"", False, tag=tag)
                         continue
-                    handler = self._handlers.get(method)
-                    if handler is None:
-                        raise RpcError(f"no such method {method!r}")
-                    if req_id is None:
-                        result = handler(payload)
-                    else:
-                        result = self._execute_once(handler, payload, req_id)
-                    _send_msg(conn, ["ok"], result, compress, tag=tag)
-                except BaseException as e:
-                    try:
-                        _send_msg(conn, ["err", f"{type(e).__name__}: {e}"],
-                                  b"", False, tag=tag)
-                    except OSError:
-                        return
+                except OSError:
+                    return
+                renv, rbody = self._handle_one(method, payload, req_id,
+                                               trace)
+                try:
+                    _send_msg(conn, renv, rbody,
+                              compress if renv[0] == "ok" else False,
+                              tag=tag)
+                except OSError:
+                    return
 
     def _execute_once(self, handler, payload, req_id: bytes) -> bytes:
         """At-most-once execution for an id, including the concurrent
@@ -643,13 +699,14 @@ class _ConnState:
     Owned by exactly one thread (the client pools one per thread), so
     none of this state needs a lock."""
 
-    __slots__ = ("sock", "compress", "tagged", "next_tag", "outstanding",
-                 "done", "evicted", "dead")
+    __slots__ = ("sock", "compress", "tagged", "trace", "next_tag",
+                 "outstanding", "done", "evicted", "dead")
 
     def __init__(self, sock: socket.socket, compress: bool):
         self.sock = sock
         self.compress = compress
         self.tagged = False
+        self.trace = False  # peer acked the __trace__ envelope slot
         self.next_tag = 1
         self.outstanding = set()  # tags sent, reply not yet claimed
         self.done: Dict[int, tuple] = {}  # tag -> (env, payload) parked
@@ -743,20 +800,27 @@ class RpcClient:
         sock = socket.create_connection(self._target, timeout=self.timeout)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         cs = _ConnState(sock, compress=not _is_loopback(sock))
-        if self.enable_tags:
-            try:
+        try:
+            if self.enable_tags:
                 # negotiate tagged framing; a legacy peer answers
                 # "no such method __tags__" and the connection stays
                 # untagged (negotiate-down, both directions compatible)
                 _send_msg(sock, ["__tags__"], b"", False)
                 env, _, _ = _recv_msg_tagged(sock)
                 cs.tagged = env[0] == "ok"
-            except BaseException:
-                try:
-                    sock.close()
-                except OSError:
-                    pass
-                raise
+            if tracing.tracing_enabled():
+                # negotiate the trace envelope slot the same way; only
+                # probed when this process traces at all, so the
+                # disabled wire stays byte-identical to the legacy one
+                _send_msg(sock, ["__trace__"], b"", False)
+                env, _, _ = _recv_msg_tagged(sock)
+                cs.trace = env[0] == "ok"
+        except BaseException:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise
         me = threading.current_thread()
         dead = []
         with self._conns_lock:
@@ -792,6 +856,21 @@ class RpcClient:
                 del self._conn_by_thread[me]
         if getattr(self._local, "cs", None) is cs:
             self._local.cs = None
+
+    @staticmethod
+    def _traced_envelope(envelope: list, cs: _ConnState) -> list:
+        """Attach the caller's active span context as the third envelope
+        slot when this connection negotiated ``__trace__`` (the req-id
+        slot is explicitly None when absent so servers index the slots
+        positionally). Untraced calls and un-negotiated connections send
+        the envelope untouched — byte-identical to the legacy wire."""
+        if not cs.trace:
+            return envelope
+        tctx = tracing.current_context()
+        if tctx is None:
+            return envelope
+        return [envelope[0], envelope[1] if len(envelope) > 1 else None,
+                list(tctx)]
 
     def _take_tag(self, cs: _ConnState) -> int:
         tag = cs.next_tag
@@ -895,14 +974,15 @@ class RpcClient:
                     continue
             others_inflight = bool(cs.outstanding)
             try:
+                env_send = self._traced_envelope(envelope, cs)
                 if cs.tagged:
                     tag = self._take_tag(cs)
-                    _send_msg(cs.sock, envelope, payload, cs.compress,
+                    _send_msg(cs.sock, env_send, payload, cs.compress,
                               tag=tag)
                     cs.outstanding.add(tag)
                     env, result = self._wait_tag(cs, tag)
                 else:
-                    _send_msg(cs.sock, envelope, payload, cs.compress)
+                    _send_msg(cs.sock, env_send, payload, cs.compress)
                     env, result = _recv_msg(cs.sock)
                 break
             except (ConnectionError, OSError):
@@ -945,6 +1025,7 @@ class RpcClient:
         envelope: list = [method]
         if dedup:
             envelope.append(os.urandom(12))
+        envelope = self._traced_envelope(envelope, cs)
         tag = self._take_tag(cs)
         try:
             self._drain_ready(cs)  # keep the reply direction flowing
@@ -981,12 +1062,13 @@ class RpcClient:
             return self._call_many_tagged(cs, method, payloads, window)
         results: list = []
         first_err: Optional[str] = None
+        envelope = self._traced_envelope([method], cs)
         try:
             i_send = 0
             while len(results) < len(payloads):
                 while (i_send < len(payloads)
                        and i_send - len(results) < window):
-                    _send_msg(cs.sock, [method], payloads[i_send],
+                    _send_msg(cs.sock, envelope, payloads[i_send],
                               cs.compress, pipelined=True)
                     i_send += 1
                 env, result = _recv_msg(cs.sock)
@@ -1009,6 +1091,7 @@ class RpcClient:
         results: list = []
         tags: List[int] = []
         first_err: Optional[str] = None
+        envelope = self._traced_envelope([method], cs)
         try:
             i_send = 0
             while len(results) < len(payloads):
@@ -1016,7 +1099,7 @@ class RpcClient:
                        and i_send - len(results) < window):
                     self._drain_ready(cs)  # keep the reply direction flowing
                     tag = self._take_tag(cs)
-                    _send_msg(cs.sock, [method], payloads[i_send],
+                    _send_msg(cs.sock, envelope, payloads[i_send],
                               cs.compress, tag=tag, pipelined=True)
                     cs.outstanding.add(tag)
                     tags.append(tag)

@@ -24,6 +24,7 @@ from typing import List, Optional
 import msgpack
 import numpy as np
 
+from persia_tpu import tracing
 from persia_tpu.logger import get_default_logger
 from persia_tpu.rpc import (
     RpcClient,
@@ -132,12 +133,18 @@ class ShardParallelDispatcher:
         if len(groups) <= 1:
             return self.holder.lookup(signs, dim, training)
         out = np.empty((len(signs), dim), dtype=np.float32)
+        # pool threads have no thread-local trace context; capture the
+        # handler span here so per-shard sub-lookups parent to it
+        tctx = tracing.current_context()
 
-        def run(sel):
-            out[sel] = self.holder.lookup(signs[sel], dim, training)
+        def run(ib):
+            i, sel = ib
+            with tracing.span("ps/shard_lookup", ctx=tctx, bucket=i,
+                              n=len(sel)):
+                out[sel] = self.holder.lookup(signs[sel], dim, training)
 
         # pool.map raises the first sub-call error after all complete
-        list(self._pool.map(run, groups))
+        list(self._pool.map(run, enumerate(groups)))
         return out
 
     def update_gradients(self, signs: np.ndarray, grads: np.ndarray,
@@ -149,11 +156,15 @@ class ShardParallelDispatcher:
         groups = self._shard_buckets(signs)
         if len(groups) <= 1:
             return self.holder.update_gradients(signs, grads, dim)
+        tctx = tracing.current_context()
 
-        def run(sel):
-            self.holder.update_gradients(signs[sel], grads[sel], dim)
+        def run(ib):
+            i, sel = ib
+            with tracing.span("ps/shard_update", ctx=tctx, bucket=i,
+                              n=len(sel)):
+                self.holder.update_gradients(signs[sel], grads[sel], dim)
 
-        list(self._pool.map(run, groups))
+        list(self._pool.map(run, enumerate(groups)))
 
     def close(self):
         if self._pool is not None:
@@ -163,7 +174,8 @@ class ShardParallelDispatcher:
 class PsService:
     def __init__(self, holder, host: str = "127.0.0.1", port: int = 0,
                  inc_dumper=None, shard_parallel: Optional[bool] = None,
-                 concurrent_streams: int = 8, legacy_frames: bool = False):
+                 concurrent_streams: int = 8, legacy_frames: bool = False,
+                 http_port: Optional[int] = None):
         self.holder = holder
         self.inc_dumper = inc_dumper
         # concurrent_streams opts into the per-connection dispatch pool:
@@ -195,6 +207,21 @@ class PsService:
         s.register("load", self._load)
         s.register("status", self._status)
         s.register("ready_for_serving", self._ready)
+        # observability sidecar: /metrics + /healthz + /trace next to
+        # the RPC socket (http_port=0 binds an ephemeral port; None
+        # keeps the sidecar off — in-process test holders don't want a
+        # listener per instance)
+        from persia_tpu import obs_http
+
+        self.http = obs_http.maybe_start(host, http_port, self._health)
+
+    def _health(self) -> dict:
+        doc = self.server.health()
+        with self._status_lock:
+            doc["model_manager_status"] = self.status
+        doc["holder_entries"] = len(self.holder)
+        doc["shard_parallel"] = self._dispatch.enabled
+        return doc
 
     @property
     def addr(self):
@@ -203,6 +230,8 @@ class PsService:
     def stop(self):
         self.server.stop()
         self._dispatch.close()
+        if self.http is not None:
+            self.http.stop()
 
     def _configure(self, payload: bytes) -> bytes:
         req = msgpack.unpackb(payload, raw=False)
@@ -472,6 +501,9 @@ def main():
     p.add_argument("--addr-file", default=None,
                    help="write the bound address here after listen (with "
                         "--port 0: race-free port handoff to a parent)")
+    from persia_tpu import obs_http
+
+    obs_http.add_http_args(p)
     p.add_argument("--concurrent-streams", type=int,
                    default=int(os.environ.get(
                        "PERSIA_PS_CONCURRENT_STREAMS", 8)),
@@ -480,9 +512,10 @@ def main():
                         "shard-parallel execution is controlled "
                         "separately by PERSIA_PS_SHARD_PARALLEL=0/1")
     args = p.parse_args()
-    from persia_tpu.tracing import start_deadlock_detection
+    from persia_tpu.tracing import set_service_name, start_deadlock_detection
 
     start_deadlock_detection()
+    set_service_name(f"ps{args.replica_index}")
 
     gc = GlobalConfig.load(args.global_config) if args.global_config else GlobalConfig()
     holder = make_holder(gc.parameter_server.capacity,
@@ -508,17 +541,20 @@ def main():
         holder, args.host, args.port, inc_dumper=inc_dumper,
         concurrent_streams=args.concurrent_streams,
         # A/B lever for the worker-cycle bench's serialized baseline
-        legacy_frames=os.environ.get("PERSIA_PS_LEGACY_FRAMES") == "1")
+        legacy_frames=os.environ.get("PERSIA_PS_LEGACY_FRAMES") == "1",
+        http_port=obs_http.port_from_args(args))
     if args.initial_checkpoint:
         holder.load_file(args.initial_checkpoint)
         _logger.info("loaded initial checkpoint from %s",
                      args.initial_checkpoint)
-    _logger.info("parameter server %d/%d listening on %s",
-                 args.replica_index, args.replica_size, service.addr)
+    _logger.info("parameter server %d/%d listening on %s (sidecar %s)",
+                 args.replica_index, args.replica_size, service.addr,
+                 service.http.addr if service.http else "off")
     if args.addr_file:
         from persia_tpu.utils import write_addr_file
 
         write_addr_file(service.addr, args.addr_file)
+    obs_http.write_addr_file_from_args(service.http, args)
     if args.coordinator:
         CoordinatorClient(args.coordinator).register(
             ROLE_PS, args.replica_index, service.addr)

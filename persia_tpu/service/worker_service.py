@@ -44,13 +44,18 @@ _logger = get_default_logger(__name__)
 
 class WorkerService:
     def __init__(self, worker: EmbeddingWorker, host: str = "127.0.0.1",
-                 port: int = 0, concurrent_streams: int = 8):
+                 port: int = 0, concurrent_streams: int = 8,
+                 http_port: Optional[int] = None):
         self.worker = worker
         # dispatch pool: a pipelining trainer/data-loader connection
         # (tagged framing) gets out-of-order completion, so one slow
         # lookup fan-out does not convoy the next batch's ingestion
         self.server = RpcServer(host, port,
                                 concurrent_streams=concurrent_streams)
+        # observability sidecar (see PsService): /metrics /healthz /trace
+        from persia_tpu import obs_http
+
+        self.http = obs_http.maybe_start(host, http_port, self._health)
         s = self.server
         s.register("forward_batched", self._forward_batched)
         s.register("forward_batch_id", self._forward_batch_id)
@@ -67,6 +72,25 @@ class WorkerService:
     @property
     def addr(self):
         return self.server.addr
+
+    def stop(self):
+        self.server.stop()
+        if self.http is not None:
+            self.http.stop()
+
+    def _health(self) -> dict:
+        """Live middleware internals for /healthz: the buffer depths and
+        staleness are THE signals for a stuck hybrid pipeline (permits
+        all held = staleness pegged; loaders outrunning trainers =
+        forward buffer climbing toward ForwardBufferFull)."""
+        doc = self.server.health()
+        w = self.worker
+        with w._lock:
+            doc["forward_buffer_depth"] = len(w._forward_id_buffer)
+            doc["post_forward_buffer_depth"] = len(w._post_forward_buffer)
+            doc["staleness"] = w.staleness
+        doc["ps_replicas"] = w.replica_size
+        return doc
 
     def _forward_batched(self, payload: bytes) -> bytes:
         _, feats = ser.unpack_id_features(payload)
@@ -295,10 +319,14 @@ def main():
     p.add_argument("--enable-monitor", action="store_true",
                    default=os.environ.get("PERSIA_ENABLE_MONITOR") == "1",
                    help="estimate distinct ids per feature (HLL gauge)")
+    from persia_tpu import obs_http
+
+    obs_http.add_http_args(p)
     args = p.parse_args()
-    from persia_tpu.tracing import start_deadlock_detection
+    from persia_tpu.tracing import set_service_name, start_deadlock_detection
 
     start_deadlock_detection()
+    set_service_name(f"worker{args.replica_index}")
 
     schema = EmbeddingSchema.load(args.embedding_config)
     gc = GlobalConfig.load(args.global_config) if args.global_config else GlobalConfig()
@@ -320,10 +348,15 @@ def main():
         enable_monitor=args.enable_monitor,
         ps_resolver=ps_resolver,
     )
-    service = WorkerService(worker, args.host, args.port)
-    _logger.info("embedding worker %d/%d listening on %s (%d PS)",
+    service = WorkerService(
+        worker, args.host, args.port,
+        http_port=obs_http.port_from_args(args))
+    _logger.info("embedding worker %d/%d listening on %s (%d PS, "
+                 "sidecar %s)",
                  args.replica_index, args.replica_size, service.addr,
-                 len(ps_clients))
+                 len(ps_clients),
+                 service.http.addr if service.http else "off")
+    obs_http.write_addr_file_from_args(service.http, args)
     if args.coordinator:
         CoordinatorClient(args.coordinator).register(
             ROLE_WORKER, args.replica_index, service.addr)

@@ -60,6 +60,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import msgpack
 import numpy as np
 
+from persia_tpu import tracing
 from persia_tpu.config import EmbeddingSchema
 from persia_tpu.ctx import InferCtx
 from persia_tpu.data.batch import (
@@ -242,7 +243,7 @@ class HotRowCache:
 
 
 class _PendingRequest:
-    __slots__ = ("batch", "done", "pred", "error", "t_enqueue")
+    __slots__ = ("batch", "done", "pred", "error", "t_enqueue", "tctx")
 
     def __init__(self, batch: PersiaBatch):
         self.batch = batch
@@ -250,6 +251,10 @@ class _PendingRequest:
         self.pred: Optional[np.ndarray] = None
         self.error: Optional[BaseException] = None
         self.t_enqueue = time.perf_counter()
+        # the submitting handler thread's span context: the dispatcher
+        # thread has none of its own, so the merged forward's span
+        # parents to the first traced request it serves
+        self.tctx = tracing.current_context()
 
 
 class _MicroBatcher:
@@ -400,6 +405,7 @@ class InferenceServer:
         cache_rows: int = 0,
         cache_ttl_sec: float = 30.0,
         concurrent_streams: Optional[int] = None,
+        http_port: Optional[int] = None,
     ):
         # Opt-in contract: a default (serialized) server keeps the
         # legacy thread-per-connection RPC loop with NO shared-pool cap
@@ -469,6 +475,21 @@ class InferenceServer:
                                        labels)
         self._t_forward = reg.histogram(
             "inference_forward_time_cost_sec", labels)
+        # observability sidecar (see PsService): /metrics /healthz /trace
+        from persia_tpu import obs_http
+
+        self.http = obs_http.maybe_start(host, http_port, self._healthz)
+
+    def _healthz(self) -> dict:
+        doc = self.server.health()
+        if self._batcher is not None:
+            with self._batcher._cond:
+                doc["microbatch_queue_depth"] = len(self._batcher._queue)
+        if self.cache is not None:
+            doc["cache_rows_resident"] = len(self.cache)
+            doc["cache_hit_rate"] = round(self.cache.hit_rate, 4)
+        doc["requests_total"] = self._m_requests.value
+        return doc
 
     @property
     def addr(self) -> str:
@@ -478,14 +499,15 @@ class InferenceServer:
 
     def _predict(self, payload: bytes) -> bytes:
         t0 = time.perf_counter()
-        batch = PersiaBatch.from_bytes(payload)
-        self._m_requests.inc()
-        if self._batcher is not None:
-            pred = self._batcher.submit(batch)
-        else:
-            pred = self._forward(batch)
-            self._m_batches.inc()
-            self._m_rows.inc(batch.batch_size)
+        with tracing.span("serving/predict"):
+            batch = PersiaBatch.from_bytes(payload)
+            self._m_requests.inc()
+            if self._batcher is not None:
+                pred = self._batcher.submit(batch)
+            else:
+                pred = self._forward(batch)
+                self._m_batches.inc()
+                self._m_rows.inc(batch.batch_size)
         self._t_e2e.observe(time.perf_counter() - t0)
         return pack_arrays({}, [np.ascontiguousarray(pred)])
 
@@ -501,11 +523,14 @@ class InferenceServer:
         now = time.perf_counter()
         for r in reqs:
             self._t_queue.observe(now - r.t_enqueue)
-        merged, sizes = merge_batches([r.batch for r in reqs])
-        rows = merged.batch_size
-        bucket = self._bucket_for(rows)
-        padded = pad_batch(merged, bucket)
-        pred = self._forward(padded)
+        tctx = next((r.tctx for r in reqs if r.tctx is not None), None)
+        kw = {"ctx": tctx} if tctx is not None else {}
+        with tracing.span("serving/merged_forward", n_reqs=len(reqs), **kw):
+            merged, sizes = merge_batches([r.batch for r in reqs])
+            rows = merged.batch_size
+            bucket = self._bucket_for(rows)
+            padded = pad_batch(merged, bucket)
+            pred = self._forward(padded)
         self._m_batches.inc()
         self._m_rows.inc(rows)
         self._m_padded.inc(bucket - rows)
@@ -516,9 +541,9 @@ class InferenceServer:
             r.done.set()
 
     def _forward(self, batch: PersiaBatch) -> np.ndarray:
-        with self._t_lookup.timer():
+        with self._t_lookup.timer(), tracing.span("serving/lookup"):
             lookup = self._lookup(batch.id_type_features)
-        with self._t_forward.timer():
+        with self._t_forward.timer(), tracing.span("serving/forward"):
             pred, _labels = self.ctx.forward_prepared(batch, lookup)
             return np.asarray(pred)
 
@@ -612,6 +637,8 @@ class InferenceServer:
         self.server.stop()
         if self._batcher is not None:
             self._batcher.close()
+        if self.http is not None:
+            self.http.stop()
 
 
 class InferenceClient:
@@ -734,7 +761,11 @@ def main(argv=None):
                    help="hot-row LRU capacity (0 = no cache)")
     p.add_argument("--cache-ttl-sec", type=float, default=30.0,
                    help="hot-row TTL; bounds staleness vs inc_update")
+    from persia_tpu import obs_http
+
+    obs_http.add_http_args(p)
     args = p.parse_args(argv)
+    tracing.set_service_name(f"serving:{args.port}")
 
     schema = EmbeddingSchema.load(args.embedding_config)
     model = zoo[args.model]()
@@ -749,7 +780,9 @@ def main(argv=None):
                              max_batch_rows=args.max_batch_rows,
                              max_wait_us=args.max_wait_us,
                              cache_rows=args.cache_rows,
-                             cache_ttl_sec=args.cache_ttl_sec)
+                             cache_ttl_sec=args.cache_ttl_sec,
+                             http_port=obs_http.port_from_args(args))
+    obs_http.write_addr_file_from_args(server.http, args)
     server.serve_forever()
 
 

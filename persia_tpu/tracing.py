@@ -1,28 +1,373 @@
-"""Stage timing + stall/deadlock detection.
+"""Cross-tier tracing + stage timing + stall/deadlock detection.
 
 Reference observability surface: per-stage Prometheus gauges
 (embedding_worker_service/mod.rs:83-100, persia-core/src/metrics.rs) and
 an opt-in deadlock detector thread (persia-common/src/utils.rs:22-48,
 enabled by PERSIA_DEADLOCK_DETECTION=1).
 
-Python has no parking_lot introspection, so the detector watches a
-process-wide heartbeat that the pipeline hot loops tick; if a full
-interval passes with no tick while work is marked in flight, every
-thread's stack is dumped to stderr — which is what you need to debug a
-stuck queue/semaphore cycle.
+On top of the reference surface this module adds **distributed
+tracing**: one logical training step spans three tiers (trainer ↔
+embedding worker ↔ sharded PS), and aggregate histograms cannot tell you
+*which* tier made *this* batch slow. A :class:`Span` carries
+``(trace_id, span_id, parent_id)``; the active span lives in a
+thread-local so nested ``with span(...)`` blocks parent naturally; the
+context crosses process boundaries through the RPC envelope (rpc.py
+negotiates the extra envelope slot per connection, like ``__tags__``, so
+legacy peers never see it). Finished spans land in a process-wide ring
+buffer (:class:`TraceCollector`) that the HTTP sidecar
+(:mod:`persia_tpu.obs_http`) serves at ``/trace`` and
+:func:`chrome_trace` exports as Chrome-trace/Perfetto JSON.
+
+Tracing is OFF by default (``PERSIA_TRACING=1`` or
+:func:`enable_tracing` turns it on): every ``span(...)`` call site then
+returns a shared no-op context manager, and the RPC client never probes
+``__trace__`` — the disabled wire is byte-identical to the untraced one.
+
+:class:`StepProfiler` is the device-side companion: opt-in
+``jax.profiler`` start/stop keyed to a trainer step window, so a TPU
+device trace can be captured aligned with the host spans of the same
+steps.
 """
 
+import json
 import os
+import struct
 import sys
 import threading
 import time
 import traceback
-from typing import Optional
+from collections import deque
+from typing import Dict, List, Optional, Tuple
 
 from persia_tpu.logger import get_default_logger
 from persia_tpu.metrics import default_registry
 
 _logger = get_default_logger(__name__)
+
+
+# --- span context ---------------------------------------------------------
+
+_enabled = os.environ.get("PERSIA_TRACING") == "1"
+_tls = threading.local()
+# chrome-trace "pid" label; set_service_name() names this process's track
+_service = [f"pid{os.getpid()}"]
+
+# distinct sentinel: span(ctx=None) means "suppress unless propagated",
+# while an OMITTED ctx falls back to the thread-local parent
+_UNSET = object()
+
+
+def tracing_enabled() -> bool:
+    return _enabled
+
+
+def enable_tracing(on: bool = True):
+    """Flip span recording process-wide. Turn on BEFORE dialing RPC
+    clients that should propagate context: the ``__trace__`` capability
+    is negotiated per connection at dial time."""
+    global _enabled
+    _enabled = bool(on)
+
+
+def set_service_name(name: str):
+    """Name this process's track in exported traces (e.g. ``ps0``,
+    ``worker1``, ``trainer``)."""
+    _service[0] = name
+
+
+def service_name() -> str:
+    return _service[0]
+
+
+def _rand64() -> int:
+    # non-zero 63-bit id: fits signed int64 consumers and msgpack ints
+    while True:
+        (v,) = struct.unpack("<Q", os.urandom(8))
+        v &= (1 << 63) - 1
+        if v:
+            return v
+
+
+def current_context() -> Optional[Tuple[int, int]]:
+    """(trace_id, span_id) of the active span on THIS thread, or None.
+    This is what the RPC client injects into the envelope and what
+    fan-out code captures before handing work to a pool thread."""
+    if not _enabled:
+        return None
+    return getattr(_tls, "ctx", None)
+
+
+class _NullSpan:
+    """Shared no-op for disabled tracing — one attribute read + two
+    no-op method calls per instrumented block."""
+
+    __slots__ = ()
+    trace_id = None
+    span_id = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    @property
+    def ctx(self):
+        return None
+
+    def tag(self, **kw):
+        return self
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class Span:
+    """One timed region. ``__enter__`` installs it as the thread's
+    active context (its children parent to it); ``__exit__`` restores
+    the previous context and hands the finished span to the collector.
+
+    Wall-clock start (``time.time_ns``) makes spans from different
+    processes line up on one timeline; the duration is measured with
+    the monotonic perf counter so it never jumps with clock slew."""
+
+    __slots__ = ("name", "service", "trace_id", "span_id", "parent_id",
+                 "start_ns", "dur_ns", "tags", "pid", "tid", "_prev",
+                 "_t0")
+
+    def __init__(self, name: str, trace_id: int, span_id: int,
+                 parent_id: int, tags: Optional[Dict] = None,
+                 service: Optional[str] = None):
+        self.name = name
+        self.service = service if service is not None else _service[0]
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.tags = tags
+        self.pid = os.getpid()
+        self.tid = threading.current_thread().name
+        self.start_ns = 0
+        self.dur_ns = 0
+
+    @property
+    def ctx(self) -> Tuple[int, int]:
+        """Propagation handle: what children (local or remote) parent to."""
+        return (self.trace_id, self.span_id)
+
+    def tag(self, **kw):
+        if self.tags is None:
+            self.tags = {}
+        self.tags.update(kw)
+        return self
+
+    def __enter__(self):
+        self._prev = getattr(_tls, "ctx", None)
+        _tls.ctx = (self.trace_id, self.span_id)
+        self.start_ns = time.time_ns()
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.dur_ns = time.perf_counter_ns() - self._t0
+        _tls.ctx = self._prev
+        if exc_type is not None:
+            self.tag(error=f"{exc_type.__name__}: {exc_val}")
+        _collector.add(self)
+        return False
+
+    def to_dict(self) -> Dict:
+        """JSON-safe form (ids as hex strings: u64s do not survive
+        JavaScript JSON consumers)."""
+        return {
+            "name": self.name,
+            "service": self.service,
+            "trace_id": f"{self.trace_id:016x}",
+            "span_id": f"{self.span_id:016x}",
+            "parent_id": f"{self.parent_id:016x}" if self.parent_id else None,
+            "start_ns": self.start_ns,
+            "dur_ns": self.dur_ns,
+            "pid": self.pid,
+            "tid": self.tid,
+            "tags": self.tags,
+        }
+
+
+def span(name: str, ctx=_UNSET, root: bool = False, service: Optional[str] = None,
+         **tags):
+    """Open a span as a context manager.
+
+    - default: child of the thread's active span; with no active span,
+      starts a NEW trace (a fresh root).
+    - ``ctx=(trace_id, parent_span_id)``: child of a PROPAGATED context
+      (an RPC envelope, a captured fan-out parent). ``ctx=None``
+      (explicitly) suppresses the span entirely — fan-out helpers pass
+      whatever :func:`current_context` returned, so untraced requests
+      stay untraced instead of spawning orphan roots.
+    - ``root=True``: force a fresh trace id even under an active span
+      (step boundaries).
+    """
+    if not _enabled:
+        return _NULL_SPAN
+    if ctx is None:
+        return _NULL_SPAN
+    if root or ctx is _UNSET:
+        cur = None if root else getattr(_tls, "ctx", None)
+        if cur is None:
+            trace_id, parent = _rand64(), 0
+        else:
+            trace_id, parent = cur
+    else:
+        trace_id, parent = ctx
+    return Span(name, trace_id, _rand64(), parent, tags or None,
+                service=service)
+
+
+# --- collector + export ---------------------------------------------------
+
+
+class TraceCollector:
+    """Bounded ring of finished spans, process-wide. Old spans fall off
+    the back; ``/trace?n=K`` and the bench read the recent window."""
+
+    def __init__(self, capacity: int = 8192):
+        self._dq: "deque[Span]" = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+
+    def add(self, s: Span):
+        with self._lock:
+            self._dq.append(s)
+
+    def recent(self, n: Optional[int] = None) -> List[Span]:
+        with self._lock:
+            spans = list(self._dq)
+        if n is not None and n < len(spans):
+            spans = spans[-n:]
+        return spans
+
+    def clear(self):
+        with self._lock:
+            self._dq.clear()
+
+    def __len__(self) -> int:
+        return len(self._dq)
+
+
+_collector = TraceCollector()
+
+
+def default_collector() -> TraceCollector:
+    return _collector
+
+
+def chrome_trace(spans=None) -> Dict:
+    """Spans (Span objects or ``to_dict()`` dicts — the raw form the
+    sidecar serves, so multi-process merges need no re-parsing) ->
+    Chrome-trace/Perfetto JSON object. Complete ``ph: X`` duration
+    events on one wall-clock timeline; process tracks are named by
+    service via metadata events."""
+    if spans is None:
+        spans = _collector.recent()
+    events = []
+    named_pids = {}
+    for s in spans:
+        d = s.to_dict() if isinstance(s, Span) else s
+        if d["pid"] not in named_pids:
+            named_pids[d["pid"]] = d["service"]
+            events.append({
+                "ph": "M", "name": "process_name", "pid": d["pid"],
+                "tid": 0, "args": {"name": d["service"]},
+            })
+        args = {"trace_id": d["trace_id"], "span_id": d["span_id"],
+                "parent_id": d["parent_id"]}
+        if d.get("tags"):
+            args.update({str(k): v for k, v in d["tags"].items()})
+        events.append({
+            "name": d["name"],
+            "cat": d["service"],
+            "ph": "X",
+            "ts": d["start_ns"] / 1e3,   # microseconds
+            "dur": d["dur_ns"] / 1e3,
+            "pid": d["pid"],
+            "tid": d["tid"],
+            "args": args,
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def export_chrome_trace(path: str, spans=None) -> str:
+    with open(path, "w") as f:
+        json.dump(chrome_trace(spans), f)
+    return path
+
+
+# --- device profiler hooks ------------------------------------------------
+
+
+class StepProfiler:
+    """Opt-in ``jax.profiler`` window keyed to trainer step indices.
+
+    ``on_step(i)`` is called at each step BOUNDARY (before step ``i``
+    runs): the device trace starts when ``i == start_step`` and stops
+    after ``num_steps`` steps, so the captured TPU timeline aligns with
+    the host spans of exactly that step window. ``close()`` stops an
+    open capture (ctx exit / teardown). Environment wiring:
+    ``PERSIA_PROFILE_DIR`` (enables), ``PERSIA_PROFILE_START_STEP``
+    (default 10), ``PERSIA_PROFILE_NUM_STEPS`` (default 5) — see
+    :func:`profiler_from_env`."""
+
+    def __init__(self, logdir: str, start_step: int = 10,
+                 num_steps: int = 5):
+        self.logdir = logdir
+        self.start_step = int(start_step)
+        self.num_steps = max(1, int(num_steps))
+        self.active = False
+        self._done = False
+
+    def on_step(self, step_idx: int):
+        if self._done:
+            return
+        if not self.active and step_idx >= self.start_step:
+            try:
+                import jax
+
+                jax.profiler.start_trace(self.logdir)
+                self.active = True
+                self._stop_at = step_idx + self.num_steps
+                _logger.info("device profiler started at step %d -> %s",
+                             step_idx, self.logdir)
+            except Exception as e:  # profiling must never kill training
+                _logger.warning("jax.profiler start failed: %s", e)
+                self._done = True
+        elif self.active and step_idx >= self._stop_at:
+            self.close()
+
+    def close(self):
+        if not self.active:
+            return
+        self.active = False
+        self._done = True
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+            _logger.info("device profiler stopped -> %s", self.logdir)
+        except Exception as e:
+            _logger.warning("jax.profiler stop failed: %s", e)
+
+
+def profiler_from_env() -> Optional[StepProfiler]:
+    """Build a StepProfiler from PERSIA_PROFILE_* env vars, or None."""
+    logdir = os.environ.get("PERSIA_PROFILE_DIR")
+    if not logdir:
+        return None
+    return StepProfiler(
+        logdir,
+        start_step=int(os.environ.get("PERSIA_PROFILE_START_STEP", "10")),
+        num_steps=int(os.environ.get("PERSIA_PROFILE_NUM_STEPS", "5")),
+    )
+
+
+# --- stall/deadlock detection (pre-existing surface) ----------------------
 
 _beat = 0
 _inflight = 0

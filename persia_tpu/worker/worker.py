@@ -24,6 +24,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from persia_tpu import tracing
 from persia_tpu.config import EmbeddingSchema
 from persia_tpu.data.batch import IDTypeFeature
 from persia_tpu.logger import get_default_logger
@@ -140,6 +141,16 @@ class EmbeddingWorker:
         self._t_aggregate = reg.histogram(
             "update_aggregate_time_cost_sec", labels)
         self._t_ship = reg.histogram("update_ship_time_cost_sec", labels)
+        # buffer-depth/staleness gauges: every mutation happens under
+        # self._lock, so set() from _sync_gauges_locked is exact — these
+        # are what /healthz and a scraper watch to catch a stuck
+        # pipeline (staleness pegged at the semaphore bound, forward
+        # buffer climbing toward ForwardBufferFull)
+        self._g_forward_buf = reg.gauge("worker_forward_buffer_depth",
+                                        labels)
+        self._g_post_buf = reg.gauge("worker_post_forward_buffer_depth",
+                                     labels)
+        self._g_staleness = reg.gauge("worker_staleness", labels)
         # periodic expiry sweep — ingestion-piggybacked expiry alone never
         # fires once the loaders die (see _sweep_loop)
         self._sweep_stop = threading.Event()
@@ -184,7 +195,15 @@ class EmbeddingWorker:
         feats = mw.preprocess_batch(id_type_features, self.schema)
         with self._lock:
             self._forward_id_buffer[ref_id] = (feats, time.monotonic())
+            self._sync_gauges_locked()
         return ref_id
+
+    def _sync_gauges_locked(self):
+        """Mirror buffer depths + staleness into the registry gauges.
+        Caller holds self._lock, so the values are consistent."""
+        self._g_forward_buf.set(len(self._forward_id_buffer))
+        self._g_post_buf.set(len(self._post_forward_buffer))
+        self._g_staleness.set(self.staleness)
 
     def _expire_stale(self):
         horizon = time.monotonic() - self.buffered_data_expired_sec
@@ -202,6 +221,7 @@ class EmbeddingWorker:
                 if expired:
                     _logger.warning("expired %d stale buffered batches",
                                     len(expired))
+            self._sync_gauges_locked()
 
     def _sweep_loop(self):
         """Background expiry, matching the C++ binary's periodic sweep
@@ -259,6 +279,7 @@ class EmbeddingWorker:
         (reference: forward_batch_id, mod.rs:1031-1074)."""
         with self._lock:
             item = self._forward_id_buffer.pop(ref_id, None)
+            self._sync_gauges_locked()
         if item is None:
             raise KeyError(f"ref_id {ref_id} not in forward buffer")
         feats, enter_time = item
@@ -270,6 +291,7 @@ class EmbeddingWorker:
             # reference forward.rs:708-761)
             with self._lock:
                 self._forward_id_buffer[ref_id] = (feats, enter_time)
+                self._sync_gauges_locked()
             raise
         if training:
             with self._lock:
@@ -278,6 +300,7 @@ class EmbeddingWorker:
                 self._post_forward_buffer[ref_id] = (
                     feats, groups, time.monotonic())
                 self.staleness += 1
+                self._sync_gauges_locked()
         return result
 
     def lookup_direct(
@@ -300,27 +323,35 @@ class EmbeddingWorker:
         if self.monitor is not None:
             for f in feats:
                 self.monitor.observe(f.name, f.distinct_signs)
-        with self._t_preprocess.timer():
+        with self._t_preprocess.timer(), tracing.span("worker/preprocess"):
             groups = mw.shard_split(feats, self.schema, self.replica_size)
             mats = mw.alloc_lookup_mats(feats, self.schema)
+        # fan-out pool threads have no thread-local trace context — the
+        # do_lookup_* closures capture the active worker/rpc span (they
+        # run inside it) so per-(shard,dim) PS calls (and through the
+        # RPC envelope, the PS handler spans) keep their parentage
+        tctx = None
+
+        def ps_lookup(g):
+            with tracing.span("worker/ps_lookup", ctx=tctx, shard=g.shard,
+                              dim=g.dim, n=len(g.signs)):
+                return self.ps_clients[g.shard].lookup(g.signs, g.dim,
+                                                       training)
 
         def do_lookup_serialized():
+            nonlocal tctx
+            tctx = tracing.current_context()
             # legacy plane: gather every shard's result, then scatter
             if self._fanout is None or len(groups) <= 1:
-                results = [
-                    self.ps_clients[g.shard].lookup(g.signs, g.dim, training)
-                    for g in groups
-                ]
+                results = [ps_lookup(g) for g in groups]
             else:
-                results = list(self._fanout.map(
-                    lambda g: self.ps_clients[g.shard].lookup(
-                        g.signs, g.dim, training),
-                    groups,
-                ))
+                results = list(self._fanout.map(ps_lookup, groups))
             for g, res in zip(groups, results):
                 mw.scatter_group(mats, g, res)
 
         def do_lookup_streaming():
+            nonlocal tctx
+            tctx = tracing.current_context()
             # one fan-out task per REPLICA; inside it, the replica's
             # (shard,dim) groups multiplex on the thread's one
             # connection (PsClient.lookup_future, tag-matched) and each
@@ -337,22 +368,22 @@ class EmbeddingWorker:
                 by_shard.setdefault(g.shard, []).append(g)
 
             def run_group(g):
-                mw.scatter_group(
-                    mats, g,
-                    self.ps_clients[g.shard].lookup(g.signs, g.dim,
-                                                    training))
+                mw.scatter_group(mats, g, ps_lookup(g))
 
             def run_shard_mux(gs):
                 client = self.ps_clients[gs[0].shard]
-                pend = []
-                for g in gs:
-                    if len(pend) >= self.MUX_WINDOW:
-                        pg, resolve = pend.pop(0)
-                        mw.scatter_group(mats, pg, resolve())
-                    pend.append(
-                        (g, client.lookup_future(g.signs, g.dim, training)))
-                for g, resolve in pend:
-                    mw.scatter_group(mats, g, resolve())
+                with tracing.span("worker/ps_lookup_mux", ctx=tctx,
+                                  shard=gs[0].shard, groups=len(gs)):
+                    pend = []
+                    for g in gs:
+                        if len(pend) >= self.MUX_WINDOW:
+                            pg, resolve = pend.pop(0)
+                            mw.scatter_group(mats, pg, resolve())
+                        pend.append(
+                            (g, client.lookup_future(g.signs, g.dim,
+                                                     training)))
+                    for g, resolve in pend:
+                        mw.scatter_group(mats, g, resolve())
 
             tasks = []
             for gs in by_shard.values():
@@ -374,9 +405,10 @@ class EmbeddingWorker:
         # row overwrites), so a mid-fan-out failure is safe either way
         do_lookup = (do_lookup_streaming if self.streaming
                      else do_lookup_serialized)
-        with self._t_rpc.timer():
+        with self._t_rpc.timer(), tracing.span("worker/rpc",
+                                               groups=len(groups)):
             self._with_ps_retry(do_lookup)
-        with self._t_postprocess.timer():
+        with self._t_postprocess.timer(), tracing.span("worker/postprocess"):
             out = {}
             for feat, mat in zip(feats, mats):
                 slot = self.schema.get_slot(feat.name)
@@ -393,6 +425,7 @@ class EmbeddingWorker:
             item = self._post_forward_buffer.pop(ref_id, None)
             if item is not None:
                 self.staleness -= 1
+            self._sync_gauges_locked()
         if item is None:
             raise KeyError(f"ref_id {ref_id} not in post-forward buffer")
         try:
@@ -405,6 +438,7 @@ class EmbeddingWorker:
             with self._lock:
                 self._post_forward_buffer[ref_id] = item
                 self.staleness += 1
+                self._sync_gauges_locked()
             raise
 
     def _update_gradients_inner(self, ref_id, item, grads, loss_scale):
@@ -436,6 +470,9 @@ class EmbeddingWorker:
             return
 
         def do_update_streaming():
+            # runs inside the worker/update_stream span — capture it so
+            # the fan-out ship threads parent their spans to it
+            tctx = tracing.current_context()
             futures = []
             per_feature: list = [None] * len(feats)
             agg_sec = 0.0
@@ -452,7 +489,8 @@ class EmbeddingWorker:
                 # blocking sends; aggregation continues on this thread)
                 for g, gmat in ready:
                     futures.append(self._fanout.submit(
-                        self._ship_group, g.shard, g.signs, gmat, g.dim))
+                        self._ship_group, g.shard, g.signs, gmat, g.dim,
+                        tctx))
             self._t_aggregate.observe(agg_sec)
             with self._t_ship.timer():
                 for f in futures:
@@ -461,15 +499,18 @@ class EmbeddingWorker:
         # on retry the whole closure re-runs: groups that applied before
         # the failure may re-apply (fresh dedup ids per call) — the same
         # rare, bounded imprecision the restore-path already documents
-        self._with_ps_retry(do_update_streaming)
+        with tracing.span("worker/update_stream", groups=len(groups)):
+            self._with_ps_retry(do_update_streaming)
 
-    def _ship_group(self, shard, signs, gmat, dim):
-        self.ps_clients[shard].update_gradients(signs, gmat, dim)
+    def _ship_group(self, shard, signs, gmat, dim, tctx=None):
+        with tracing.span("worker/ps_update", ctx=tctx, shard=shard,
+                          dim=dim, n=len(signs)):
+            self.ps_clients[shard].update_gradients(signs, gmat, dim)
 
     def _update_gradients_serialized(self, feats, fwd_groups, grads,
                                      loss_scale):
         """Legacy plane: aggregate everything, then ship every group."""
-        with self._t_aggregate.timer():
+        with self._t_aggregate.timer(), tracing.span("worker/aggregate"):
             per_feature = [
                 mw.aggregate_gradients(feat, self.schema.get_slot(feat.name),
                                        grads[feat.name], loss_scale)
@@ -479,23 +520,23 @@ class EmbeddingWorker:
                 feats, self.schema, per_feature, self.replica_size,
                 groups=fwd_groups,
             )
-
         def do_update():
+            # runs inside the worker/ship span — capture it so fan-out
+            # threads parent their per-shard spans to it
+            tctx = tracing.current_context()
             if self._fanout is None or len(shard_groups) <= 1:
                 for shard, dim, signs, g in shard_groups:
-                    self.ps_clients[shard].update_gradients(signs, g, dim)
+                    self._ship_group(shard, signs, g, dim, tctx)
                 return
             futures = [
-                self._fanout.submit(
-                    lambda s, sg, gd, d: self.ps_clients[s].update_gradients(
-                        sg, gd, d),
-                    shard, signs, g, dim)
+                self._fanout.submit(self._ship_group, shard, signs, g, dim,
+                                    tctx)
                 for shard, dim, signs, g in shard_groups
             ]
             for f in futures:
                 f.result()
 
-        with self._t_ship.timer():
+        with self._t_ship.timer(), tracing.span("worker/ship"):
             self._with_ps_retry(do_update)
 
     def _with_ps_retry(self, fn):
@@ -607,14 +648,19 @@ class EmbeddingWorker:
         groups = [np.nonzero(shards == r)[0] for r in np.unique(shards)]
         replicas = [int(shards[sel[0]]) for sel in groups]
 
+        tctx = tracing.current_context()
+
+        def fetch_one(r, sel):
+            with tracing.span("worker/ps_lookup", ctx=tctx, shard=r,
+                              dim=dim, n=len(sel)):
+                return self.ps_clients[r].lookup(signs[sel], dim, False)
+
         def fetch_all():
             if self._fanout is None or len(groups) <= 1:
-                return [self.ps_clients[r].lookup(signs[sel], dim, False)
+                return [fetch_one(r, sel)
                         for r, sel in zip(replicas, groups)]
             return list(self._fanout.map(
-                lambda rs: self.ps_clients[rs[0]].lookup(
-                    signs[rs[1]], dim, False),
-                zip(replicas, groups)))
+                lambda rs: fetch_one(*rs), zip(replicas, groups)))
 
         with self._t_rpc.timer():
             results = self._with_ps_retry(fetch_all)

@@ -38,6 +38,11 @@ while [ "$(date +%s)" -lt "$END" ]; do
       #    round 7 documents the split)
       step "bench rpc (data plane)" python bench.py --mode rpc --max-seconds 900
       step "bench worker (cycle breakdown)" python bench.py --mode worker --max-seconds 1100
+      # 4b. observability: traced worker+PS cycle (per-span breakdown +
+      #     tracing overhead) and keep the exported cross-process
+      #     Chrome-trace JSON from the TPU host next to the log
+      step "bench trace (observability)" python bench.py --mode trace \
+        --trace-out /root/repo/TRACE_capture.json --max-seconds 900
       # 5. re-capture the headline near the end of the window
       step "re-capture: python bench.py" python bench.py
       echo "$(date -u +%FT%TZ) chip sequence complete — see BENCH_CAPTURE_r05.log" >> "$LOG"
