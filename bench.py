@@ -969,6 +969,174 @@ def bench_worker_service(batch_size, steps, native_worker, n_ps=2, dim=DIM):
     return steps * batch_size / elapsed
 
 
+def bench_chaos(batch_size, steps, n_ps=2, dim=8, kill_replica=1,
+                staleness=4):
+    """Fault-tolerance bench: a REAL training loop (ForwardEngine +
+    BackwardEngine over a RemoteEmbeddingWorker and PS subprocesses)
+    has one PS replica SIGKILLed mid-loop. The ServiceCtx supervisor
+    detects the death (process exit / sidecar probe), restarts the
+    replica with ``--initial-checkpoint`` + ``--replay-inc-dir``, the
+    worker tier re-resolves and re-arms it, and the loop finishes.
+
+    Reports: detection latency (kill -> supervisor noticed), recovery
+    time (noticed -> restored replica Idle + registered), lost updates
+    (backward ships that exhausted every retry during the outage),
+    staleness-permit balance (must be exactly zero leaked), and
+    post-recovery lookup parity: every row durably covered by the last
+    checkpoint + incremental packets of the killed replica must read
+    back EXACTLY from the restored store (phase-2 training uses a
+    disjoint sign range, so the phase-1 rows are immutable witnesses).
+    """
+    import tempfile
+    import threading
+    from types import SimpleNamespace
+
+    import yaml
+
+    from persia_tpu.checkpoint import iter_psd_entries
+    from persia_tpu.config import EmbeddingSchema, uniform_slots
+    from persia_tpu.data.batch import IDTypeFeatureWithSingleID, PersiaBatch
+    from persia_tpu.pipeline import ForwardEngine
+    from persia_tpu.service.helper import ServiceCtx
+    from persia_tpu.service.ps_service import PsClient
+
+    n_slots = 4
+    schema = EmbeddingSchema(slots_config=uniform_slots(
+        [f"slot_{s}" for s in range(n_slots)], dim=dim))
+    tmp = tempfile.mkdtemp(prefix="persia_chaos_")
+    ckpt_dir = os.path.join(tmp, "ckpt")
+    inc_dir = os.path.join(tmp, "inc")
+    gc_path = os.path.join(tmp, "global.yml")
+    with open(gc_path, "w") as f:
+        # small inc buffer: packets flush every few batches, so the
+        # restore path has real replay work
+        yaml.safe_dump({"parameter_server": {
+            "capacity": 1_000_000, "num_hashmap_internal_shards": 4,
+            "enable_incremental_update": True,
+            "incremental_buffer_size": max(64, batch_size),
+            "incremental_dir": inc_dir}}, f)
+    rng = np.random.default_rng(0)
+
+    def batch(lo, hi):
+        return PersiaBatch([
+            IDTypeFeatureWithSingleID(
+                f"slot_{s}",
+                rng.integers(lo, hi, size=batch_size, dtype=np.uint64))
+            for s in range(n_slots)
+        ], requires_grad=True)
+
+    phase1 = max(6, steps // 3)
+    phase2 = max(10, steps)
+    kill_at = 3
+    t_kill = [0.0]
+    result = {}
+    with ServiceCtx(schema, n_workers=1, n_ps=n_ps,
+                    global_config_path=gc_path, supervise_ps=True,
+                    ps_restore_dir=ckpt_dir, ps_inc_dir=inc_dir,
+                    ps_probe_interval=0.25) as svc:
+        w = svc.remote_worker()
+        w.configure_parameter_servers(
+            "bounded_uniform", {"lower": -0.01, "upper": 0.01}, 1.0, 10.0)
+        w.register_optimizer({"type": "sgd", "lr": 0.1, "wd": 0.0})
+        engine = ForwardEngine(SimpleNamespace(worker=w), num_workers=2,
+                               embedding_staleness=staleness)
+
+        def train(batches):
+            for lb in engine.run(iter(batches)):
+                grads = {name: np.ones_like(r.embeddings)
+                         for name, r in lb.lookup.items()}
+                engine.backward.submit(lb.ref_id, grads)
+            engine.flush(timeout=240)
+
+        # phase 1: build durable state — train, checkpoint, train more
+        # so incremental packets accumulate past the checkpoint
+        train([batch(0, 1 << 16) for _ in range(phase1)])
+        w.dump(ckpt_dir)
+        train([batch(0, 1 << 16) for _ in range(phase1 // 2 + 1)])
+        log(f"chaos: phase 1 done ({phase1 + phase1 // 2 + 1} steps), "
+            f"checkpoint + inc packets in place")
+
+        # phase 2 (disjoint sign range): kill the replica mid-loop
+        killed = threading.Event()
+
+        def phase2_batches():
+            for s in range(phase2):
+                if s == kill_at and not killed.is_set():
+                    p = svc.ps_proc(kill_replica)
+                    log(f"chaos: SIGKILL ps-{kill_replica} (pid {p.pid}) "
+                        f"at step {s}")
+                    t_kill[0] = time.monotonic()
+                    p.kill()
+                    killed.set()
+                yield batch(1 << 20, (1 << 20) + (1 << 16))
+
+        t0 = time.perf_counter()
+        train(phase2_batches())
+        loop_sec = time.perf_counter() - t0
+        events = svc.wait_ps_recoveries(1, timeout=60)
+        ev = events[0]
+        if "failed" in ev:
+            raise RuntimeError(f"PS recovery FAILED: {ev}")
+        detection_sec = ev["t_detected"] - t_kill[0]
+        recovery_sec = ev["recovery_sec"]
+        lost = engine.backward.lost_updates
+        permits_leaked = staleness - engine.staleness_sem._value
+        engine.shutdown()
+
+        # parity: overlay the killed replica's checkpoint shard with its
+        # inc packets IN REPLAY ORDER (sorted names, checkpoint first) —
+        # the exact reconstruction the restored PS performed. The
+        # witness set is the PHASE-1 sign range only: those rows are
+        # never touched after the kill (phase 2 trains a disjoint
+        # range), so every one must read back bit-exact; phase-2 rows
+        # keep training past their last packet flush and so cannot be
+        # compared against a durable copy.
+        phase1_max = 1 << 16
+        expected = {}
+        shard_file = os.path.join(ckpt_dir, f"replica_{kill_replica}.psd")
+        for sign, _d, vec in iter_psd_entries(shard_file):
+            if sign < phase1_max:
+                expected[sign] = vec
+        for name in sorted(os.listdir(inc_dir)):
+            pth = os.path.join(inc_dir, name, f"{kill_replica}.inc")
+            if name.startswith("inc_") and os.path.exists(pth):
+                for sign, _d, vec in iter_psd_entries(pth):
+                    if sign < phase1_max:
+                        expected[sign] = vec
+        client = PsClient(svc.ps_addrs[kill_replica])
+        mismatches = 0
+        for sign, vec in expected.items():
+            got = client.get_entry(sign)
+            if got is None or not np.array_equal(got[1][:len(vec)], vec):
+                mismatches += 1
+        result = {
+            "detection_sec": round(detection_sec, 3),
+            "recovery_sec": round(recovery_sec, 3),
+            "kill_to_recovered_sec": round(detection_sec + recovery_sec, 3),
+            "lost_updates": lost,
+            "staleness_permits_leaked": permits_leaked,
+            "parity_rows_checked": len(expected),
+            "parity_mismatches": mismatches,
+            "phase2_loop_sec": round(loop_sec, 2),
+            "restarts": len(events),
+        }
+    log(f"chaos: detection {result['detection_sec'] * 1e3:.0f} ms, "
+        f"recovery {result['recovery_sec']:.2f} s, "
+        f"lost_updates={result['lost_updates']}, "
+        f"permits_leaked={result['staleness_permits_leaked']}, "
+        f"parity {result['parity_rows_checked']} rows / "
+        f"{result['parity_mismatches']} mismatches")
+    if result["parity_mismatches"]:
+        raise RuntimeError(
+            f"post-recovery parity FAILED: {result['parity_mismatches']} "
+            f"of {result['parity_rows_checked']} restored rows differ")
+    if result["staleness_permits_leaked"]:
+        raise RuntimeError(
+            f"{result['staleness_permits_leaked']} staleness permits "
+            f"leaked across the kill/recovery cycle")
+    return result["kill_to_recovered_sec"], result
+
+
 def make_infer_requests(num, rows, n_slots, num_dense, vocab=1 << 18,
                         a=1.2, seed=0):
     """Pre-serialized label-less PersiaBatch blobs with Zipf-skewed signs
@@ -1469,7 +1637,7 @@ def main():
     p.add_argument("--mode",
                    choices=["hybrid", "device", "cached", "attn", "wire",
                             "worker", "worker-svc", "store", "roofline",
-                            "infer", "rpc", "trace"],
+                            "infer", "rpc", "trace", "chaos"],
                    default="device")
     p.add_argument("--trace-out", default="/tmp/persia_trace_capture.json",
                    help="trace mode: exported Chrome-trace JSON path")
@@ -1501,6 +1669,7 @@ def main():
         "infer": ("infer_microbatched_qps", "req/sec"),
         "rpc": ("rpc_out_of_order_msgs_per_sec", "msgs/sec"),
         "trace": ("trace_overhead_pct", "percent"),
+        "chaos": ("chaos_ps_kill_to_recovered_sec", "sec"),
     }[args.mode]
 
     # Shared two-tier watchdog (persia_tpu.utils.arm_watchdog — the same
@@ -1520,7 +1689,7 @@ def main():
         args.batch_size, args.steps, args.warmup = 256, 3, 1
 
     if args.mode not in ("wire", "worker", "worker-svc", "store", "rpc",
-                         "trace"):  # host-only modes skip jax
+                         "trace", "chaos"):  # host-only modes skip jax
         # local verification escape hatch (nn_worker.py honors the same
         # variable); plain JAX_PLATFORMS=cpu also counts — the axon
         # platform plugin re-pins jax.config via sitecustomize, so the
@@ -1566,6 +1735,15 @@ def main():
         # host-side metric: no meaningful ratio against the chip-throughput
         # baseline constant, so pin 1.0 like wire mode
         vs_baseline = 1.0
+    elif args.mode == "chaos":
+        value, detail = bench_chaos(
+            min(args.batch_size, 256) if args.smoke else args.batch_size,
+            max(args.steps, 5))
+        # no external baseline for recovery time; the hard gates (zero
+        # leaked permits, parity-exact restore) are enforced inside —
+        # reaching here means they held
+        vs_baseline = 1.0
+        extra["detail"] = detail
     elif args.mode == "trace":
         value, detail = bench_trace(args.batch_size, max(args.steps, 5),
                                     trace_out=args.trace_out)

@@ -64,7 +64,15 @@ class IncrementalUpdateDumper:
         from persia_tpu.ps.store import DUMP_MAGIC
 
         self._seq += 1
-        name = f"inc_{time.strftime('%Y%m%d%H%M%S')}_{self._seq:06d}"
+        # the replica index is part of the packet NAME, not just the
+        # file inside: all replicas share one inc_dir (global config),
+        # and two replicas flushing in the same second used to collide
+        # on the same packet directory (rename onto a non-empty dir ->
+        # the update RPC that triggered the flush failed). A restarted
+        # replica restarts seq at 1, so the pid suffix keeps a fresh
+        # incarnation from colliding with its predecessor's packets.
+        name = (f"inc_{time.strftime('%Y%m%d%H%M%S')}_{self._seq:06d}"
+                f"_r{self.replica_index}_p{os.getpid()}")
         pkt_dir = os.path.join(self.inc_dir, name)
         tmp_dir = pkt_dir + ".tmp"
         os.makedirs(tmp_dir, exist_ok=True)
@@ -91,12 +99,20 @@ class IncrementalUpdateDumper:
 
 
 class IncrementalUpdateLoader:
-    """Infer-side: scan ``inc_dir`` and hot-load new packets."""
+    """Infer-side: scan ``inc_dir`` and hot-load new packets.
 
-    def __init__(self, holder, inc_dir: str, scan_interval_sec: float = 10.0):
+    ``replica_index`` restricts the load to that replica's ``.inc``
+    files — the crash-recovery boot replay uses this so a restored PS
+    shard reconstructs exactly ITS rows (all replicas share one
+    inc_dir); the default (None) keeps the infer-side behavior of
+    loading every replica's entries."""
+
+    def __init__(self, holder, inc_dir: str, scan_interval_sec: float = 10.0,
+                 replica_index: Optional[int] = None):
         self.holder = holder
         self.inc_dir = inc_dir
         self.scan_interval_sec = scan_interval_sec
+        self.replica_index = replica_index
         self._applied: Set[str] = set()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -119,6 +135,9 @@ class IncrementalUpdateLoader:
                 info = json.load(f)
             for fn in sorted(os.listdir(pkt_dir)):
                 if not fn.endswith(".inc"):
+                    continue
+                if (self.replica_index is not None
+                        and fn != f"{self.replica_index}.inc"):
                     continue
                 for sign, dim, vec in iter_psd_entries(
                         os.path.join(pkt_dir, fn)):

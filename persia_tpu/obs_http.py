@@ -13,6 +13,13 @@ slow batch can be followed across tiers without restarting anything:
   fields (service name, pid, uptime) with whatever the service's
   ``health_fn`` reports. Always HTTP 200 while the process can answer —
   liveness is the TCP accept; the *content* carries the judgement.
+- ``GET /healthz?ready=1`` — READINESS variant: same document, but the
+  status code follows the health doc's ``ready`` field — 503 when the
+  service reports ``ready: false`` (a PS that is Loading/restoring, a
+  worker whose PS tier is down). Liveness and readiness are different
+  questions: a restarting replica is alive (do not kill it again) but
+  not ready (do not route traffic to it) — supervisors probe the
+  former, k8s readiness probes and load balancers the latter.
 - ``GET /trace?n=K[&format=chrome|raw]`` — the most recent K spans from
   the process-local trace collector. ``chrome`` (default) is a
   Chrome-trace/Perfetto ``traceEvents`` JSON ready to load as-is;
@@ -70,14 +77,24 @@ class ObservabilityServer:
                 pass
 
             def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+                status = 200
                 try:
                     url = urlparse(self.path)
                     if url.path == "/metrics":
                         body = sidecar.registry.render().encode()
                         ctype = "text/plain; version=0.0.4; charset=utf-8"
                     elif url.path == "/healthz":
-                        body = json.dumps(sidecar._health()).encode()
+                        doc = sidecar._health()
+                        body = json.dumps(doc).encode()
                         ctype = "application/json"
+                        q = parse_qs(url.query)
+                        if (q.get("ready", ["0"])[0] not in ("", "0")
+                                and doc.get("ready") is False):
+                            # readiness probe: alive but must not
+                            # receive traffic (Loading/restoring/
+                            # unarmed) — the 503 makes supervisors and
+                            # k8s probes not route to it mid-recovery
+                            status = 503
                     elif url.path == "/trace":
                         q = parse_qs(url.query)
                         n = int(q.get("n", ["256"])[0])
@@ -90,7 +107,7 @@ class ObservabilityServer:
                 except Exception as e:  # noqa: BLE001 — surfaced as 500
                     self.send_error(500, str(e))
                     return
-                self.send_response(200)
+                self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()

@@ -180,6 +180,16 @@ class BackwardEngine:
         # observable next to the staleness gauge
         self._g_pending = default_registry().gauge(
             "pipeline_backward_pending_updates")
+        # updates whose ship exhausted every transport retry: bounded-
+        # staleness async SGD tolerates a dropped sparse update, so a
+        # PERMANENT ship failure releases its permit and counts here
+        # instead of poisoning the engine (which used to wedge the
+        # trainer at the staleness bound — every later batch's permit
+        # was acquired by the feeder but its grads never enqueued).
+        # Programming errors (missing grads, bad ref) still propagate.
+        self.lost_updates = 0
+        self._c_lost = default_registry().counter(
+            "pipeline_lost_updates_total")
         # register on the worker so checkpoint dumps can quiesce us
         engines = getattr(worker, "_backward_engines", None)
         if engines is None:
@@ -195,6 +205,12 @@ class BackwardEngine:
 
     def submit(self, ref_id: int, grads: Dict[str, Any]):
         if self._errors:
+            # this batch's grads will never enqueue, so the permit its
+            # lookup acquired must not stay captive (the round-4 leak:
+            # after `staleness` poisoned submits the feeder blocked in
+            # acquire forever — trainer deadlocked at the bound)
+            if self.staleness_sem is not None:
+                self.staleness_sem.release()
             raise self._errors[0]
         with self._pending_cv:
             self._pending += 1
@@ -254,9 +270,35 @@ class BackwardEngine:
                         grads = dict(zip(grads.names, per_slot))
                     self._update_with_recovery(ref_id, grads)
                 heartbeat()
-            except BaseException as e:  # propagate to the training thread
-                _logger.error("backward update failed: %s", e)
-                self._errors.append(e)
+            except BaseException as e:
+                from persia_tpu.rpc import RpcDeadlineExceeded
+
+                # transport loss and shed deadlines only — nested-hop
+                # transport failures arrive typed as RpcConnectionLost/
+                # RpcTimeout (ConnectionError/OSError subclasses) via
+                # the err-envelope mapping. A PLAIN RpcError is a real
+                # application failure (bad gradient shape, handler bug)
+                # and must propagate: silently counting every update of
+                # a buggy job as "lost" would train nothing and say so
+                # nowhere.
+                if isinstance(e, (RpcDeadlineExceeded, ConnectionError,
+                                  OSError)):
+                    # transport-class failure that survived the full
+                    # recovery ladder: the service tier is (still) down.
+                    # Drop THIS update — count it, release its permit
+                    # (finally below) — rather than wedging the whole
+                    # engine; async sparse SGD's staleness bound already
+                    # prices in a bounded number of lost updates.
+                    with self._pending_cv:
+                        self.lost_updates += 1
+                    self._c_lost.inc()
+                    _logger.error(
+                        "backward update permanently failed (%s); "
+                        "counted as lost_update #%d, permit released",
+                        e, self.lost_updates)
+                else:  # programming error: propagate to the trainer
+                    _logger.error("backward update failed: %s", e)
+                    self._errors.append(e)
             finally:
                 work_finished()
                 self._g_pending.dec(1)

@@ -32,6 +32,24 @@ probe "no such method" and never see the extra slot; with tracing
 disabled (the default) the probe itself is never sent, so the wire is
 byte-identical to the untraced protocol.
 
+Two more optional envelope slots follow the same negotiate-down rule:
+a client that wants **deadline propagation** probes ``__deadline__`` at
+dial time; when the server acks, each call may carry its remaining time
+budget (seconds) as a fourth envelope slot, and the server SHEDS work
+whose budget expired before dispatch (typed back to the caller as
+:class:`RpcDeadlineExceeded`). Neither probe nor slot exists when the
+feature is off — byte-identical legacy wire.
+
+Failures are typed: transport-level loss surfaces as
+:class:`RpcTimeout` / :class:`RpcConnectionLost` (subclassing the
+builtin ``TimeoutError`` / ``ConnectionError`` so existing catch
+clauses keep working), application errors stay plain :class:`RpcError`,
+and a :class:`CircuitBreaker` (per-replica, used by ``PsClient``) fails
+fast with :class:`RpcCircuitOpen` instead of re-walking the retry
+ladder against a dead peer. Deterministic fault injection
+(:mod:`persia_tpu.faults`) hooks the client send and server receive
+paths behind a zero-overhead ``_active`` guard.
+
 Numpy arrays are framed with :func:`pack_arrays` / :func:`unpack_arrays`.
 :func:`pack_arrays_sg` is the zero-copy twin: it returns a buffer LIST
 that ``sendmsg``/writev hands to the kernel without the ``tobytes()``
@@ -43,6 +61,7 @@ The server runs a thread per connection (clients hold few, long-lived
 connections — trainers and workers, not end users).
 """
 
+import os
 import select
 import socket
 import struct
@@ -53,7 +72,7 @@ from typing import Callable, Dict, List, Optional, Tuple, Union
 import msgpack
 import numpy as np
 
-from persia_tpu import tracing
+from persia_tpu import faults, tracing
 
 try:
     import zstandard
@@ -107,7 +126,194 @@ def _is_loopback(sock: socket.socket) -> bool:
 
 
 class RpcError(RuntimeError):
-    pass
+    """Base of the typed RPC failure hierarchy. Application-level errors
+    (a handler raised) are plain ``RpcError``; transport-level failures
+    surface as the subclasses below, which ALSO subclass the builtin
+    exception callers historically caught (``ConnectionError`` /
+    ``TimeoutError``) — existing ``except (RpcError, ConnectionError,
+    OSError)`` clauses keep working, while new callers can distinguish
+    retryable transport loss from fatal application errors."""
+
+
+class RpcTimeout(RpcError, TimeoutError):
+    """The socket timed out waiting for the peer (``socket.timeout`` is
+    a ``TimeoutError``/``OSError``, so legacy catch clauses still
+    match). Retryable: the request MAY have executed."""
+
+
+class RpcConnectionLost(RpcError, ConnectionError):
+    """The connection died mid-call (reset, closed, refused). Retryable
+    for idempotent/dedup'd methods; the completed state of an in-flight
+    request is ambiguous."""
+
+
+class RpcDeadlineExceeded(RpcError):
+    """The server shed the request because its propagated deadline had
+    already passed at dispatch time (or a deadline-aware layer failed
+    it fast). NOT retryable with the same deadline — the time budget is
+    spent; callers degrade instead (serving zero-vector fallback)."""
+
+
+class RpcCircuitOpen(RpcConnectionLost):
+    """Fail-fast refusal: the replica's :class:`CircuitBreaker` is open
+    after consecutive transport failures. No wire traffic happened; a
+    background probe re-closes the breaker when the replica returns."""
+
+
+# server-side shed marker: the client maps this envelope prefix back to
+# the typed exception (the err slot carries "ExcName: message" strings)
+_DEADLINE_ERR = "RpcDeadlineExceeded"
+
+# err-envelope exception names that re-type on the client. A handler in
+# a MIDDLE tier (worker) that loses ITS downstream hop (PS) reports
+# "ConnectionResetError: ..." through a perfectly healthy connection —
+# without this mapping the caller sees a plain RpcError and every
+# transport-aware layer above (serving degradation, pipeline
+# lost-update accounting) misclassifies a nested outage as an
+# application bug. Plain OSError is deliberately NOT mapped: it carries
+# genuine application failures (filesystem errors in dump/load paths)
+# that must surface, not be silently retried/dropped as transport loss.
+_REMOTE_LOST = frozenset((
+    "RpcConnectionLost", "RpcCircuitOpen", "ConnectionError",
+    "ConnectionResetError", "ConnectionRefusedError",
+    "ConnectionAbortedError", "BrokenPipeError",
+))
+_REMOTE_TIMEOUT = frozenset(("RpcTimeout", "TimeoutError", "timeout"))
+
+
+def _typed_call_error(addr: str, method: str, msg: str) -> RpcError:
+    msg = str(msg)
+    name = msg.split(":", 1)[0]
+    full = f"{addr} {method}: {msg}"
+    if name == _DEADLINE_ERR:
+        return RpcDeadlineExceeded(full)
+    if name in _REMOTE_LOST:
+        return RpcConnectionLost(full)
+    if name in _REMOTE_TIMEOUT:
+        return RpcTimeout(full)
+    return RpcError(full)
+
+
+def _typed_transport_error(e: BaseException, addr: str,
+                           method: str) -> RpcError:
+    """Wrap a raw OSError/socket.timeout in the typed hierarchy (pass
+    already-typed errors through untouched)."""
+    if isinstance(e, RpcError):
+        return e
+    if isinstance(e, socket.timeout):
+        return RpcTimeout(f"{addr} {method}: {e!r}")
+    return RpcConnectionLost(f"{addr} {method}: {e!r}")
+
+
+class CircuitBreaker:
+    """Per-replica fail-fast gate: CLOSED -> (``threshold`` consecutive
+    transport failures) -> OPEN, where :meth:`allow` refuses instantly
+    (callers raise :class:`RpcCircuitOpen` without touching the wire,
+    so a dead PS replica costs microseconds instead of a full
+    retry-with-backoff ladder per call). From OPEN, a background probe
+    (``probe`` callable, e.g. a bare TCP connect) — or the ``cooldown``
+    clock when no probe is given — moves the breaker to HALF_OPEN:
+    exactly ONE trial call is let through; its success closes the
+    breaker, its failure re-opens it. Application errors (plain
+    RpcError) never trip the breaker — only transport-level loss does.
+    """
+
+    def __init__(self, threshold: int = 5, cooldown: float = 1.0,
+                 probe: Optional[Callable[[], bool]] = None,
+                 probe_interval: float = 0.25):
+        self.threshold = max(1, int(threshold))
+        self.cooldown = float(cooldown)
+        self.probe_interval = float(probe_interval)
+        self._probe = probe
+        self._lock = threading.Lock()
+        self._state = "closed"
+        self._fails = 0
+        self._opened_at = 0.0
+        self._trial_inflight = False
+        self._probe_thread: Optional[threading.Thread] = None
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def allow(self) -> bool:
+        """True when a call may proceed (closed, or the half-open
+        trial slot). False == fail fast, no wire traffic."""
+        with self._lock:
+            if self._state == "closed":
+                return True
+            if self._state == "open":
+                if _time.monotonic() - self._opened_at < self.cooldown:
+                    return False
+                self._state = "half_open"
+                self._trial_inflight = False
+            # half_open: one trial call at a time
+            if self._trial_inflight:
+                return False
+            self._trial_inflight = True
+            return True
+
+    def record_success(self):
+        with self._lock:
+            self._state = "closed"
+            self._fails = 0
+            self._trial_inflight = False
+
+    def record_failure(self):
+        with self._lock:
+            self._fails += 1
+            if self._state == "half_open" or self._fails >= self.threshold:
+                self._open_locked()
+
+    def _open_locked(self):
+        self._state = "open"
+        self._opened_at = _time.monotonic()
+        self._trial_inflight = False
+        if self._probe is not None and (
+            self._probe_thread is None or not self._probe_thread.is_alive()
+        ):
+            self._probe_thread = threading.Thread(
+                target=self._probe_loop, daemon=True,
+                name="circuit-breaker-probe")
+            self._probe_thread.start()
+
+    def _probe_loop(self):
+        """Background recovery watch: while the breaker is open, poll
+        the probe; the first success arms the half-open trial slot
+        immediately (no need to wait out the cooldown)."""
+        while True:
+            with self._lock:
+                if self._state != "open":
+                    return
+            try:
+                ok = bool(self._probe())
+            except Exception:
+                ok = False
+            if ok:
+                with self._lock:
+                    if self._state == "open":
+                        self._state = "half_open"
+                        self._trial_inflight = False
+                return
+            _time.sleep(self.probe_interval)
+
+
+def tcp_probe(addr: str, timeout: float = 1.0) -> Callable[[], bool]:
+    """Cheapest liveness probe for a breaker: does the address accept a
+    TCP connection. (Readiness — checkpoint restored, optimizer armed —
+    is the trial call's job; the probe only gates when to bother.)"""
+    host, port = addr.rsplit(":", 1)
+
+    def probe() -> bool:
+        try:
+            with socket.create_connection((host, int(port)),
+                                          timeout=timeout):
+                return True
+        except OSError:
+            return False
+
+    return probe
 
 
 def pack_arrays(meta: dict, arrays: List[np.ndarray]) -> bytes:
@@ -327,7 +533,7 @@ class RpcServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  concurrent_streams: int = 1, enable_tags: bool = True,
-                 enable_trace: bool = True):
+                 enable_trace: bool = True, enable_deadline: bool = True):
         from collections import OrderedDict
 
         self._concurrent_streams = max(1, int(concurrent_streams))
@@ -335,11 +541,19 @@ class RpcServer:
         # ``__tags__`` negotiation answers "no such method" and clients
         # negotiate down to untagged framing (compat tests use this);
         # enable_trace=False likewise refuses the ``__trace__`` probe so
-        # clients never attach the trace envelope slot
+        # clients never attach the trace envelope slot, and
+        # enable_deadline=False refuses ``__deadline__`` so clients
+        # never attach the deadline slot (legacy-peer emulation)
         self._enable_tags = enable_tags
         self._handlers: Dict[str, Callable[[bytes], bytes]] = {}
         if enable_trace:
             self._handlers["__trace__"] = lambda payload: b""
+        if enable_deadline:
+            self._handlers["__deadline__"] = lambda payload: b""
+        # remote fault-injection control (chaos bench re-arms a live PS
+        # subprocess): opt-in by env — never exposed by default
+        if os.environ.get("PERSIA_FAULTS_RPC") == "1":
+            self._handlers["__faults__"] = faults._handle_control
         # /healthz surface: in-flight + served handler counts and the
         # age of the last request seen (scrapers distinguish "idle" from
         # "wedged" by pairing this with their own traffic knowledge).
@@ -351,6 +565,7 @@ class RpcServer:
         self._stats_lock = threading.Lock()
         self._inflight_reqs = 0
         self._served_reqs = 0
+        self._shed_reqs = 0  # deadline-expired requests refused unrun
         self._last_activity = _time.monotonic()
         self._stream_pool = None  # built lazily on the first connection
         self._stream_pool_lock = threading.Lock()
@@ -411,23 +626,34 @@ class RpcServer:
                 "rpc_addr": self.addr,
                 "inflight_rpcs": self._inflight_reqs,
                 "served_rpcs": self._served_reqs,
+                "shed_rpcs": self._shed_reqs,
                 "last_activity_age_sec": round(
                     _time.monotonic() - self._last_activity, 3),
             }
 
     def _handle_one(self, method: str, payload, req_id,
-                    trace=None) -> Tuple[list, bytes]:
+                    trace=None, deadline=None) -> Tuple[list, bytes]:
         """Run one request to a (envelope, body) response pair — the
         single execution point for BOTH the serial and dispatch-pool
         paths. ``trace`` is the propagated ``(trace_id, parent_span)``
         context from the envelope (None when the request is untraced):
         the handler runs under a child span, so per-shard PS handler
         work shows up parented to the caller's stage span even when a
-        pool thread answers out of order."""
+        pool thread answers out of order. ``deadline`` is the request's
+        LOCAL-monotonic expiry (computed at recv from the envelope's
+        remaining-time slot): a request whose deadline already passed —
+        e.g. it sat queued behind a slow handler in the dispatch pool —
+        is SHED, not run; the caller's time budget is spent either way,
+        and running it anyway would burn server work nobody reads."""
         with self._stats_lock:
             self._inflight_reqs += 1
             self._last_activity = _time.monotonic()
         try:
+            if deadline is not None and _time.monotonic() >= deadline:
+                with self._stats_lock:
+                    self._shed_reqs += 1
+                return ["err", f"{_DEADLINE_ERR}: deadline expired "
+                               f"before {method!r} dispatched"], b""
             handler = self._handlers.get(method)
             if handler is None:
                 raise RpcError(f"no such method {method!r}")
@@ -496,11 +722,12 @@ class RpcServer:
             except OSError:
                 conn_dead.set()
 
-        def handle_direct(method, payload, req_id, tag, trace):
+        def handle_direct(method, payload, req_id, tag, trace, deadline):
             """Tagged request in a pool thread: handle and send straight
             from here, in COMPLETION order — no queue hop, no writer
             wakeup (out-of-order is the tag wire's whole point)."""
-            env, body = self._handle_one(method, payload, req_id, trace)
+            env, body = self._handle_one(method, payload, req_id, trace,
+                                         deadline)
             send_response(env, body, tag)
             with queued_lock:
                 queued[0] -= 1
@@ -541,6 +768,25 @@ class RpcServer:
                     except (ConnectionError, OSError):
                         break
                     method = env[0]
+                    if faults._active:
+                        # injection sites for the chaos tests: reset
+                        # kills the connection cold, drop swallows the
+                        # frame (client times out), error answers an
+                        # err envelope, corrupt mangles the payload
+                        # (handler errors, connection survives)
+                        try:
+                            act = faults.fire("rpc.server.recv",
+                                              method=method)
+                        except ConnectionError:
+                            break
+                        except faults.InjectedFault as e:
+                            send_response(
+                                ["err", f"InjectedFault: {e}"], b"", tag)
+                            continue
+                        if act == "drop":
+                            continue
+                        if act == "corrupt":
+                            payload = faults.corrupt_bytes(payload)
                     if method == "__shutdown__":
                         pending.put(("__SHUTDOWN__", tag))
                         wt.join()
@@ -555,6 +801,12 @@ class RpcServer:
                         continue
                     req_id = env[1] if len(env) >= 3 else None
                     trace = env[2] if len(env) >= 4 else None
+                    # deadline slot carries REMAINING seconds (clock-sync
+                    # free); pin it to this host's monotonic clock once,
+                    # at recv — queue wait then counts against it
+                    deadline = env[3] if len(env) >= 5 else None
+                    if deadline is not None:
+                        deadline = _time.monotonic() + float(deadline)
                     if flags & _FLAG_PIPELINED:
                         # the client declared more requests may be in
                         # flight: executing inline would head-of-line
@@ -581,7 +833,8 @@ class RpcServer:
                         # request queued behind this one: respond from
                         # the reader thread
                         renv, rbody = self._handle_one(method, payload,
-                                                       req_id, trace)
+                                                       req_id, trace,
+                                                       deadline)
                         send_response(renv, rbody, tag)
                         if conn_dead.is_set():
                             break
@@ -593,11 +846,11 @@ class RpcServer:
                         if tag is None:
                             fut = pool.submit(
                                 self._handle_one, method, payload, req_id,
-                                trace)
+                                trace, deadline)
                             pending.put((None, fut))
                         else:
                             pool.submit(handle_direct, method, payload,
-                                        req_id, tag, trace)
+                                        req_id, tag, trace, deadline)
                     except RuntimeError:
                         # stop() shut the pool down between recv and
                         # submit; the server is closing anyway
@@ -622,6 +875,25 @@ class RpcServer:
                 method = env[0]
                 req_id = env[1] if len(env) >= 3 else None
                 trace = env[2] if len(env) >= 4 else None
+                deadline = env[3] if len(env) >= 5 else None
+                if deadline is not None:
+                    deadline = _time.monotonic() + float(deadline)
+                if faults._active:
+                    try:
+                        act = faults.fire("rpc.server.recv", method=method)
+                    except ConnectionError:
+                        return
+                    except faults.InjectedFault as e:
+                        try:
+                            _send_msg(conn, ["err", f"InjectedFault: {e}"],
+                                      b"", False, tag=tag)
+                        except OSError:
+                            return
+                        continue
+                    if act == "drop":
+                        continue
+                    if act == "corrupt":
+                        payload = faults.corrupt_bytes(payload)
                 try:
                     if method == "__shutdown__":
                         _send_msg(conn, ["ok"], b"", False, tag=tag)
@@ -638,7 +910,7 @@ class RpcServer:
                 except OSError:
                     return
                 renv, rbody = self._handle_one(method, payload, req_id,
-                                               trace)
+                                               trace, deadline)
                 try:
                     _send_msg(conn, renv, rbody,
                               compress if renv[0] == "ok" else False,
@@ -699,14 +971,15 @@ class _ConnState:
     Owned by exactly one thread (the client pools one per thread), so
     none of this state needs a lock."""
 
-    __slots__ = ("sock", "compress", "tagged", "trace", "next_tag",
-                 "outstanding", "done", "evicted", "dead")
+    __slots__ = ("sock", "compress", "tagged", "trace", "deadline",
+                 "next_tag", "outstanding", "done", "evicted", "dead")
 
     def __init__(self, sock: socket.socket, compress: bool):
         self.sock = sock
         self.compress = compress
         self.tagged = False
         self.trace = False  # peer acked the __trace__ envelope slot
+        self.deadline = False  # peer acked the __deadline__ envelope slot
         self.next_tag = 1
         self.outstanding = set()  # tags sent, reply not yet claimed
         self.done: Dict[int, tuple] = {}  # tag -> (env, payload) parked
@@ -747,12 +1020,13 @@ class RpcFuture:
             try:
                 env, payload = self._client._wait_tag(self._cs, self._tag)
             except (ConnectionError, OSError) as e:
-                self._error = e
+                self._error = _typed_transport_error(
+                    e, self._client.addr, self._method)
                 self._client._drop_conn(self._cs)
-                raise
+                raise self._error from e
             if env[0] != "ok":
-                self._error = RpcError(
-                    f"{self._client.addr} {self._method}: {env[1]}")
+                self._error = _typed_call_error(
+                    self._client.addr, self._method, env[1])
             else:
                 self._value = payload
         if self._error is not None:
@@ -780,7 +1054,9 @@ class RpcClient:
 
     def __init__(self, addr: str, timeout: float = 60.0,
                  max_retries: int = 5, retry_backoff: float = 0.2,
-                 enable_tags: bool = True):
+                 enable_tags: bool = True,
+                 deadline: Optional[float] = None,
+                 enable_deadline: Optional[bool] = None):
         self.addr = addr
         host, port = addr.rsplit(":", 1)
         self._target = (host, int(port))
@@ -788,6 +1064,17 @@ class RpcClient:
         self.max_retries = max_retries
         self.retry_backoff = retry_backoff
         self.enable_tags = enable_tags
+        # deadline propagation is negotiated like __trace__: the
+        # ``__deadline__`` probe is ONLY sent when this client wants
+        # deadlines at all (a default deadline, or enable_deadline=True
+        # for per-call use), so the no-deadline wire stays byte-identical
+        # to the legacy protocol. ``deadline`` is seconds-from-send; the
+        # envelope carries the remaining budget and the server sheds
+        # work whose budget expired before dispatch.
+        self.default_deadline = deadline
+        self.enable_deadline = (bool(enable_deadline)
+                                if enable_deadline is not None
+                                else deadline is not None)
         self._local = threading.local()
         # one pooled conn per calling thread, keyed by the Thread object,
         # so close() (and GC via __del__) can release every socket
@@ -815,6 +1102,12 @@ class RpcClient:
                 _send_msg(sock, ["__trace__"], b"", False)
                 env, _, _ = _recv_msg_tagged(sock)
                 cs.trace = env[0] == "ok"
+            if self.enable_deadline:
+                # deadline slot negotiation: legacy peers answer "no
+                # such method" and never see the slot (negotiate-down)
+                _send_msg(sock, ["__deadline__"], b"", False)
+                env, _, _ = _recv_msg_tagged(sock)
+                cs.deadline = env[0] == "ok"
         except BaseException:
             try:
                 sock.close()
@@ -871,6 +1164,23 @@ class RpcClient:
             return envelope
         return [envelope[0], envelope[1] if len(envelope) > 1 else None,
                 list(tctx)]
+
+    def _build_envelope(self, envelope: list, cs: _ConnState,
+                        deadline: Optional[float]) -> list:
+        """Full envelope assembly: trace slot (slot 2) then the deadline
+        slot (slot 3, remaining seconds). Earlier slots are padded with
+        None so servers keep indexing positionally; with no deadline in
+        play the envelope is exactly the traced/legacy form —
+        byte-identical wire when the feature is off."""
+        env = self._traced_envelope(envelope, cs)
+        if deadline is None:
+            deadline = self.default_deadline
+        if deadline is not None and cs.deadline:
+            env = list(env)
+            while len(env) < 3:
+                env.append(None)
+            env.append(float(deadline))
+        return env
 
     def _take_tag(self, cs: _ConnState) -> int:
         tag = cs.next_tag
@@ -935,7 +1245,7 @@ class RpcClient:
             self._park_one(cs)
 
     def call(self, method: str, payload: Payload = b"",
-             dedup: bool = False):
+             dedup: bool = False, deadline: Optional[float] = None):
         """``dedup=True`` attaches a per-request id that the server uses
         to execute the request at most once (RpcServer's LRU of served
         ids): required for non-idempotent methods (gradient updates,
@@ -965,16 +1275,20 @@ class RpcClient:
             if fresh:
                 try:
                     cs = self._dial()
-                except (ConnectionError, OSError):
+                except (ConnectionError, OSError) as e:
                     if attempts_left <= 0:
-                        raise
+                        raise _typed_transport_error(e, self.addr,
+                                                     method) from e
                     attempts_left -= 1
                     time.sleep(delay)
                     delay = min(delay * 2, 5.0)
                     continue
             others_inflight = bool(cs.outstanding)
             try:
-                env_send = self._traced_envelope(envelope, cs)
+                if faults._active:
+                    faults.fire("rpc.client.send", addr=self.addr,
+                                method=method)
+                env_send = self._build_envelope(envelope, cs, deadline)
                 if cs.tagged:
                     tag = self._take_tag(cs)
                     _send_msg(cs.sock, env_send, payload, cs.compress,
@@ -985,26 +1299,29 @@ class RpcClient:
                     _send_msg(cs.sock, env_send, payload, cs.compress)
                     env, result = _recv_msg(cs.sock)
                 break
-            except (ConnectionError, OSError):
+            except (ConnectionError, OSError) as e:
                 self._drop_conn(cs)
                 if others_inflight:
                     # tag-matched calls were in flight on this
                     # connection; a transparent re-send cannot know
                     # their completion state — surface the failure
-                    raise
+                    raise _typed_transport_error(e, self.addr,
+                                                 method) from e
                 if not fresh:
                     continue  # stale pooled socket: redial once, no sleep
                 if attempts_left <= 0:
-                    raise
+                    raise _typed_transport_error(e, self.addr,
+                                                 method) from e
                 attempts_left -= 1
                 time.sleep(delay)
                 delay = min(delay * 2, 5.0)
         if env[0] != "ok":
-            raise RpcError(f"{self.addr} {method}: {env[1]}")
+            raise _typed_call_error(self.addr, method, env[1])
         return result
 
     def call_future(self, method: str, payload: Payload = b"",
-                    dedup: bool = False) -> RpcFuture:
+                    dedup: bool = False,
+                    deadline: Optional[float] = None) -> RpcFuture:
         """Issue a request and return a tag-matched :class:`RpcFuture`
         without waiting for the reply — many can be in flight on this
         thread's one connection, and a tag-capable server completes them
@@ -1019,26 +1336,31 @@ class RpcClient:
         if not cs.tagged:
             try:
                 return RpcFuture.completed(
-                    value=self.call(method, payload, dedup=dedup))
+                    value=self.call(method, payload, dedup=dedup,
+                                    deadline=deadline))
             except (RpcError, ConnectionError, OSError) as e:
                 return RpcFuture.completed(error=e)
         envelope: list = [method]
         if dedup:
             envelope.append(os.urandom(12))
-        envelope = self._traced_envelope(envelope, cs)
+        envelope = self._build_envelope(envelope, cs, deadline)
         tag = self._take_tag(cs)
         try:
+            if faults._active:
+                faults.fire("rpc.client.send", addr=self.addr,
+                            method=method)
             self._drain_ready(cs)  # keep the reply direction flowing
             _send_msg(cs.sock, envelope, payload, cs.compress, tag=tag,
                       pipelined=True)
-        except (ConnectionError, OSError):
+        except (ConnectionError, OSError) as e:
             self._drop_conn(cs)
-            raise
+            raise _typed_transport_error(e, self.addr, method) from e
         cs.outstanding.add(tag)
         return RpcFuture(self, cs, tag, method)
 
     def call_many(self, method: str, payloads: List[Payload],
-                  window: int = 16) -> list:
+                  window: int = 16,
+                  deadline: Optional[float] = None) -> list:
         """Pipelined calls on this thread's pooled connection: up to
         ``window`` requests are on the wire before the first response is
         read. On a tagged connection the server may execute and answer
@@ -1059,15 +1381,19 @@ class RpcClient:
             return []
         cs = self._conn()
         if cs.tagged:
-            return self._call_many_tagged(cs, method, payloads, window)
+            return self._call_many_tagged(cs, method, payloads, window,
+                                          deadline)
         results: list = []
         first_err: Optional[str] = None
-        envelope = self._traced_envelope([method], cs)
+        envelope = self._build_envelope([method], cs, deadline)
         try:
             i_send = 0
             while len(results) < len(payloads):
                 while (i_send < len(payloads)
                        and i_send - len(results) < window):
+                    if faults._active:
+                        faults.fire("rpc.client.send", addr=self.addr,
+                                    method=method)
                     _send_msg(cs.sock, envelope, payloads[i_send],
                               cs.compress, pipelined=True)
                     i_send += 1
@@ -1076,27 +1402,31 @@ class RpcClient:
                     # keep draining: an unread tail would desynchronize
                     # the NEXT call's request/response pairing
                     if first_err is None:
-                        first_err = f"{self.addr} {method}: {env[1]}"
+                        first_err = (self.addr, method, env[1])
                     result = b""
                 results.append(result)
-        except (ConnectionError, OSError):
+        except (ConnectionError, OSError) as e:
             self._drop_conn(cs)
-            raise
+            raise _typed_transport_error(e, self.addr, method) from e
         if first_err is not None:
-            raise RpcError(first_err)
+            raise _typed_call_error(*first_err)
         return results
 
     def _call_many_tagged(self, cs: _ConnState, method: str,
-                          payloads: List[Payload], window: int) -> list:
+                          payloads: List[Payload], window: int,
+                          deadline: Optional[float] = None) -> list:
         results: list = []
         tags: List[int] = []
-        first_err: Optional[str] = None
-        envelope = self._traced_envelope([method], cs)
+        first_err: Optional[tuple] = None
+        envelope = self._build_envelope([method], cs, deadline)
         try:
             i_send = 0
             while len(results) < len(payloads):
                 while (i_send < len(payloads)
                        and i_send - len(results) < window):
+                    if faults._active:
+                        faults.fire("rpc.client.send", addr=self.addr,
+                                    method=method)
                     self._drain_ready(cs)  # keep the reply direction flowing
                     tag = self._take_tag(cs)
                     _send_msg(cs.sock, envelope, payloads[i_send],
@@ -1109,14 +1439,14 @@ class RpcClient:
                 env, result = self._wait_tag(cs, tags[len(results)])
                 if env[0] != "ok":
                     if first_err is None:
-                        first_err = f"{self.addr} {method}: {env[1]}"
+                        first_err = (self.addr, method, env[1])
                     result = b""
                 results.append(result)
-        except (ConnectionError, OSError):
+        except (ConnectionError, OSError) as e:
             self._drop_conn(cs)
-            raise
+            raise _typed_transport_error(e, self.addr, method) from e
         if first_err is not None:
-            raise RpcError(first_err)
+            raise _typed_call_error(*first_err)
         return results
 
     def call_msg(self, method: str, **kwargs) -> dict:

@@ -8,6 +8,7 @@ tests drive a genuine multi-process cluster over real sockets inside one
 pytest.
 """
 
+import json
 import os
 import subprocess
 import sys
@@ -62,6 +63,23 @@ class ServiceCtx:
         with ServiceCtx(schema, n_workers=1, n_ps=2) as svc:
             worker = svc.remote_worker()     # RemoteEmbeddingWorker
             ...
+
+    With ``supervise_ps=True`` the monitor becomes a **supervisor** for
+    the (Python) PS tier instead of a dead-man switch: a PS replica
+    that exits — or whose PR-3 ``/healthz`` sidecar stops answering for
+    ``ps_probe_failures`` consecutive probes while the process looks
+    alive (wedged, not dead) — is killed and RESTARTED with the same
+    replica index. The restart restores the replica's shard from
+    ``ps_restore_dir`` (its ``replica_<i>.psd`` from the last
+    ``dump_sharded``) and replays the incremental-update packets in
+    ``ps_inc_dir`` on top (``--replay-inc-dir``), so every durably
+    recorded row survives the crash; the worker tier re-resolves the
+    replica's new address through the coordinator and re-arms its
+    optimizer on the next data-plane call (worker.py's existing
+    recovery). Each recovery is recorded in ``ps_recoveries`` with
+    detection/recovery timestamps — the chaos bench's numbers. Crashes
+    of unsupervised roles (coordinator, workers) still tear the whole
+    group down, as do supervised replicas past ``ps_max_restarts``.
     """
 
     def __init__(
@@ -76,6 +94,12 @@ class ServiceCtx:
         native_worker: bool = False,
         ps_capacity: int = 1_000_000_000,
         ps_num_shards: int = 16,
+        supervise_ps: bool = False,
+        ps_restore_dir: Optional[str] = None,
+        ps_inc_dir: Optional[str] = None,
+        ps_probe_interval: float = 0.5,
+        ps_probe_failures: int = 4,
+        ps_max_restarts: int = 5,
     ):
         self.schema = schema
         self.n_workers = n_workers
@@ -87,6 +111,16 @@ class ServiceCtx:
         self.global_config_path = global_config_path
         self.extra_env = env or {}
         self.startup_timeout = startup_timeout
+        if supervise_ps and native_ps:
+            raise ValueError("supervise_ps drives the Python PS binary "
+                             "(--replay-inc-dir); native_ps has its own "
+                             "k8s-level restart story")
+        self.supervise_ps = supervise_ps
+        self.ps_restore_dir = ps_restore_dir
+        self.ps_inc_dir = ps_inc_dir
+        self.ps_probe_interval = ps_probe_interval
+        self.ps_probe_failures = ps_probe_failures
+        self.ps_max_restarts = ps_max_restarts
         self.procs: List[subprocess.Popen] = []
         self.coordinator_addr: Optional[str] = None
         self.worker_addrs: List[str] = []
@@ -95,6 +129,16 @@ class ServiceCtx:
         self._monitor: Optional[threading.Thread] = None
         self._closing = False
         self.crashed: List[str] = []
+        # supervisor state (supervise_ps): per-replica incarnation
+        # counter, sidecar addresses, consecutive probe failures, and
+        # the recorded recovery events
+        self.ps_recoveries: List[dict] = []
+        self._ps_incarnation: dict = {}
+        self._ps_http_addr: dict = {}
+        self._ps_http_file: dict = {}
+        self._ps_probe_fails: dict = {}
+        self._ps_restarts: dict = {}
+        self._last_probe = 0.0
 
     def _spawn(self, args: List[str], name: str, replica_index: int,
                replica_size: int) -> subprocess.Popen:
@@ -157,13 +201,7 @@ class ServiceCtx:
                     f"ps-{i}", i, self.n_ps,
                 )
                 continue
-            args = ["-m", "persia_tpu.service.ps_service",
-                    "--replica-index", str(i),
-                    "--replica-size", str(self.n_ps),
-                    "--coordinator", self.coordinator_addr]
-            if self.global_config_path:
-                args += ["--global-config", self.global_config_path]
-            self._spawn(args, f"ps-{i}", i, self.n_ps)
+            self._spawn_ps(i)
         for i in range(self.n_workers):
             if self.native_worker:
                 from persia_tpu.utils import resolve_binary_path
@@ -211,20 +249,205 @@ class ServiceCtx:
                      self.coordinator_addr, self.ps_addrs, self.worker_addrs)
         return self
 
+    def _spawn_ps(self, i: int, restore: bool = False) -> subprocess.Popen:
+        """Spawn (or respawn) Python PS replica ``i``. Supervised
+        replicas always carry the /healthz sidecar (the supervisor's
+        detection channel); a ``restore`` respawn additionally restores
+        the replica's checkpoint shard and replays incremental packets
+        before it registers with the coordinator."""
+        args = ["-m", "persia_tpu.service.ps_service",
+                "--replica-index", str(i),
+                "--replica-size", str(self.n_ps),
+                "--coordinator", self.coordinator_addr]
+        if self.global_config_path:
+            args += ["--global-config", self.global_config_path]
+        if self.supervise_ps:
+            inc = self._ps_incarnation[i] = self._ps_incarnation.get(i, 0) + 1
+            http_file = os.path.join(self._tmpdir.name,
+                                     f"ps_{i}_{inc}.http")
+            self._ps_http_file[i] = http_file
+            self._ps_http_addr.pop(i, None)
+            self._ps_probe_fails[i] = 0
+            args += ["--http-port", "0", "--http-addr-file", http_file]
+        if restore:
+            if self.ps_restore_dir:
+                ckpt = os.path.join(self.ps_restore_dir,
+                                    f"replica_{i}.psd")
+                if os.path.exists(ckpt):
+                    args += ["--initial-checkpoint", ckpt]
+            if self.ps_inc_dir:
+                args += ["--replay-inc-dir", self.ps_inc_dir]
+        proc = self._spawn(args, f"ps-{i}", i, self.n_ps)
+        proc._persia_replica = i  # type: ignore[attr-defined]
+        proc._persia_supervised = self.supervise_ps  # type: ignore
+        return proc
+
     def _watch(self):
-        """Kill the whole group if any child crashes
-        (reference helper.py:296-315)."""
+        """Crash monitor. Default: kill the whole group if any child
+        crashes (reference helper.py:296-315). With ``supervise_ps``, a
+        crashed/wedged PS replica is instead detected (process exit OR
+        repeated /healthz probe failure) and restarted with restore —
+        the fault-tolerance story the chaos bench exercises."""
         while not self._closing:
-            for p in self.procs:
+            for p in list(self.procs):
+                if getattr(p, "_persia_handled", False):
+                    continue
                 rc = p.poll()
                 if rc is not None and rc != 0 and not self._closing:
                     name = getattr(p, "_persia_name", "?")
+                    if (getattr(p, "_persia_supervised", False)
+                            and self._restarts_left(p._persia_replica)):
+                        self._recover_ps(p, f"exited rc={rc}")
+                        continue
                     self.crashed.append(f"{name} rc={rc}")
                     _logger.error("service %s crashed (rc=%d); tearing down",
                                   name, rc)
                     self._terminate_all()
                     return
+            if self.supervise_ps and not self._closing:
+                self._probe_ps_sidecars()
             time.sleep(0.2)
+
+    def _restarts_left(self, i: int) -> bool:
+        return self._ps_restarts.get(i, 0) < self.ps_max_restarts
+
+    def _ps_sidecar_addr(self, i: int) -> Optional[str]:
+        addr = self._ps_http_addr.get(i)
+        if addr is None:
+            path = self._ps_http_file.get(i)
+            if path and os.path.exists(path):
+                with open(path) as f:
+                    addr = f.read().strip()
+                if addr:
+                    self._ps_http_addr[i] = addr
+        return addr
+
+    def _probe_ps_sidecars(self):
+        """Liveness probing through the PR-3 observability sidecar: a
+        PS whose PROCESS is alive but whose sidecar stops answering is
+        wedged (stuck handler, hosed event loop) — after
+        ``ps_probe_failures`` consecutive misses it is killed and
+        restarted like a crash. Plain liveness on purpose: a
+        restoring replica answers /healthz (not-ready), so recovery is
+        never mistaken for a wedge."""
+        import urllib.request
+
+        now = time.monotonic()
+        if now - self._last_probe < self.ps_probe_interval:
+            return
+        self._last_probe = now
+        for p in list(self.procs):
+            if (not getattr(p, "_persia_supervised", False)
+                    or getattr(p, "_persia_handled", False)
+                    or p.poll() is not None):
+                continue
+            i = p._persia_replica
+            addr = self._ps_sidecar_addr(i)
+            if addr is None:
+                continue  # still starting; startup_timeout governs
+            try:
+                with urllib.request.urlopen(
+                        f"http://{addr}/healthz", timeout=1.0):
+                    self._ps_probe_fails[i] = 0
+            except Exception:
+                self._ps_probe_fails[i] = self._ps_probe_fails.get(i, 0) + 1
+                if self._ps_probe_fails[i] >= self.ps_probe_failures:
+                    if not self._restarts_left(i):
+                        continue  # next crash tears the group down
+                    _logger.error(
+                        "PS %d sidecar unresponsive (%d consecutive "
+                        "probes); killing the wedged replica", i,
+                        self._ps_probe_fails[i])
+                    p.kill()
+                    try:
+                        p.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        continue  # unkillable; retry next sweep
+                    self._recover_ps(p, "sidecar unresponsive")
+
+    def _recover_ps(self, proc: subprocess.Popen, reason: str):
+        """Restart a dead supervised PS replica and record the recovery
+        event. Recovered == the replacement wrote its sidecar addr file
+        (restore ran BEFORE that write in ps_service.main) and reports
+        model-manager Idle; optimizer re-arming stays the worker tier's
+        lazy job (re-registering it here would race in-flight
+        re-arms)."""
+        i = proc._persia_replica
+        t_detected = time.monotonic()
+        proc._persia_handled = True  # type: ignore[attr-defined]
+        self._ps_restarts[i] = self._ps_restarts.get(i, 0) + 1
+        event = {"replica": i, "reason": reason, "t_detected": t_detected,
+                 "restart_no": self._ps_restarts[i]}
+        _logger.error("supervised PS %d down (%s); restarting (%d/%d)",
+                      i, reason, self._ps_restarts[i], self.ps_max_restarts)
+        new_proc = self._spawn_ps(i, restore=True)
+        deadline = time.monotonic() + self.startup_timeout
+        addr = None
+        import urllib.request
+
+        while time.monotonic() < deadline and not self._closing:
+            if new_proc.poll() is not None:
+                # restore crashed: count it and let the next watch
+                # sweep decide (restart again or tear down)
+                event["failed"] = f"respawn exited rc={new_proc.poll()}"
+                self.ps_recoveries.append(event)
+                return
+            sidecar = self._ps_sidecar_addr(i)
+            if sidecar is not None:
+                try:
+                    with urllib.request.urlopen(
+                            f"http://{sidecar}/healthz", timeout=1.0) as r:
+                        doc = json.loads(r.read().decode())
+                    if doc.get("model_manager_status") == "Idle":
+                        addr = doc.get("rpc_addr")
+                        break
+                except Exception:
+                    pass
+            time.sleep(0.05)
+        event["addr"] = addr
+        if addr is None:
+            # the replacement never reached Idle inside startup_timeout:
+            # that is a FAILED recovery, not a slow success — recording
+            # it as recovered would point callers (and ps_addrs) at a
+            # replica that cannot serve
+            event["failed"] = "replacement never reached Idle"
+            self.ps_recoveries.append(event)
+            _logger.error("PS %d recovery FAILED: replacement never "
+                          "reached Idle within %.0fs", i,
+                          self.startup_timeout)
+            return
+        event["t_recovered"] = time.monotonic()
+        event["recovery_sec"] = round(event["t_recovered"] - t_detected, 3)
+        if i < len(self.ps_addrs):
+            self.ps_addrs[i] = addr
+        self.ps_recoveries.append(event)
+        _logger.warning("PS %d recovered in %.2fs at %s", i,
+                        event["recovery_sec"], addr)
+
+    def ps_proc(self, i: int) -> Optional[subprocess.Popen]:
+        """The LIVE subprocess currently serving PS replica ``i`` (the
+        chaos bench kills it; after a recovery this returns the
+        replacement)."""
+        for p in reversed(self.procs):
+            if (getattr(p, "_persia_replica", None) == i
+                    and not getattr(p, "_persia_handled", False)
+                    and p.poll() is None):
+                return p
+        return None
+
+    def wait_ps_recoveries(self, n: int, timeout: float = 60.0) -> List[dict]:
+        """Block until the supervisor has recorded ``n`` completed
+        recovery events (chaos bench/test synchronization)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            done = [e for e in self.ps_recoveries
+                    if "t_recovered" in e or "failed" in e]
+            if len(done) >= n:
+                return done
+            time.sleep(0.05)
+        raise TimeoutError(
+            f"waited {timeout}s for {n} PS recoveries, have "
+            f"{self.ps_recoveries}")
 
     def remote_worker(self):
         from persia_tpu.service.worker_service import RemoteEmbeddingWorker

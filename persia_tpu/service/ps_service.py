@@ -24,13 +24,16 @@ from typing import List, Optional
 import msgpack
 import numpy as np
 
-from persia_tpu import tracing
+from persia_tpu import faults, tracing
 from persia_tpu.logger import get_default_logger
 from persia_tpu.rpc import (
+    CircuitBreaker,
+    RpcCircuitOpen,
     RpcClient,
     RpcServer,
     pack_arrays,
     pack_arrays_sg,
+    tcp_probe,
     unpack_arrays,
 )
 from persia_tpu.service.coordinator import ROLE_PS, CoordinatorClient
@@ -221,6 +224,14 @@ class PsService:
             doc["model_manager_status"] = self.status
         doc["holder_entries"] = len(self.holder)
         doc["shard_parallel"] = self._dispatch.enabled
+        # readiness (distinct from liveness): the sidecar's
+        # /healthz?ready=1 returns 503 on False, so supervisors and k8s
+        # readiness probes never route traffic to a replica that is
+        # Loading/restoring or has not been re-armed with an optimizer
+        doc["ready"] = (
+            getattr(self.holder, "optimizer", True) is not None
+            and doc["model_manager_status"] == "Idle"
+        )
         return doc
 
     @property
@@ -253,6 +264,9 @@ class PsService:
 
     def _lookup(self, payload: bytes) -> bytes:
         meta, (signs,) = unpack_arrays(payload)
+        if faults._active:
+            # chaos sites: delay == slow shard, die == kill mid-request
+            faults.fire("ps.lookup", n=len(signs), dim=meta["dim"])
         out = self._dispatch.lookup(signs, meta["dim"], meta["training"])
         # scatter-gather response (default): the (n, dim) result goes
         # to the socket without a tobytes() concatenation copy
@@ -260,6 +274,8 @@ class PsService:
 
     def _update_gradients(self, payload: bytes) -> bytes:
         meta, (signs, grads) = unpack_arrays(payload)
+        if faults._active:
+            faults.fire("ps.update", n=len(signs), dim=meta["dim"])
         self._dispatch.update_gradients(signs, grads, meta["dim"])
         if self.inc_dumper is not None:
             self.inc_dumper.commit(signs)
@@ -340,6 +356,39 @@ class PsService:
             threading.Thread(target=run, daemon=True).start()
         return b""
 
+    def restore(self, checkpoint_path: Optional[str] = None,
+                replay_inc_dir: Optional[str] = None,
+                replica_index: Optional[int] = None) -> int:
+        """Crash-recovery boot restore: load this replica's last
+        checkpoint shard, then replay any incremental-update packets
+        newer than it (the train-side dumper's ``inc_*`` directories) on
+        top — together they reconstruct every durably-recorded row. The
+        status machine rides along, so ``/healthz?ready=1`` answers 503
+        until the restore completes (the supervisor and k8s probes must
+        not route to a replica mid-restore). Returns the number of
+        replayed incremental entries."""
+        self._set_status("Loading")
+        replayed = 0
+        try:
+            if checkpoint_path:
+                self.holder.load_file(checkpoint_path)
+                _logger.info("restored checkpoint %s (%d entries)",
+                             checkpoint_path, len(self.holder))
+            if replay_inc_dir:
+                from persia_tpu.inc_update import IncrementalUpdateLoader
+
+                replayed = IncrementalUpdateLoader(
+                    self.holder, replay_inc_dir,
+                    replica_index=replica_index).scan_once()
+                _logger.info("replayed %d incremental entries from %s",
+                             replayed, replay_inc_dir)
+            self._set_status("Idle")
+        except BaseException as e:
+            _logger.error("restore failed: %s", e)
+            self._set_status(f"Failed: {e}")
+            raise
+        return replayed
+
     def _status(self, payload: bytes) -> bytes:
         with self._status_lock:
             return msgpack.packb({"status": self.status})
@@ -361,48 +410,117 @@ class PsClient:
     multiplex on one socket, and a dispatch-pool server completes them
     out of order. Legacy servers (e.g. the C++ ``ps_server``) negotiate
     down transparently; the future methods then degrade to synchronous
-    calls."""
+    calls.
+
+    Every RPC passes through a per-replica **circuit breaker** (default
+    on; ``PERSIA_PS_CIRCUIT_BREAKER=0`` or ``circuit_breaker=False``
+    disables): after ``CB_THRESHOLD`` consecutive calls that exhausted
+    the transport retry ladder, the breaker opens and calls fail fast
+    with :class:`~persia_tpu.rpc.RpcCircuitOpen` — no wire traffic, no
+    per-call backoff ladder against a dead replica — while a background
+    TCP probe watches the address; the first accept arms a single
+    half-open trial call whose success re-closes the breaker. The
+    worker's re-arm/refresh recovery path sees ``RpcCircuitOpen`` as an
+    ordinary ``ConnectionError``. ``deadline`` (seconds) arms per-call
+    deadline propagation (negotiated; see rpc.py)."""
+
+    CB_THRESHOLD = 3
+    CB_COOLDOWN = 1.0
 
     def __init__(self, addr: str, enable_tags: bool = True,
-                 legacy_frames: bool = False):
+                 legacy_frames: bool = False,
+                 circuit_breaker=None, deadline: Optional[float] = None):
         self.addr = addr
-        self.client = RpcClient(addr, enable_tags=enable_tags)
+        self.client = RpcClient(addr, enable_tags=enable_tags,
+                                deadline=deadline)
         # legacy_frames reverts request framing to the concatenating
         # pack_arrays (pre-zero-copy A/B lever; see PsService)
         self._pack = pack_arrays if legacy_frames else pack_arrays_sg
+        if circuit_breaker is None:
+            circuit_breaker = (
+                os.environ.get("PERSIA_PS_CIRCUIT_BREAKER") != "0")
+        if circuit_breaker is True:
+            circuit_breaker = CircuitBreaker(
+                threshold=self.CB_THRESHOLD, cooldown=self.CB_COOLDOWN,
+                probe=tcp_probe(addr))
+        elif circuit_breaker is False:
+            circuit_breaker = None
+        self.breaker: Optional[CircuitBreaker] = circuit_breaker
+
+    def _check_open(self):
+        br = self.breaker
+        if br is not None and not br.allow():
+            raise RpcCircuitOpen(
+                f"{self.addr}: circuit open (failing fast after "
+                f"{br.threshold} consecutive transport failures)")
+
+    def _settle(self, fn):
+        """Record one RPC's outcome on the breaker: transport-level
+        loss (incl. our typed subclasses) trips it; an application
+        error means the replica ANSWERED — the transport is healthy, so
+        it counts as breaker success (critically, this releases the
+        half-open trial slot: a restarted-blank replica whose trial
+        call errs at the application layer must close the breaker, not
+        wedge it open forever)."""
+        br = self.breaker
+        try:
+            out = fn()
+        except (ConnectionError, OSError):
+            if br is not None:
+                br.record_failure()
+            raise
+        except BaseException:
+            if br is not None:
+                br.record_success()
+            raise
+        if br is not None:
+            br.record_success()
+        return out
+
+    def _guarded(self, fn):
+        """Run one blocking RPC under the breaker (fail fast when open,
+        then settle). The future paths split the two halves: issue under
+        :meth:`_check_open`, settle at resolve time."""
+        self._check_open()
+        return self._settle(fn)
 
     def configure(self, init_method, init_params, admit_probability=1.0,
                   weight_bound=10.0, enable_weight_bound=True):
-        self.client.call_msg(
+        self._guarded(lambda: self.client.call_msg(
             "configure", init_method=init_method, init_params=init_params,
             admit_probability=admit_probability, weight_bound=weight_bound,
             enable_weight_bound=enable_weight_bound,
-        )
+        ))
 
     def register_optimizer(self, config: dict, feature_index_prefix_bit=0):
-        self.client.call_msg(
+        self._guarded(lambda: self.client.call_msg(
             "register_optimizer", config=config,
             feature_index_prefix_bit=feature_index_prefix_bit,
-        )
+        ))
 
     def lookup(self, signs: np.ndarray, dim: int, training: bool) -> np.ndarray:
         payload = self._pack({"dim": int(dim), "training": bool(training)},
                                  [np.ascontiguousarray(signs, np.uint64)])
-        _, (out,) = unpack_arrays(self.client.call("lookup", payload))
+        _, (out,) = unpack_arrays(
+            self._guarded(lambda: self.client.call("lookup", payload)))
         return out.reshape(len(signs), dim)
 
     def lookup_future(self, signs: np.ndarray, dim: int, training: bool):
         """Issue the lookup without waiting; returns a zero-arg resolver
         producing the (n, dim) matrix. Multiple in-flight lookups
         multiplex on this thread's one connection (tag-matched), so a
-        slow (shard, dim) group no longer blocks the fast ones."""
+        slow (shard, dim) group no longer blocks the fast ones. The
+        breaker gates the ISSUE (fail fast when open) and settles on
+        the resolver's outcome."""
+        self._check_open()
         n = len(signs)
         payload = self._pack({"dim": int(dim), "training": bool(training)},
                                  [np.ascontiguousarray(signs, np.uint64)])
-        fut = self.client.call_future("lookup", payload)
+        fut = self._settle(
+            lambda: self.client.call_future("lookup", payload))
 
         def resolve() -> np.ndarray:
-            _, (out,) = unpack_arrays(fut.result())
+            _, (out,) = unpack_arrays(self._settle(fut.result))
             return out.reshape(n, dim)
 
         return resolve
@@ -414,70 +532,82 @@ class PsClient:
         ])
         # non-idempotent: dedup id makes the retry at-most-once server-side
         # (blocking path keeps the client's full retry-with-backoff)
-        self.client.call("update_gradients", payload, dedup=True)
+        self._guarded(lambda: self.client.call("update_gradients", payload,
+                                               dedup=True))
 
     def update_gradients_future(self, signs: np.ndarray, grads: np.ndarray,
                                 dim: int):
         """Issue the gradient push without waiting; returns a zero-arg
         resolver that raises on failure. Already-aggregated groups ship
         while later ones are still aggregating (worker streaming)."""
+        self._check_open()
         payload = self._pack({"dim": int(dim)}, [
             np.ascontiguousarray(signs, np.uint64),
             np.ascontiguousarray(grads, np.float32),
         ])
         # non-idempotent: dedup id makes the retry at-most-once server-side
-        fut = self.client.call_future("update_gradients", payload, dedup=True)
+        fut = self._settle(lambda: self.client.call_future(
+            "update_gradients", payload, dedup=True))
 
         def resolve():
-            fut.result()
+            self._settle(fut.result)
 
         return resolve
 
     def __len__(self) -> int:
-        return msgpack.unpackb(self.client.call("len"), raw=False)["len"]
+        return msgpack.unpackb(
+            self._guarded(lambda: self.client.call("len")),
+            raw=False)["len"]
 
     def get_entry(self, sign: int):
         payload = msgpack.packb({"sign": int(sign)}, use_bin_type=True)
-        meta, arrays = unpack_arrays(self.client.call("get_entry", payload))
+        meta, arrays = unpack_arrays(
+            self._guarded(lambda: self.client.call("get_entry", payload)))
         if not meta["found"]:
             return None
         return meta["dim"], arrays[0]
 
     def set_entry(self, sign: int, dim: int, vec: np.ndarray):
-        self.client.call("set_entry", pack_arrays(
+        self._guarded(lambda: self.client.call("set_entry", pack_arrays(
             {"sign": int(sign), "dim": int(dim)},
             [np.ascontiguousarray(vec, np.float32)],
-        ))
+        )))
 
     def get_entries(self, signs: np.ndarray, width: int):
         payload = self._pack({"width": int(width)}, [
             np.ascontiguousarray(signs, np.uint64)])
         _, (found, vecs) = unpack_arrays(
-            self.client.call("get_entries", payload))
+            self._guarded(lambda: self.client.call("get_entries", payload)))
         return (found.astype(bool),
                 vecs.reshape(len(signs), width).astype(np.float32))
 
     def set_entries(self, signs: np.ndarray, dim: int, vecs: np.ndarray):
-        self.client.call("set_entries", self._pack({"dim": int(dim)}, [
-            np.ascontiguousarray(signs, np.uint64),
-            np.ascontiguousarray(vecs, np.float32),
-        ]), dedup=True)
+        self._guarded(lambda: self.client.call(
+            "set_entries", self._pack({"dim": int(dim)}, [
+                np.ascontiguousarray(signs, np.uint64),
+                np.ascontiguousarray(vecs, np.float32),
+            ]), dedup=True))
 
     def clear(self):
-        self.client.call("clear")
+        self._guarded(lambda: self.client.call("clear"))
 
     def dump_file(self, path: str, blocking: bool = True):
-        self.client.call_msg("dump", path=path, blocking=blocking)
+        self._guarded(lambda: self.client.call_msg(
+            "dump", path=path, blocking=blocking))
 
     def load_file(self, path: str, clear: bool = True, blocking: bool = True):
-        self.client.call_msg("load", path=path, clear=clear, blocking=blocking)
+        self._guarded(lambda: self.client.call_msg(
+            "load", path=path, clear=clear, blocking=blocking))
 
     def model_manager_status(self) -> str:
-        return msgpack.unpackb(self.client.call("status"), raw=False)["status"]
+        return msgpack.unpackb(
+            self._guarded(lambda: self.client.call("status")),
+            raw=False)["status"]
 
     def ready_for_serving(self) -> bool:
-        return msgpack.unpackb(self.client.call("ready_for_serving"),
-                               raw=False)["ready"]
+        return msgpack.unpackb(
+            self._guarded(lambda: self.client.call("ready_for_serving")),
+            raw=False)["ready"]
 
     def shutdown(self):
         self.client.shutdown_server()
@@ -498,6 +628,11 @@ def main():
                    default=os.environ.get("PERSIA_COORDINATOR_ADDR"))
     p.add_argument("--global-config", default=None)
     p.add_argument("--initial-checkpoint", default=None)
+    p.add_argument("--replay-inc-dir", default=None,
+                   help="after --initial-checkpoint, replay incremental "
+                        "update packets (inc_update dumper output) on top "
+                        "of the restored store — the supervisor's crash "
+                        "recovery path")
     p.add_argument("--addr-file", default=None,
                    help="write the bound address here after listen (with "
                         "--port 0: race-free port handoff to a parent)")
@@ -543,10 +678,12 @@ def main():
         # A/B lever for the worker-cycle bench's serialized baseline
         legacy_frames=os.environ.get("PERSIA_PS_LEGACY_FRAMES") == "1",
         http_port=obs_http.port_from_args(args))
-    if args.initial_checkpoint:
-        holder.load_file(args.initial_checkpoint)
-        _logger.info("loaded initial checkpoint from %s",
-                     args.initial_checkpoint)
+    if args.initial_checkpoint or args.replay_inc_dir:
+        # restore BEFORE registering with the coordinator, so workers
+        # never route to a half-restored replica; the sidecar is already
+        # up and reports ready=false (503 on /healthz?ready=1) meanwhile
+        service.restore(args.initial_checkpoint, args.replay_inc_dir,
+                        replica_index=args.replica_index)
     _logger.info("parameter server %d/%d listening on %s (sidecar %s)",
                  args.replica_index, args.replica_size, service.addr,
                  service.http.addr if service.http else "off")

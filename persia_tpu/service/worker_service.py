@@ -22,6 +22,7 @@ import argparse
 import itertools
 import os
 import threading
+import time
 from typing import Dict, List, Optional, Sequence
 
 import msgpack
@@ -55,6 +56,12 @@ class WorkerService:
         # observability sidecar (see PsService): /metrics /healthz /trace
         from persia_tpu import obs_http
 
+        # readiness is an RPC fan-out to every PS replica — cache it so
+        # aggressive probe intervals don't multiply PS control traffic.
+        # Initialized BEFORE the sidecar starts serving: a probe landing
+        # in the construction window must not 500 on missing state.
+        self._ready_lock = threading.Lock()
+        self._ready_cache = (0.0, True)
         self.http = obs_http.maybe_start(host, http_port, self._health)
         s = self.server
         s.register("forward_batched", self._forward_batched)
@@ -90,7 +97,30 @@ class WorkerService:
             doc["post_forward_buffer_depth"] = len(w._post_forward_buffer)
             doc["staleness"] = w.staleness
         doc["ps_replicas"] = w.replica_size
+        # readiness: can this worker actually serve lookups right now
+        # (every PS replica armed and Idle)? /healthz?ready=1 turns a
+        # False into a 503 so probes stop routing here mid-PS-recovery
+        doc["ready"] = self._ready_cached()
         return doc
+
+    READY_CACHE_SEC = 2.0
+
+    def _ready_cached(self) -> bool:
+        now = time.monotonic()
+        with self._ready_lock:
+            t, val = self._ready_cache
+            if now - t < self.READY_CACHE_SEC:
+                return val
+        try:
+            ready = all(
+                c.ready_for_serving() for c in self.worker.ps_clients
+                if hasattr(c, "ready_for_serving")
+            )
+        except Exception:
+            ready = False
+        with self._ready_lock:
+            self._ready_cache = (time.monotonic(), ready)
+        return ready
 
     def _forward_batched(self, payload: bytes) -> bytes:
         _, feats = ser.unpack_id_features(payload)

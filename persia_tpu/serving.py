@@ -70,8 +70,21 @@ from persia_tpu.data.batch import (
     PersiaBatch,
 )
 from persia_tpu.logger import get_default_logger
-from persia_tpu.rpc import RpcClient, RpcError, RpcServer, pack_arrays, \
-    unpack_arrays
+from persia_tpu.rpc import (
+    RpcClient,
+    RpcDeadlineExceeded,
+    RpcError,
+    RpcServer,
+    pack_arrays,
+    unpack_arrays,
+)
+
+# failures that degrade to zero-vector embeddings instead of failing the
+# request: a circuit-open replica (RpcCircuitOpen is a ConnectionError),
+# a shed deadline, transport loss/timeouts. Application errors (schema
+# mismatch, bad payload) still fail the request — they would zero-fill
+# forever, not transiently.
+DEGRADABLE_ERRORS = (RpcDeadlineExceeded, ConnectionError, OSError)
 
 _logger = get_default_logger(__name__)
 
@@ -406,6 +419,7 @@ class InferenceServer:
         cache_ttl_sec: float = 30.0,
         concurrent_streams: Optional[int] = None,
         http_port: Optional[int] = None,
+        degraded_fallback: bool = True,
     ):
         # Opt-in contract: a default (serialized) server keeps the
         # legacy thread-per-connection RPC loop with NO shared-pool cap
@@ -449,6 +463,16 @@ class InferenceServer:
             self._batcher = None
         self.cache = (HotRowCache(cache_rows, cache_ttl_sec)
                       if cache_rows > 0 else None)
+        # Graceful degradation (default on): when the embedding tier is
+        # unreachable for a lookup — circuit-open replica, shed
+        # deadline, connection loss — predict serves ZERO VECTORS for
+        # the affected signs instead of failing or stalling the whole
+        # request. Signs served from the hot-row cache (and dims whose
+        # fetch succeeded) keep their real embeddings; zero rows are
+        # never cached, so recovery is immediate. Counted per port
+        # below — a nonzero rate is the pager signal that the serving
+        # tier is running on partial embeddings.
+        self.degraded_fallback = bool(degraded_fallback)
 
         from persia_tpu.metrics import default_registry
 
@@ -475,6 +499,11 @@ class InferenceServer:
                                        labels)
         self._t_forward = reg.histogram(
             "inference_forward_time_cost_sec", labels)
+        # degradation observables (labels carry the server port)
+        self._m_degraded = reg.counter("inference_degraded_lookups_total",
+                                       labels)
+        self._m_zero_rows = reg.counter(
+            "inference_zero_fallback_rows_total", labels)
         # observability sidecar (see PsService): /metrics /healthz /trace
         from persia_tpu import obs_http
 
@@ -489,6 +518,11 @@ class InferenceServer:
             doc["cache_rows_resident"] = len(self.cache)
             doc["cache_hit_rate"] = round(self.cache.hit_rate, 4)
         doc["requests_total"] = self._m_requests.value
+        doc["degraded_lookups_total"] = self._m_degraded.value
+        # the serving tier stays READY while degrading (zero-vector
+        # fallback answers requests); degraded_lookups_total climbing is
+        # the alert, not a routing decision
+        doc["ready"] = True
         return doc
 
     @property
@@ -551,9 +585,37 @@ class InferenceServer:
 
     def _lookup(self, id_type_features: List[IDTypeFeature]):
         if self.cache is None:
-            return self.worker.lookup_direct(id_type_features,
-                                             training=False)
+            try:
+                return self.worker.lookup_direct(id_type_features,
+                                                 training=False)
+            except DEGRADABLE_ERRORS as e:
+                if not self.degraded_fallback:
+                    raise
+                return self._zero_lookup(id_type_features, e)
         return self._lookup_cached(id_type_features)
+
+    def _zero_lookup(self, id_type_features: List[IDTypeFeature], cause):
+        """Whole-lookup degradation (no cache to salvage hits from):
+        preprocess locally — the same transforms the worker would run,
+        so shapes are identical — and zero-fill every embedding row.
+        The model still answers (dense features carry what they carry);
+        a recommendation served on partial signal beats a 500."""
+        from persia_tpu.worker import middleware as mw
+
+        feats = mw.preprocess_batch(id_type_features, self.schema)
+        out = {}
+        rows = 0
+        for f in feats:
+            slot = self.schema.get_slot(f.name)
+            mat = np.zeros((f.num_distinct, slot.dim), np.float32)
+            rows += f.num_distinct
+            out[f.name] = mw.postprocess_feature(f, slot, mat)
+        self._m_degraded.inc()
+        self._m_zero_rows.inc(rows)
+        _logger.warning("degraded predict: embedding tier unreachable "
+                        "(%s); %d rows served as zero vectors", cause,
+                        rows)
+        return out
 
     def _lookup_cached(self, id_type_features: List[IDTypeFeature]):
         """Preprocess locally (the same dedup/hashstack/prefix transforms
@@ -578,7 +640,22 @@ class InferenceServer:
         for dim, parts in misses.items():
             all_signs = np.concatenate([p[2] for p in parts])
             uniq, inverse = np.unique(all_signs, return_inverse=True)
-            rows = self.worker.lookup_signs(uniq, dim)
+            try:
+                rows = self.worker.lookup_signs(uniq, dim)
+            except DEGRADABLE_ERRORS as e:
+                if not self.degraded_fallback:
+                    raise
+                # the miss rows stay at their zero initialization; the
+                # CACHED signs of this request (and every other dim)
+                # keep their real embeddings — only the unreachable
+                # replica's share degrades. Zero rows are NOT cached,
+                # so the first post-recovery request refetches.
+                self._m_degraded.inc()
+                self._m_zero_rows.inc(len(all_signs))
+                _logger.warning(
+                    "degraded lookup (dim=%d): %d miss rows served as "
+                    "zero vectors (%s)", dim, len(all_signs), e)
+                continue
             self.cache.put(uniq, dim, rows)
             pos = 0
             for mat, miss_pos, s in parts:
@@ -611,6 +688,8 @@ class InferenceServer:
             "compiled_buckets": sorted(self.ctx.eval_batch_rows_seen),
             "buckets": list(self.buckets),
         }
+        d["degraded_lookups"] = self._m_degraded.value
+        d["zero_fallback_rows"] = self._m_zero_rows.value
         if self.cache is not None:
             d.update(cache_hit_rate=self.cache.hit_rate,
                      cache_hits=self.cache.hits,
@@ -761,6 +840,10 @@ def main(argv=None):
                    help="hot-row LRU capacity (0 = no cache)")
     p.add_argument("--cache-ttl-sec", type=float, default=30.0,
                    help="hot-row TTL; bounds staleness vs inc_update")
+    p.add_argument("--no-degraded-fallback", action="store_true",
+                   help="fail predicts when the embedding tier is "
+                        "unreachable instead of serving zero-vector "
+                        "embeddings for the affected signs")
     from persia_tpu import obs_http
 
     obs_http.add_http_args(p)
@@ -781,7 +864,8 @@ def main(argv=None):
                              max_wait_us=args.max_wait_us,
                              cache_rows=args.cache_rows,
                              cache_ttl_sec=args.cache_ttl_sec,
-                             http_port=obs_http.port_from_args(args))
+                             http_port=obs_http.port_from_args(args),
+                             degraded_fallback=not args.no_degraded_fallback)
     obs_http.write_addr_file_from_args(server.http, args)
     server.serve_forever()
 

@@ -43,6 +43,12 @@ while [ "$(date +%s)" -lt "$END" ]; do
       #     Chrome-trace JSON from the TPU host next to the log
       step "bench trace (observability)" python bench.py --mode trace \
         --trace-out /root/repo/TRACE_capture.json --max-seconds 900
+      # 4c. fault tolerance: kill/restart a live PS mid-training-loop
+      #     (detection latency, recovery time, lost updates, restore
+      #     parity) — host-only, but captured on the TPU host so the
+      #     recovery numbers reflect production-class core counts
+      step "bench chaos (fault tolerance)" python bench.py --mode chaos \
+        --max-seconds 900
       # 5. re-capture the headline near the end of the window
       step "re-capture: python bench.py" python bench.py
       echo "$(date -u +%FT%TZ) chip sequence complete — see BENCH_CAPTURE_r05.log" >> "$LOG"
