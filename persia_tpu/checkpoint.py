@@ -20,7 +20,6 @@ Re-design of the reference model manager
 
 import json
 import os
-import struct
 import time
 from typing import List, Optional, Sequence
 
@@ -28,7 +27,6 @@ import numpy as np
 
 from persia_tpu.hashing import farmhash64_np
 from persia_tpu.logger import get_default_logger
-from persia_tpu.ps.store import DUMP_MAGIC
 
 _logger = get_default_logger(__name__)
 
@@ -125,17 +123,17 @@ def wait_for_idle(ps_clients: Sequence, timeout: float = 600.0):
 
 
 def iter_psd_entries(path: str):
-    """Stream (sign, dim, vec) records out of one PSD1 file."""
+    """Stream (sign, dim, f32 vec) records out of one PSD v1/v2 file.
+
+    v2 records (half-precision holders' dumps) carry a per-record
+    embedding dtype tag; the shared decoder widens them to f32, so every
+    consumer (resharding load, incremental replay) is version-agnostic
+    and the target holder re-narrows per its own ``row_dtype``."""
+    from persia_tpu.ps.store import iter_psd_records, read_psd_header
+
     with open(path, "rb") as f:
-        head = f.read(4 + struct.calcsize("<IQ"))
-        if head[:4] != DUMP_MAGIC:
-            raise ValueError(f"{path}: bad PSD1 magic")
-        _version, count = struct.unpack_from("<IQ", head, 4)
-        for _ in range(count):
-            rec = f.read(struct.calcsize("<QII"))
-            sign, dim, total = struct.unpack("<QII", rec)
-            vec = np.frombuffer(f.read(4 * total), dtype=np.float32)
-            yield sign, dim, vec
+        version, count = read_psd_header(f, path)
+        yield from iter_psd_records(f.read, version, count)
 
 
 def load_sharded(ps_clients: Sequence, dirpath: str):

@@ -247,6 +247,14 @@ class EmbeddingParameterServerConfig:
 
     capacity: int = 1_000_000_000
     num_hashmap_internal_shards: int = 100
+    # storage precision of the embedding slice of every row ("fp32" |
+    # "fp16" | "bf16"); optimizer state always stays fp32. Non-fp32 is
+    # Python-holder-only — the native C++ store is parity-gated to fp32
+    # (ps.native.lint_row_dtype rejects the combination loudly).
+    row_dtype: str = "fp32"
+    # optional BYTE budget for eviction (0 = row-count capacity only):
+    # with it, an fp16 table genuinely admits ~2x the rows of fp32
+    capacity_bytes: int = 0
     # accepted for config-file compatibility with the reference; the
     # full-amount streaming manager is not implemented (full dumps go
     # through checkpoint.dump_sharded instead)
@@ -330,6 +338,8 @@ class GlobalConfig:
                 num_hashmap_internal_shards=int(
                     ps_raw.get("num_hashmap_internal_shards", 100)
                 ),
+                row_dtype=str(ps_raw.get("row_dtype", "fp32")),
+                capacity_bytes=int(ps_raw.get("capacity_bytes", 0)),
                 full_amount_manager_buffer_size=int(
                     ps_raw.get("full_amount_manager_buffer_size", 1000)
                 ),

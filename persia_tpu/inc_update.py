@@ -61,7 +61,16 @@ class IncrementalUpdateDumper:
     def _dump_packet(self, signs: Set[int]):
         import struct
 
-        from persia_tpu.ps.store import DUMP_MAGIC
+        from persia_tpu.ps.optim import RowPrecision
+        from persia_tpu.ps.store import _DTYPE_CODES, DUMP_MAGIC
+
+        # packets honor the holder's storage policy: a half-precision
+        # holder ships v2 records (fp16/bf16 emb bytes + f32 state) —
+        # half the train->serve sync bytes; the loader's version-agnostic
+        # reader widens on apply. fp32 holders keep the v1 layout.
+        row_dtype = getattr(self.holder, "row_dtype", "fp32")
+        rp = RowPrecision(row_dtype)
+        version = 1 if rp.is_fp32 else 2
 
         self._seq += 1
         # the replica index is part of the packet NAME, not just the
@@ -84,12 +93,19 @@ class IncrementalUpdateDumper:
             if entry is None:
                 continue
             dim, vec = entry
-            records.append(struct.pack("<QII", sign, dim, len(vec)))
-            records.append(np.ascontiguousarray(vec, np.float32).tobytes())
+            vec = np.ascontiguousarray(vec, np.float32)
+            if version == 1:
+                records.append(struct.pack("<QII", sign, dim, len(vec)))
+                records.append(vec.tobytes())
+            else:
+                records.append(struct.pack(
+                    "<QIBI", sign, dim, _DTYPE_CODES[rp.name],
+                    len(vec) - dim))
+                records.append(rp.pack(vec, dim).tobytes())
             count += 1
         with open(path, "wb") as f:
             f.write(DUMP_MAGIC)
-            f.write(struct.pack("<IQ", 1, count))
+            f.write(struct.pack("<IQ", version, count))
             for r in records:
                 f.write(r)
         with open(os.path.join(tmp_dir, DONE_MARKER), "w") as f:

@@ -55,7 +55,8 @@ class ObservabilityServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  registry=None, collector=None,
                  health_fn: Optional[Callable[[], Dict]] = None,
-                 service: str = "persia"):
+                 service: str = "persia",
+                 refresh_fn: Optional[Callable[[], None]] = None):
         if registry is None:
             from persia_tpu.metrics import default_registry
 
@@ -67,6 +68,11 @@ class ObservabilityServer:
         self.registry = registry
         self.collector = collector
         self.health_fn = health_fn
+        # called before each /metrics render: services sync pull-style
+        # gauges (e.g. the PS resident-bytes-per-shard series) so a
+        # scrape always sees current values without paying per-mutation
+        # gauge updates on the data path
+        self.refresh_fn = refresh_fn
         self.service = service
         self._t0 = time.monotonic()
         sidecar = self
@@ -81,6 +87,11 @@ class ObservabilityServer:
                 try:
                     url = urlparse(self.path)
                     if url.path == "/metrics":
+                        if sidecar.refresh_fn is not None:
+                            try:
+                                sidecar.refresh_fn()
+                            except Exception:  # never fail a scrape
+                                pass
                         body = sidecar.registry.render().encode()
                         ctype = "text/plain; version=0.0.4; charset=utf-8"
                     elif url.path == "/healthz":
@@ -157,7 +168,7 @@ class ObservabilityServer:
 
 
 def maybe_start(host: str, http_port: Optional[int], health_fn,
-                service: Optional[str] = None):
+                service: Optional[str] = None, refresh_fn=None):
     """The one sidecar-construction convention every service shares:
     ``None`` keeps the sidecar off (in-process test instances), any port
     number starts one (0 = ephemeral). Returns the started server or
@@ -169,7 +180,8 @@ def maybe_start(host: str, http_port: Optional[int], health_fn,
 
         service = service_name()
     return ObservabilityServer(host, http_port, health_fn=health_fn,
-                               service=service).start()
+                               service=service,
+                               refresh_fn=refresh_fn).start()
 
 
 def add_http_args(parser):

@@ -153,6 +153,9 @@ class NativeEmbeddingHolder:
     # the service tier's shard-parallel dispatch gets real parallelism
     # from one process (ps_service.ShardParallelDispatcher keys on this)
     releases_gil = True
+    # parity-gated: the C++ store keeps every row fp32 (make_holder
+    # rejects any other policy while this backend is active)
+    row_dtype = "fp32"
 
     def __init__(self, capacity: int = 1_000_000_000, num_internal_shards: int = 8):
         lib = load_native_lib()
@@ -275,17 +278,77 @@ class NativeEmbeddingHolder:
             raise IOError(f"native dump to {path} failed")
 
     def load_file(self, path: str, clear: bool = True):
+        # The C++ loader reads the (fp32) v1 layout only. A v2 dump —
+        # written by a half-precision PYTHON holder (e.g. an fp16 train
+        # tier handing a checkpoint to a native fp32 serving tier) —
+        # is decoded record-by-record here instead: widen to f32, store
+        # through set_entry. Keeps the "any holder loads either
+        # version" contract without teaching store.h the v2 framing.
+        from persia_tpu.ps.store import iter_psd_records, read_psd_header
+
+        with open(path, "rb") as f:
+            version, count = read_psd_header(f, path)
+            if version == 1:
+                pass  # fast path below: one C++ call
+            else:
+                if clear:
+                    self.clear()
+                for sign, dim, vec in iter_psd_records(f.read, version,
+                                                       count):
+                    self.set_entry(sign, dim, vec)
+                return
         if self._lib.ptps_load(self._h, path.encode(), 1 if clear else 0) != 0:
             raise IOError(f"native load from {path} failed")
 
 
-def make_holder(capacity: int, num_internal_shards: int, prefer_native: bool = True):
-    """Fastest available holder: native C++ store, else the numpy one."""
-    if prefer_native and os.environ.get("PERSIA_FORCE_PYTHON_PS") != "1":
+def lint_row_dtype(row_dtype: str = "fp32", prefer_native: bool = True,
+                   capacity_bytes=None):
+    """Config lint for the mixed-precision store policy: the native C++
+    store (store.h/capi.cc) is **fp32-only** with row-count eviction —
+    it implements neither ``row_dtype`` narrowing nor byte-accounted
+    capacity. Selecting either policy while the native backend would be
+    the active one is a silent-downgrade hazard (rows would quietly stay
+    fp32-wide), so it is rejected LOUDLY here instead. Raises
+    ``ValueError``; a no-op when the policy is plain fp32, the native
+    backend is not preferred/forced off, or the library simply is not
+    built (the numpy holder serves then). ``capacity_bytes`` falsy —
+    including the config-default 0 — means the byte policy is OFF."""
+    if (row_dtype in (None, "fp32")) and not capacity_bytes:
+        return
+    if not prefer_native or os.environ.get("PERSIA_FORCE_PYTHON_PS") == "1":
+        return
+    if load_native_lib(build_if_missing=False) is None:
+        return
+    policy = (f"row_dtype={row_dtype!r}" if row_dtype not in (None, "fp32")
+              else f"capacity_bytes={capacity_bytes}")
+    raise ValueError(
+        f"{policy} is not supported by the native C++ store (fp32 rows, "
+        f"row-count eviction only) and the native backend is active on "
+        f"this host. Either keep row_dtype=fp32 for native parity, or "
+        f"set PERSIA_FORCE_PYTHON_PS=1 to run this replica on the numpy "
+        f"holder, which implements the mixed-precision policy.")
+
+
+def make_holder(capacity: int, num_internal_shards: int,
+                prefer_native: bool = True, row_dtype: str = "fp32",
+                capacity_bytes=None):
+    """Fastest available holder honoring the storage policy: native C++
+    store for plain fp32, else the numpy one. Non-fp32 ``row_dtype`` (or
+    byte-accounted capacity) is Python-holder-only; asking for it while
+    the native backend is active fails loudly (:func:`lint_row_dtype`)
+    rather than silently downgrading the policy."""
+    capacity_bytes = capacity_bytes or None  # 0 (config default) = off
+    lint_row_dtype(row_dtype, prefer_native, capacity_bytes)
+    want_python = (row_dtype not in (None, "fp32")
+                   or capacity_bytes is not None)
+    if (prefer_native and not want_python
+            and os.environ.get("PERSIA_FORCE_PYTHON_PS") != "1"):
         try:
             return NativeEmbeddingHolder(capacity, num_internal_shards)
         except RuntimeError:
             _logger.warning("native store unavailable; using numpy holder")
     from persia_tpu.ps.store import EmbeddingHolder
 
-    return EmbeddingHolder(capacity, num_internal_shards)
+    return EmbeddingHolder(capacity, num_internal_shards,
+                           row_dtype=row_dtype or "fp32",
+                           capacity_bytes=capacity_bytes)

@@ -14,9 +14,198 @@ C++ kernels consume. Entry layout is ``[embedding | optimizer state]``
 (reference: persia-embedding-holder/src/emb_entry.rs:17-158).
 """
 
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
+
+try:  # bf16 storage needs ml_dtypes (shipped with jax); fp16/fp32 do not
+    import ml_dtypes
+
+    _BF16 = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover — jax environments always have it
+    _BF16 = None
+
+
+ROW_DTYPES = ("fp32", "fp16", "bf16")
+
+
+class RowPrecision:
+    """Per-table storage-precision policy for the EMBEDDING portion of a
+    PS entry — the widen-on-read / narrow-on-write half of the
+    mixed-precision store.
+
+    Entries keep the reference's ``[embedding | optimizer state]``
+    layout, but under ``fp16``/``bf16`` the embedding slice is stored in
+    half precision while the appended optimizer state stays fp32
+    (Adagrad/Adam accumulators quantize catastrophically: the
+    ``acc += grad²`` read-modify-write underflows in half precision once
+    the accumulator outgrows the increment, silently freezing the
+    effective LR). The stored form is then ONE contiguous uint8 buffer
+    ``[emb as half | state as f32]`` — one ndarray per entry, same
+    object-header overhead as the legacy fp32 layout, so the measured
+    resident-bytes saving is the data saving.
+
+    All optimizer math runs on widened fp32 matrices (:meth:`unpack` /
+    :meth:`unpack_matrix` before ``SparseOptimizer.update``,
+    :meth:`pack_into` after), so the update arithmetic is fp32-exact;
+    the only precision loss is the final narrow of the embedding slice
+    (one rounding per write, ≤ 2^-11 relative for fp16, ≤ 2^-8 for
+    bf16). ``fp32`` keeps the legacy single-f32-array layout
+    bit-identically."""
+
+    def __init__(self, name: str = "fp32"):
+        if name not in ROW_DTYPES:
+            raise ValueError(
+                f"unknown row_dtype {name!r} (expected one of {ROW_DTYPES})")
+        if name == "bf16" and _BF16 is None:
+            raise ValueError("row_dtype='bf16' requires ml_dtypes")
+        self.name = name
+        self.np_dtype = {
+            "fp32": np.dtype(np.float32),
+            "fp16": np.dtype(np.float16),
+            "bf16": _BF16,
+        }[name]
+        self.itemsize = self.np_dtype.itemsize
+        self.is_fp32 = name == "fp32"
+        # (dim, space) -> structured dtype viewing one stored row as
+        # [emb half | state f32] with ZERO copies — the batched
+        # update's widen/narrow then costs one strided cast pass per
+        # direction instead of a contiguous-copy chain
+        self._struct_cache: Dict[Tuple[int, int], np.dtype] = {}
+
+    def _row_struct(self, dim: int, space: int) -> np.dtype:
+        dt = self._struct_cache.get((dim, space))
+        if dt is None:
+            fields = [("e", self.np_dtype, (dim,))]
+            if space:
+                fields.append(("s", np.float32, (space,)))
+            dt = self._struct_cache[(dim, space)] = np.dtype(fields)
+        return dt
+
+    # --- byte math (capacity planning + the byte-accounting eviction) ---
+
+    def emb_nbytes(self, dim: int) -> int:
+        return dim * self.itemsize
+
+    def entry_nbytes(self, dim: int, space: int) -> int:
+        """Stored DATA bytes of one entry (embedding + optimizer state)."""
+        return dim * self.itemsize + space * 4
+
+    def stored_len(self, dim: int, space: int) -> int:
+        """``len()`` of the stored array for an entry of this shape —
+        f32 elements under fp32, raw bytes under half precision (the
+        width check the update path uses in place of ``dim + space``)."""
+        if self.is_fp32:
+            return dim + space
+        return self.entry_nbytes(dim, space)
+
+    def state_len_of(self, vec: np.ndarray, dim: int) -> Optional[int]:
+        """Optimizer-state f32 slots of a stored vec, or None if the
+        byte length cannot belong to a ``dim``-wide entry."""
+        if self.is_fp32:
+            return len(vec) - dim if len(vec) >= dim else None
+        extra = len(vec) - dim * self.itemsize
+        if extra < 0 or extra % 4:
+            return None
+        return extra // 4
+
+    # --- narrow-on-write --------------------------------------------------
+
+    def pack(self, full: np.ndarray, dim: int) -> np.ndarray:
+        """fp32 ``[emb | state]`` -> the stored form (fresh buffer)."""
+        if self.is_fp32:
+            return np.ascontiguousarray(full, dtype=np.float32)
+        emb = np.ascontiguousarray(full[:dim]).astype(self.np_dtype)
+        state = np.ascontiguousarray(full[dim:], dtype=np.float32)
+        buf = np.empty(emb.nbytes + state.nbytes, np.uint8)
+        buf[: emb.nbytes] = emb.view(np.uint8)
+        if state.nbytes:
+            buf[emb.nbytes:] = state.view(np.uint8)
+        return buf
+
+    def pack_into(self, full: np.ndarray, vec: np.ndarray, dim: int):
+        """Narrow ``full`` (f32 [emb|state]) into the EXISTING stored
+        buffer ``vec`` in place (the update path's write-back)."""
+        if self.is_fp32:
+            vec[:] = full
+            return
+        emb = np.ascontiguousarray(full[:dim]).astype(self.np_dtype)
+        vec[: emb.nbytes] = emb.view(np.uint8)
+        state = np.ascontiguousarray(full[dim:], dtype=np.float32)
+        if state.nbytes:
+            vec[emb.nbytes:] = state.view(np.uint8)
+
+    # --- widen-on-read ----------------------------------------------------
+
+    def emb_f32(self, vec: np.ndarray, dim: int) -> np.ndarray:
+        """The embedding slice of a stored vec, widened to f32."""
+        if self.is_fp32:
+            return vec[:dim]
+        return (np.ascontiguousarray(vec[: dim * self.itemsize])
+                .view(self.np_dtype).astype(np.float32))
+
+    def unpack(self, vec: np.ndarray, dim: int) -> np.ndarray:
+        """Stored vec -> a fresh fp32 ``[emb | state]`` array."""
+        if self.is_fp32:
+            return np.array(vec, dtype=np.float32)
+        esz = dim * self.itemsize
+        out = np.empty(dim + (len(vec) - esz) // 4, np.float32)
+        self.unpack_into(vec, dim, out)
+        return out
+
+    def unpack_into(self, vec: np.ndarray, dim: int, out: np.ndarray):
+        if self.is_fp32:
+            out[:] = vec
+            return
+        esz = dim * self.itemsize
+        out[:dim] = (np.ascontiguousarray(vec[:esz]).view(self.np_dtype)
+                     .astype(np.float32))
+        if len(vec) > esz:
+            out[dim:] = np.ascontiguousarray(vec[esz:]).view(np.float32)
+
+    def unpack_matrix(self, vecs: List[np.ndarray], dim: int,
+                      width: int) -> np.ndarray:
+        """Widen uniform-shape stored vecs into one (n, width) fp32
+        matrix for the batched optimizer call. One gather (np.stack)
+        plus one strided cast pass per field — the structured-dtype
+        view avoids any intermediate contiguous copies."""
+        if self.is_fp32:
+            return np.stack(vecs).astype(np.float32, copy=False)
+        n = len(vecs)
+        space = width - dim
+        rec = np.stack(vecs).view(self._row_struct(dim, space))  # (n, 1)
+        mat = np.empty((n, width), np.float32)
+        mat[:, :dim] = rec["e"].reshape(n, dim)
+        if space:
+            mat[:, dim:] = rec["s"].reshape(n, space)
+        return mat
+
+    def narrow_matrix(self, mat: np.ndarray, dim: int) -> np.ndarray:
+        """fp32 (n, dim+space) -> the stored byte layout as ONE
+        (n, stored_len) uint8 matrix (one strided cast pass per field;
+        rows are then copied out per entry)."""
+        n, width = mat.shape
+        space = width - dim
+        stored = np.empty((n, self.entry_nbytes(dim, space)), np.uint8)
+        rec = stored.view(self._row_struct(dim, space))
+        rec["e"].reshape(n, dim)[...] = mat[:, :dim]
+        if space:
+            rec["s"].reshape(n, space)[...] = mat[:, dim:]
+        return stored
+
+    def pack_matrix_into(self, mat: np.ndarray,
+                         vecs: List[np.ndarray], dim: int):
+        """Narrow the updated fp32 matrix back into the stored per-entry
+        buffers (which stay the live objects in the eviction map). The
+        narrow is one vectorized pass; the write-back is ONE assignment
+        per row — same per-row cost as the fp32 path."""
+        if self.is_fp32:
+            for row, vec in zip(mat, vecs):
+                vec[:] = row
+            return
+        stored = self.narrow_matrix(mat, dim)
+        for i, vec in enumerate(vecs):
+            vec[:] = stored[i]
 
 
 class SparseOptimizer:

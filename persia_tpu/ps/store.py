@@ -27,6 +27,18 @@ TPU-first deviations:
   a per-sign virtual dispatch.
 - Admission decisions are deterministic per sign (rng.py ADMIT_SALT) rather
   than drawn from a thread-local RNG.
+
+Mixed-precision rows (this backend only; the native C++ store is
+parity-gated to fp32 — see :func:`persia_tpu.ps.native.make_holder`):
+``row_dtype`` ∈ {fp32, fp16, bf16} stores the embedding slice in half
+precision while keeping the appended optimizer state fp32; all update
+math runs through :class:`~persia_tpu.ps.optim.RowPrecision`'s
+widen-on-read / narrow-on-write path so the arithmetic stays fp32-exact.
+``capacity_bytes`` switches eviction to byte accounting, so an fp16
+table genuinely admits ~2x the rows of an fp32 one before evicting.
+Half-precision holders dump the PSD **v2** record layout (per-record
+dtype tag, emb bytes + f32 state bytes); v1 files still load into any
+holder, and v2 files load into fp32 holders (widen on read).
 """
 
 import struct
@@ -36,10 +48,17 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from persia_tpu.ps.optim import SparseOptimizer, apply_weight_bound
+from persia_tpu.ps.optim import (
+    RowPrecision,
+    SparseOptimizer,
+    apply_weight_bound,
+)
 from persia_tpu.ps.rng import admit_mask, initialize_entries, internal_shard_of
 
 DUMP_MAGIC = b"PSD1"
+# PSD v2 per-record embedding dtype tags
+_DTYPE_CODES = {"fp32": 0, "fp16": 1, "bf16": 2}
+_DTYPE_NAMES = {v: k for k, v in _DTYPE_CODES.items()}
 
 
 class EvictionMap:
@@ -48,11 +67,24 @@ class EvictionMap:
     Mirrors eviction_map.rs semantics on top of an OrderedDict (which is
     exactly a hashmap + doubly-linked list, the same structure the
     reference builds from a hashmap + ArrayLinkedList).
-    Values are ``(dim, vec)`` with ``vec = [emb | opt_state]`` float32.
+    Values are ``(dim, vec)`` with ``vec = [emb | opt_state]`` float32
+    (fp32 holders) or the :class:`RowPrecision` byte layout.
+
+    Eviction accounts ROWS by default (the reference semantics). With
+    ``byte_capacity`` set it ALSO accounts resident DATA bytes — the fix
+    for capacity meaning "rows" regardless of row width: a byte budget
+    admits ~2x the rows once the embedding slice is fp16.
+    ``emb_itemsize`` tells the byte accounting how much of each entry is
+    embedding (``dim * emb_itemsize``) so the emb/state split is exact.
     """
 
-    def __init__(self, capacity: int):
+    def __init__(self, capacity: int, byte_capacity: Optional[int] = None,
+                 emb_itemsize: int = 4):
         self.capacity = capacity
+        self.byte_capacity = byte_capacity
+        self.emb_itemsize = emb_itemsize
+        self.resident_bytes = 0  # data bytes of all stored vecs
+        self.emb_bytes = 0  # the embedding-portion share of the above
         self._map: "OrderedDict[int, Tuple[int, np.ndarray]]" = OrderedDict()
 
     def get(self, sign: int) -> Optional[Tuple[int, np.ndarray]]:
@@ -64,21 +96,38 @@ class EvictionMap:
             self._map.move_to_end(sign)
         return v
 
-    def insert(self, sign: int, dim: int, vec: np.ndarray) -> Optional[int]:
-        """Insert/replace; returns the evicted sign if capacity overflowed."""
-        if sign in self._map:
-            del self._map[sign]
-        self._map[sign] = (dim, vec)
-        if len(self._map) > self.capacity:
-            evicted_sign, _ = self._map.popitem(last=False)
-            return evicted_sign
-        return None
+    def _account(self, entry: Tuple[int, np.ndarray], sign_mult: int):
+        dim, vec = entry
+        self.resident_bytes += sign_mult * vec.nbytes
+        self.emb_bytes += sign_mult * min(dim * self.emb_itemsize, vec.nbytes)
+
+    def insert(self, sign: int, dim: int, vec: np.ndarray) -> List[int]:
+        """Insert/replace; returns the signs evicted to restore the
+        row/byte budget (empty when nothing overflowed)."""
+        old = self._map.pop(sign, None)
+        if old is not None:
+            self._account(old, -1)
+        entry = (dim, vec)
+        self._map[sign] = entry
+        self._account(entry, +1)
+        evicted: List[int] = []
+        while len(self._map) > self.capacity or (
+            self.byte_capacity is not None
+            and self.resident_bytes > self.byte_capacity
+            and len(self._map) > 1
+        ):
+            evicted_sign, old = self._map.popitem(last=False)
+            self._account(old, -1)
+            evicted.append(evicted_sign)
+        return evicted
 
     def items_in_lru_order(self):
         return self._map.items()
 
     def clear(self):
         self._map.clear()
+        self.resident_bytes = 0
+        self.emb_bytes = 0
 
     def __len__(self) -> int:
         return len(self._map)
@@ -100,13 +149,30 @@ class EmbeddingHolder:
     # native holder sets True and releases the GIL in ctypes calls)
     releases_gil = False
 
-    def __init__(self, capacity: int = 1_000_000_000, num_internal_shards: int = 8):
+    def __init__(self, capacity: int = 1_000_000_000,
+                 num_internal_shards: int = 8, row_dtype: str = "fp32",
+                 capacity_bytes: Optional[int] = None):
         if num_internal_shards <= 0:
             raise ValueError("num_internal_shards must be positive")
+        # 0/falsy means "row-count capacity only" (the config default),
+        # NOT an active zero-byte budget
+        capacity_bytes = capacity_bytes or None
         self.capacity = capacity
+        self.capacity_bytes = capacity_bytes
         self.num_internal_shards = num_internal_shards
+        # per-table storage precision: the embedding slice of every
+        # entry is stored in row_dtype, the optimizer state stays f32
+        # (see RowPrecision); fp32 keeps the legacy layout bit-identically
+        self._rp = RowPrecision(row_dtype)
         per_shard = max(1, capacity // num_internal_shards)
-        self._shards = [EvictionMap(per_shard) for _ in range(num_internal_shards)]
+        per_shard_bytes = (
+            max(1, capacity_bytes // num_internal_shards)
+            if capacity_bytes is not None else None)
+        self._shards = [
+            EvictionMap(per_shard, byte_capacity=per_shard_bytes,
+                        emb_itemsize=self._rp.itemsize)
+            for _ in range(num_internal_shards)
+        ]
         self._locks = [threading.Lock() for _ in range(num_internal_shards)]
         self.optimizer: Optional[SparseOptimizer] = None
         # hyperparameters (configure(), reference mod.rs:429-451)
@@ -121,6 +187,32 @@ class EmbeddingHolder:
         # shard locks — concurrent increments lost updates); readers sum
         self._index_miss = [0] * num_internal_shards
         self._gradient_id_miss = [0] * num_internal_shards
+
+    @property
+    def row_dtype(self) -> str:
+        return self._rp.name
+
+    @property
+    def resident_bytes(self) -> int:
+        """Stored DATA bytes across all shards (emb + optimizer state).
+        Shard counters are ints mutated under their shard's lock; the
+        sum is a consistent-enough snapshot for gauges/health."""
+        return sum(s.resident_bytes for s in self._shards)
+
+    @property
+    def resident_emb_bytes(self) -> int:
+        return sum(s.emb_bytes for s in self._shards)
+
+    def resident_bytes_per_shard(self) -> List[int]:
+        return [s.resident_bytes for s in self._shards]
+
+    def row_nbytes(self, dim: int) -> int:
+        """Predicted stored data bytes/row at ``dim`` under the current
+        policy (embedding + the registered optimizer's state) — the
+        capacity-planning number the memory-budget test checks RSS
+        against."""
+        space = self.optimizer.require_space(dim) if self.optimizer else 0
+        return self._rp.entry_nbytes(dim, space)
 
     @property
     def index_miss_count(self) -> int:
@@ -186,6 +278,10 @@ class EmbeddingHolder:
                 signs, dim, self.init_method, self.init_params)
             if space:
                 self.optimizer.state_initialization(init_vecs, dim)
+        if not self._rp.is_fp32:
+            return self._lookup_half(signs, dim, training, shard_ids,
+                                     init_vecs if training else None,
+                                     admitted if training else None, out)
         for shard_idx in np.unique(shard_ids):
             sel = np.nonzero(shard_ids == shard_idx)[0]
             shard = self._shards[shard_idx]
@@ -210,6 +306,68 @@ class EmbeddingHolder:
                         self._index_miss[shard_idx] += 1
         return out
 
+    def _lookup_half(self, signs, dim, training, shard_ids, init_vecs,
+                     admitted, out):
+        """Half-precision twin of the lookup loop. Same per-sign
+        LRU/admission/insert sequence; the narrow happens once,
+        vectorized, for the whole init matrix, and hit rows widen in one
+        vectorized astype per shard (under that shard's lock — the
+        stored buffers race concurrent updates otherwise). The returned
+        rows are the STORED values (narrow-then-widen), so a lookup
+        right after the miss-insert reads exactly what later lookups
+        will."""
+        rp = self._rp
+        esz = dim * rp.itemsize
+        # the narrowed init rows are only needed on the MISS path; a
+        # steady-state (all-hit) lookup must not pay the full-matrix
+        # casts for them, so they materialize lazily on the first miss:
+        # one (n, stored_len) byte matrix (per-sign insert is then a
+        # single row copy, same cost as the fp32 path's .copy()) plus
+        # the widened rows the caller reads back
+        narrowed = [None]
+
+        def narrow_inits():
+            if narrowed[0] is None:
+                stored_rows = rp.narrow_matrix(init_vecs, dim)
+                widened = (np.ascontiguousarray(stored_rows[:, :esz])
+                           .view(rp.np_dtype).astype(np.float32))
+                narrowed[0] = (stored_rows, widened)
+            return narrowed[0]
+
+        for shard_idx in np.unique(shard_ids):
+            sel = np.nonzero(shard_ids == shard_idx)[0]
+            shard = self._shards[shard_idx]
+            hit_pos: List[int] = []
+            hit_vecs: List[np.ndarray] = []
+            with self._locks[shard_idx]:
+                for pos in sel:
+                    sign = int(signs[pos])
+                    entry = (
+                        shard.get_refresh(sign) if training else shard.get(sign)
+                    )
+                    if entry is not None and entry[0] == dim:
+                        hit_pos.append(pos)
+                        hit_vecs.append(entry[1])
+                    elif not training:
+                        self._index_miss[shard_idx] += 1
+                    elif entry is None and not admitted[pos]:
+                        self._index_miss[shard_idx] += 1
+                    else:
+                        stored_rows, widened = narrow_inits()
+                        out[pos] = widened[pos]
+                        shard.insert(sign, dim, stored_rows[pos].copy())
+                        self._index_miss[shard_idx] += 1
+                if hit_pos:
+                    # entries of the right dim may still differ in state
+                    # width (older optimizer layouts) — copy just the
+                    # emb bytes row-wise, widen in one astype
+                    raw = np.empty((len(hit_vecs), esz), np.uint8)
+                    for i, v in enumerate(hit_vecs):
+                        raw[i] = v[:esz]
+                    out[np.asarray(hit_pos)] = (
+                        raw.view(rp.np_dtype).astype(np.float32))
+        return out
+
     def update_gradients(self, signs: np.ndarray, grads: np.ndarray, dim: int):
         """Batched optimizer step for ``signs`` with grads (n, dim)."""
         if self.optimizer is None:
@@ -222,6 +380,11 @@ class EmbeddingHolder:
         shard_ids = internal_shard_of(signs, self.num_internal_shards)
         space = self.optimizer.require_space(dim)
         width = dim + space
+        rp = self._rp
+        # width check below also skips entries created under a different
+        # optimizer's state layout; for half rows it compares the stored
+        # BYTE length (RowPrecision.stored_len)
+        stored_len = rp.stored_len(dim, space)
         # Duplicate signs must apply sequentially (each step sees the
         # previous one's result, like the reference); a batched
         # gather/update/scatter would drop all but the last duplicate.
@@ -237,20 +400,23 @@ class EmbeddingHolder:
                 found_entries: List[np.ndarray] = []
                 for pos in sel:
                     entry = shard.get(int(signs[pos]))
-                    # width check also skips entries created under a
-                    # different optimizer's state layout
                     if entry is not None and entry[0] == dim and \
-                            len(entry[1]) == width:
+                            len(entry[1]) == stored_len:
                         if has_dups:
+                            # widen-on-read, fp32-exact update,
+                            # narrow-on-write (fp32: in-place, no copy)
                             st = (batch_state[pos : pos + 1]
                                   if batch_state is not None else None)
-                            row = entry[1][None, :]
+                            if rp.is_fp32:
+                                row = entry[1][None, :]
+                            else:
+                                row = rp.unpack(entry[1], dim)[None, :]
                             self.optimizer.update(
                                 row, grads[pos : pos + 1], dim, st)
                             if self.enable_weight_bound:
                                 apply_weight_bound(row[:, :dim],
                                                    self.weight_bound)
-                            entry[1][:] = row[0]
+                            rp.pack_into(row[0], entry[1], dim)
                         else:
                             found_pos.append(pos)
                             found_entries.append(entry[1])
@@ -259,7 +425,8 @@ class EmbeddingHolder:
                 if not found_pos:
                     continue
                 # fast path (no duplicates): one batched optimizer call
-                mat = np.stack(found_entries).astype(np.float32, copy=False)
+                # on the widened fp32 matrix, narrowed back row-wise
+                mat = rp.unpack_matrix(found_entries, dim, width)
                 assert mat.shape[1] == width
                 sub_state = (
                     batch_state[np.array(found_pos)]
@@ -269,24 +436,29 @@ class EmbeddingHolder:
                                       sub_state)
                 if self.enable_weight_bound:
                     apply_weight_bound(mat[:, :dim], self.weight_bound)
-                for row, vec in zip(mat, found_entries):
-                    vec[:] = row  # write back (vec is the stored buffer)
+                rp.pack_matrix_into(mat, found_entries, dim)
 
     # --- debug / checkpoint --------------------------------------------
 
     def get_entry(self, sign: int) -> Optional[Tuple[int, np.ndarray]]:
+        """(dim, f32 [emb|state]) or None. fp32 holders hand out the
+        live stored buffer (legacy semantics); half holders widen into a
+        fresh copy."""
         shard_idx = int(internal_shard_of(np.array([sign], dtype=np.uint64),
                                           self.num_internal_shards)[0])
         with self._locks[shard_idx]:
-            return self._shards[shard_idx].get(sign)
+            entry = self._shards[shard_idx].get(sign)
+            if entry is None or self._rp.is_fp32:
+                return entry
+            return entry[0], self._rp.unpack(entry[1], entry[0])
 
     def set_entry(self, sign: int, dim: int, vec: np.ndarray):
         shard_idx = int(internal_shard_of(np.array([sign], dtype=np.uint64),
                                           self.num_internal_shards)[0])
+        stored = self._rp.pack(
+            np.ascontiguousarray(vec, dtype=np.float32), dim)
         with self._locks[shard_idx]:
-            self._shards[shard_idx].insert(
-                sign, dim, np.ascontiguousarray(vec, dtype=np.float32)
-            )
+            self._shards[shard_idx].insert(sign, dim, stored)
 
     def get_entries(self, signs: np.ndarray, width: int):
         """Batched ``get_entry`` for uniform-width entries (value + opt
@@ -298,15 +470,27 @@ class EmbeddingHolder:
         found = np.zeros(n, dtype=bool)
         vecs = np.zeros((n, width), dtype=np.float32)
         shard_ids = internal_shard_of(signs, self.num_internal_shards)
+        rp = self._rp
         for shard_idx in np.unique(shard_ids):
             sel = np.nonzero(shard_ids == shard_idx)[0]
             with self._locks[shard_idx]:
                 shard = self._shards[shard_idx]
                 for pos in sel:
                     entry = shard.get(int(signs[pos]))
-                    if entry is not None and len(entry[1]) == width:
+                    if entry is None:
+                        continue
+                    if rp.is_fp32:
+                        if len(entry[1]) == width:
+                            found[pos] = True
+                            vecs[pos] = entry[1]
+                        continue
+                    # half layout: a dim-d entry with state s is width
+                    # d + s in f32 units — match on that, widen on read
+                    state_len = rp.state_len_of(entry[1], entry[0])
+                    if (state_len is not None
+                            and entry[0] + state_len == width):
                         found[pos] = True
-                        vecs[pos] = entry[1]
+                        rp.unpack_into(entry[1], entry[0], vecs[pos])
         return found, vecs
 
     def set_entries(self, signs: np.ndarray, dim: int, vecs: np.ndarray):
@@ -315,12 +499,15 @@ class EmbeddingHolder:
         signs = np.ascontiguousarray(signs, dtype=np.uint64)
         vecs = np.ascontiguousarray(vecs, dtype=np.float32)
         shard_ids = internal_shard_of(signs, self.num_internal_shards)
+        rp = self._rp
         for shard_idx in np.unique(shard_ids):
             sel = np.nonzero(shard_ids == shard_idx)[0]
             with self._locks[shard_idx]:
                 shard = self._shards[shard_idx]
                 for pos in sel:
-                    shard.insert(int(signs[pos]), dim, vecs[pos].copy())
+                    stored = (vecs[pos].copy() if rp.is_fp32
+                              else rp.pack(vecs[pos], dim))
+                    shard.insert(int(signs[pos]), dim, stored)
 
     def clear(self):
         for lock, shard in zip(self._locks, self._shards):
@@ -333,38 +520,53 @@ class EmbeddingHolder:
     # --- serialization (PSD1, shared with native/src/store.h) -----------
 
     def dump_bytes(self) -> bytes:
-        """Serialize all entries (LRU order per shard) to the PSD1 layout.
+        """Serialize all entries (LRU order per shard).
+
+        fp32 holders write the legacy **v1** layout bit-identically
+        (shared with native/src/store.h and every pre-existing reader).
+        Half-precision holders write **v2**: same magic, version field
+        2, and per-record ``sign u64 | dim u32 | emb-dtype u8 |
+        state_len u32 | emb bytes (dim * itemsize) | state f32 bytes`` —
+        half the embedding bytes on disk, f32 state exact, and a
+        dtype-tagged record so any holder (including fp32) can widen it
+        back on load.
 
         The header count is derived from the records actually serialized
         (each shard under its own lock) — never from an unlocked size
         snapshot, which concurrent inserts/evictions could invalidate and
         leave the checkpoint unloadable."""
+        rp = self._rp
         chunks = []
         count = 0
+        if rp.is_fp32:
+            for lock, shard in zip(self._locks, self._shards):
+                with lock:
+                    for sign, (dim, vec) in shard.items_in_lru_order():
+                        chunks.append(struct.pack("<QII", sign, dim, len(vec)))
+                        chunks.append(np.ascontiguousarray(
+                            vec, dtype=np.float32).tobytes())
+                        count += 1
+            return b"".join(
+                [DUMP_MAGIC, struct.pack("<IQ", 1, count)] + chunks)
+        code = _DTYPE_CODES[rp.name]
         for lock, shard in zip(self._locks, self._shards):
             with lock:
                 for sign, (dim, vec) in shard.items_in_lru_order():
-                    chunks.append(struct.pack("<QII", sign, dim, len(vec)))
-                    chunks.append(
-                        np.ascontiguousarray(vec, dtype=np.float32).tobytes())
+                    state_len = rp.state_len_of(vec, dim)
+                    chunks.append(struct.pack("<QIBI", sign, dim, code,
+                                              state_len))
+                    chunks.append(vec.tobytes())
                     count += 1
-        return b"".join([DUMP_MAGIC, struct.pack("<IQ", 1, count)] + chunks)
+        return b"".join([DUMP_MAGIC, struct.pack("<IQ", 2, count)] + chunks)
 
     def load_bytes(self, buf: bytes, clear: bool = True):
-        view = memoryview(buf)
-        if bytes(view[:4]) != DUMP_MAGIC:
-            raise ValueError("bad PSD1 magic")
-        version, count = struct.unpack_from("<IQ", view, 4)
-        if version != 1:
-            raise ValueError(f"unsupported PSD1 version {version}")
+        import io
+
+        reader = io.BytesIO(buf)
+        version, count = read_psd_header(reader, "<load_bytes>")
         if clear:
             self.clear()
-        pos = 4 + struct.calcsize("<IQ")
-        for _ in range(count):
-            sign, dim, total = struct.unpack_from("<QII", view, pos)
-            pos += struct.calcsize("<QII")
-            vec = np.frombuffer(view, dtype=np.float32, count=total, offset=pos).copy()
-            pos += 4 * total
+        for sign, dim, vec in iter_psd_records(reader.read, version, count):
             self.set_entry(sign, dim, vec)
 
     def dump_file(self, path: str):
@@ -374,3 +576,52 @@ class EmbeddingHolder:
     def load_file(self, path: str, clear: bool = True):
         with open(path, "rb") as f:
             self.load_bytes(f.read(), clear=clear)
+
+
+def read_psd_header(f, name: str = "<psd>"):
+    """Validate magic + version off a file-like; returns (version,
+    count)."""
+    head = f.read(4 + struct.calcsize("<IQ"))
+    if head[:4] != DUMP_MAGIC:
+        raise ValueError(f"{name}: bad PSD1 magic")
+    version, count = struct.unpack_from("<IQ", head, 4)
+    if version not in (1, 2):
+        raise ValueError(f"{name}: unsupported PSD version {version}")
+    return version, count
+
+
+def iter_psd_records(read, version: int, count: int):
+    """Yield ``(sign, dim, f32 [emb|state] vec)`` records via a
+    ``read(n) -> bytes`` callable — THE one widen-on-read PSD decoder,
+    shared by ``load_bytes`` and the streaming checkpoint reader
+    (``checkpoint.iter_psd_entries``), so a format change cannot fork.
+    v2 embedding slices widen from their tagged dtype, so any holder
+    consumes any version (it re-narrows per its own policy on
+    ``set_entry``). Yielded vecs are fresh WRITABLE arrays — holders
+    store the buffer they are handed and mutate it in place on update."""
+    rec1 = struct.calcsize("<QII")
+    rec2 = struct.calcsize("<QIBI")
+    rp_by_code: Dict[int, RowPrecision] = {}
+    for _ in range(count):
+        if version == 1:
+            sign, dim, total = struct.unpack("<QII", read(rec1))
+            vec = np.frombuffer(read(4 * total), dtype=np.float32).copy()
+        else:
+            sign, dim, code, state_len = struct.unpack("<QIBI", read(rec2))
+            rp = rp_by_code.get(code)
+            if rp is None:
+                name = _DTYPE_NAMES.get(code)
+                if name is None:
+                    raise ValueError(f"unknown PSD2 dtype code {code}")
+                rp = rp_by_code[code] = RowPrecision(name)
+            raw = np.frombuffer(read(rp.entry_nbytes(dim, state_len)),
+                                dtype=np.uint8)
+            if rp.is_fp32:
+                # dtype code 0 (fp32) is legal in a v2 record even
+                # though in-repo writers never emit it: the bytes ARE
+                # f32, so reinterpret — unpack() would VALUE-convert
+                # each byte into a float
+                vec = raw.view(np.float32).copy()
+            else:
+                vec = rp.unpack(raw, dim)
+        yield sign, dim, vec

@@ -102,7 +102,58 @@ _FLAG_TAGGED = 2
 # connection — the dispatch-pool server must NOT execute inline on the
 # reader thread (a slow handler would head-of-line block the others)
 _FLAG_PIPELINED = 4
+# payload is block-compressed with the codec negotiated by the
+# ``__codec__`` probe (lz4 when both sides have it, zlib fallback); the
+# first payload byte names the algorithm, so the frame is self-decoding
+# — but the flag is only ever SENT on a connection that negotiated it,
+# so legacy peers never see a frame they cannot parse
+_FLAG_BLOCK = 8
 COMPRESS_THRESHOLD = 1 << 16
+BLOCK_THRESHOLD = 1 << 16
+
+# --- negotiated block compression (the __codec__ wire) -------------------
+# zstd (above) predates the codec negotiation and stays as-is where the
+# library exists; this path is the lz4-or-zlib block codec from the
+# reference's lz4-compressed RPC, made safe by negotiation instead of by
+# assuming both ends were built alike.
+_BLOCK_LZ4 = 1
+_BLOCK_ZLIB = 2
+
+try:
+    import lz4.frame as _lz4_frame
+except ImportError:  # pragma: no cover — zlib fallback always exists
+    _lz4_frame = None
+
+import zlib as _zlib
+
+# force block compression even on loopback (tests + bench exercise the
+# codec path without a real DCN link; normal loopback traffic skips it,
+# same rule as the zstd path — pure CPU tax there)
+_FORCE_BLOCK = os.environ.get("PERSIA_RPC_FORCE_BLOCK") == "1"
+
+
+def block_codecs() -> List[str]:
+    """Locally supported block codecs, preference order first."""
+    return (["lz4", "zlib"] if _lz4_frame is not None else ["zlib"])
+
+
+def _block_compress(data: bytes, algo: str) -> bytes:
+    if algo == "lz4" and _lz4_frame is not None:
+        return bytes((_BLOCK_LZ4,)) + _lz4_frame.compress(data)
+    return bytes((_BLOCK_ZLIB,)) + _zlib.compress(data, 1)
+
+
+def _block_decompress(payload) -> bytes:
+    buf = payload if isinstance(payload, (bytes, bytearray)) \
+        else bytes(payload)
+    algo, body = buf[0], buf[1:]
+    if algo == _BLOCK_LZ4:
+        if _lz4_frame is None:  # pragma: no cover — negotiation prevents
+            raise RpcError("lz4 block payload but lz4 unavailable")
+        return _lz4_frame.decompress(body)
+    if algo == _BLOCK_ZLIB:
+        return _zlib.decompress(body)
+    raise RpcError(f"unknown block codec id {algo}")
 
 # A payload is bytes, OR a buffer list from pack_arrays_sg (scatter-
 # gather: written with one sendmsg instead of concatenated first).
@@ -411,13 +462,20 @@ def _sendmsg_all(sock: socket.socket, bufs: List[memoryview]):
 
 def _send_msg(sock: socket.socket, envelope: list, payload: Payload,
               compress: bool, tag: Optional[int] = None,
-              pipelined: bool = False):
+              pipelined: bool = False, block: Optional[str] = None):
     flags = _FLAG_PIPELINED if pipelined else 0
     nbytes = _payload_nbytes(payload)
     if compress and zstandard is not None and nbytes > COMPRESS_THRESHOLD:
         payload = _zstd_c().compress(_payload_bytes(payload))
         nbytes = len(payload)
         flags |= _FLAG_COMPRESSED
+    elif block is not None and nbytes > BLOCK_THRESHOLD and (
+            compress or _FORCE_BLOCK):
+        comp = _block_compress(_payload_bytes(payload), block)
+        if len(comp) < nbytes:  # incompressible payloads ship raw
+            payload = comp
+            nbytes = len(comp)
+            flags |= _FLAG_BLOCK
     env = msgpack.packb(envelope + [nbytes], use_bin_type=True)
     # frame_len counts everything after the u32: flags+env_len fields (3
     # bytes, already consumed by the fixed 7-byte header read) + the
@@ -477,6 +535,8 @@ def _recv_msg_full(sock: socket.socket) -> Tuple[list, Payload,
         if zstandard is None:  # pragma: no cover
             raise RpcError("compressed payload but zstandard unavailable")
         payload = _zstd_d().decompress(payload)
+    elif flags & _FLAG_BLOCK:
+        payload = _block_decompress(payload)
     return env, payload, tag, flags
 
 
@@ -533,7 +593,8 @@ class RpcServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  concurrent_streams: int = 1, enable_tags: bool = True,
-                 enable_trace: bool = True, enable_deadline: bool = True):
+                 enable_trace: bool = True, enable_deadline: bool = True,
+                 enable_codec: bool = True):
         from collections import OrderedDict
 
         self._concurrent_streams = max(1, int(concurrent_streams))
@@ -541,10 +602,13 @@ class RpcServer:
         # ``__tags__`` negotiation answers "no such method" and clients
         # negotiate down to untagged framing (compat tests use this);
         # enable_trace=False likewise refuses the ``__trace__`` probe so
-        # clients never attach the trace envelope slot, and
+        # clients never attach the trace envelope slot,
         # enable_deadline=False refuses ``__deadline__`` so clients
-        # never attach the deadline slot (legacy-peer emulation)
+        # never attach the deadline slot, and enable_codec=False refuses
+        # the ``__codec__`` probe so clients never send block-compressed
+        # frames or half-precision payloads (legacy-peer emulation)
         self._enable_tags = enable_tags
+        self._enable_codec = enable_codec
         self._handlers: Dict[str, Callable[[bytes], bytes]] = {}
         if enable_trace:
             self._handlers["__trace__"] = lambda payload: b""
@@ -618,6 +682,22 @@ class RpcServer:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             threading.Thread(target=self._serve_conn, args=(conn,),
                              daemon=True).start()
+
+    @staticmethod
+    def _codec_negotiate(payload) -> Tuple[bytes, Optional[str]]:
+        """Answer a ``__codec__`` probe: pick the first of the client's
+        block codecs this process also has (lz4 both sides, else zlib —
+        zlib is stdlib, so the intersection is never empty against a
+        probe from this codebase). Returns (reply payload, chosen)."""
+        chosen = None
+        try:
+            req = msgpack.unpackb(_payload_bytes(payload), raw=False) or {}
+            mine = block_codecs()
+            chosen = next((c for c in req.get("compress", []) if c in mine),
+                          None)
+        except Exception:
+            chosen = None
+        return msgpack.packb({"compress": chosen}, use_bin_type=True), chosen
 
     def health(self) -> dict:
         """Live-internals snapshot for the HTTP sidecar's /healthz."""
@@ -699,6 +779,10 @@ class RpcServer:
         compress = not _is_loopback(conn)
         pending: "_queue.Queue" = _queue.Queue()
         inflight = threading.BoundedSemaphore(self._concurrent_streams)
+        # block codec negotiated by this connection's __codec__ probe
+        # (mutable cell: send_response closes over it before the probe
+        # can arrive)
+        block_algo: List[Optional[str]] = [None]
         # responses may leave from the reader (inline fast path), the
         # writer (untagged in-order) or a pool thread (tagged,
         # completion order) — the lock keeps frames from interleaving
@@ -718,7 +802,7 @@ class RpcServer:
                 with send_lock:
                     _send_msg(conn, env, body,
                               compress if env[0] == "ok" else False,
-                              tag=tag)
+                              tag=tag, block=block_algo[0])
             except OSError:
                 conn_dead.set()
 
@@ -799,6 +883,15 @@ class RpcServer:
                         ack.set_result((["ok"], b""))
                         pending.put((tag, ack))
                         continue
+                    if method == "__codec__" and self._enable_codec:
+                        reply, block_algo[0] = self._codec_negotiate(payload)
+                        inflight.acquire()
+                        with queued_lock:
+                            queued[0] += 1
+                        ack = Future()
+                        ack.set_result((["ok"], reply))
+                        pending.put((tag, ack))
+                        continue
                     req_id = env[1] if len(env) >= 3 else None
                     trace = env[2] if len(env) >= 4 else None
                     # deadline slot carries REMAINING seconds (clock-sync
@@ -866,6 +959,7 @@ class RpcServer:
             self._serve_conn_concurrent(conn)
             return
         compress = not _is_loopback(conn)
+        block = None  # set by this connection's __codec__ probe
         with conn:
             while self._running:
                 try:
@@ -907,6 +1001,10 @@ class RpcServer:
                         # they do not promise it)
                         _send_msg(conn, ["ok"], b"", False, tag=tag)
                         continue
+                    if method == "__codec__" and self._enable_codec:
+                        reply, block = self._codec_negotiate(payload)
+                        _send_msg(conn, ["ok"], reply, False, tag=tag)
+                        continue
                 except OSError:
                     return
                 renv, rbody = self._handle_one(method, payload, req_id,
@@ -914,7 +1012,7 @@ class RpcServer:
                 try:
                     _send_msg(conn, renv, rbody,
                               compress if renv[0] == "ok" else False,
-                              tag=tag)
+                              tag=tag, block=block)
                 except OSError:
                     return
 
@@ -972,7 +1070,8 @@ class _ConnState:
     none of this state needs a lock."""
 
     __slots__ = ("sock", "compress", "tagged", "trace", "deadline",
-                 "next_tag", "outstanding", "done", "evicted", "dead")
+                 "codec", "block", "next_tag", "outstanding", "done",
+                 "evicted", "dead")
 
     def __init__(self, sock: socket.socket, compress: bool):
         self.sock = sock
@@ -980,6 +1079,8 @@ class _ConnState:
         self.tagged = False
         self.trace = False  # peer acked the __trace__ envelope slot
         self.deadline = False  # peer acked the __deadline__ envelope slot
+        self.codec = False  # peer acked the __codec__ payload codec
+        self.block = None  # negotiated block-compression algo (or None)
         self.next_tag = 1
         self.outstanding = set()  # tags sent, reply not yet claimed
         self.done: Dict[int, tuple] = {}  # tag -> (env, payload) parked
@@ -1019,6 +1120,7 @@ class RpcFuture:
             self._resolved = True
             try:
                 env, payload = self._client._wait_tag(self._cs, self._tag)
+                self._client._count_wire(recv=_payload_nbytes(payload))
             except (ConnectionError, OSError) as e:
                 self._error = _typed_transport_error(
                     e, self._client.addr, self._method)
@@ -1056,7 +1158,8 @@ class RpcClient:
                  max_retries: int = 5, retry_backoff: float = 0.2,
                  enable_tags: bool = True,
                  deadline: Optional[float] = None,
-                 enable_deadline: Optional[bool] = None):
+                 enable_deadline: Optional[bool] = None,
+                 enable_codec: bool = False):
         self.addr = addr
         host, port = addr.rsplit(":", 1)
         self._target = (host, int(port))
@@ -1064,6 +1167,15 @@ class RpcClient:
         self.max_retries = max_retries
         self.retry_backoff = retry_backoff
         self.enable_tags = enable_tags
+        # opt-in payload codec (PsClient turns it on for its
+        # mixed-precision wire): probes __codec__ at dial; legacy
+        # servers negotiate down; when off, no probe — byte-identical
+        self.enable_codec = enable_codec
+        # payload bytes in/out, pre-framing (what the wire codec
+        # shrinks): the bench's bytes-on-wire accounting
+        self._wire_lock = threading.Lock()
+        self._bytes_sent = 0
+        self._bytes_recv = 0
         # deadline propagation is negotiated like __trace__: the
         # ``__deadline__`` probe is ONLY sent when this client wants
         # deadlines at all (a default deadline, or enable_deadline=True
@@ -1108,6 +1220,25 @@ class RpcClient:
                 _send_msg(sock, ["__deadline__"], b"", False)
                 env, _, _ = _recv_msg_tagged(sock)
                 cs.deadline = env[0] == "ok"
+            if self.enable_codec:
+                # payload-codec negotiation: the probe carries this
+                # side's block codecs; an acking server replies with the
+                # chosen one and both sides may then ship half-precision
+                # payloads and block-compressed large frames. Legacy
+                # peers answer "no such method" and the connection stays
+                # on the fp32/raw wire; with the codec off the probe is
+                # never sent — byte-identical legacy wire.
+                _send_msg(sock, ["__codec__"],
+                          msgpack.packb({"compress": block_codecs()},
+                                        use_bin_type=True), False)
+                env, pl, _ = _recv_msg_tagged(sock)
+                if env[0] == "ok":
+                    cs.codec = True
+                    try:
+                        rep = msgpack.unpackb(_payload_bytes(pl), raw=False)
+                        cs.block = (rep or {}).get("compress")
+                    except Exception:
+                        cs.block = None
         except BaseException:
             try:
                 sock.close()
@@ -1134,6 +1265,31 @@ class RpcClient:
         if cs is None or cs.dead:
             cs = self._dial()
         return cs
+
+    def codec_active(self) -> bool:
+        """True when this thread's connection negotiated the payload
+        codec (dialing if needed); False against legacy peers, on
+        dial failure (the caller's normal call path retries), or when
+        the codec was never enabled."""
+        if not self.enable_codec:
+            return False
+        try:
+            return self._conn().codec
+        except (ConnectionError, OSError):
+            return False
+
+    def _count_wire(self, sent: int = 0, recv: int = 0):
+        with self._wire_lock:
+            self._bytes_sent += sent
+            self._bytes_recv += recv
+
+    def wire_stats(self) -> Dict[str, int]:
+        """Cumulative request/response PAYLOAD bytes through this client
+        (pre-compression — the codec-sensitive number: fp16 rows and
+        int8 grads halve/quarter it; block compression on a DCN link
+        shrinks the physical bytes further)."""
+        with self._wire_lock:
+            return {"sent": self._bytes_sent, "recv": self._bytes_recv}
 
     def _drop_conn(self, cs: Optional[_ConnState]):
         if cs is None:
@@ -1292,12 +1448,15 @@ class RpcClient:
                 if cs.tagged:
                     tag = self._take_tag(cs)
                     _send_msg(cs.sock, env_send, payload, cs.compress,
-                              tag=tag)
+                              tag=tag, block=cs.block)
                     cs.outstanding.add(tag)
                     env, result = self._wait_tag(cs, tag)
                 else:
-                    _send_msg(cs.sock, env_send, payload, cs.compress)
+                    _send_msg(cs.sock, env_send, payload, cs.compress,
+                              block=cs.block)
                     env, result = _recv_msg(cs.sock)
+                self._count_wire(sent=_payload_nbytes(payload),
+                                 recv=_payload_nbytes(result))
                 break
             except (ConnectionError, OSError) as e:
                 self._drop_conn(cs)
@@ -1351,10 +1510,11 @@ class RpcClient:
                             method=method)
             self._drain_ready(cs)  # keep the reply direction flowing
             _send_msg(cs.sock, envelope, payload, cs.compress, tag=tag,
-                      pipelined=True)
+                      pipelined=True, block=cs.block)
         except (ConnectionError, OSError) as e:
             self._drop_conn(cs)
             raise _typed_transport_error(e, self.addr, method) from e
+        self._count_wire(sent=_payload_nbytes(payload))
         cs.outstanding.add(tag)
         return RpcFuture(self, cs, tag, method)
 
@@ -1395,9 +1555,11 @@ class RpcClient:
                         faults.fire("rpc.client.send", addr=self.addr,
                                     method=method)
                     _send_msg(cs.sock, envelope, payloads[i_send],
-                              cs.compress, pipelined=True)
+                              cs.compress, pipelined=True, block=cs.block)
+                    self._count_wire(sent=_payload_nbytes(payloads[i_send]))
                     i_send += 1
                 env, result = _recv_msg(cs.sock)
+                self._count_wire(recv=_payload_nbytes(result))
                 if env[0] != "ok":
                     # keep draining: an unread tail would desynchronize
                     # the NEXT call's request/response pairing
@@ -1430,13 +1592,16 @@ class RpcClient:
                     self._drain_ready(cs)  # keep the reply direction flowing
                     tag = self._take_tag(cs)
                     _send_msg(cs.sock, envelope, payloads[i_send],
-                              cs.compress, tag=tag, pipelined=True)
+                              cs.compress, tag=tag, pipelined=True,
+                              block=cs.block)
+                    self._count_wire(sent=_payload_nbytes(payloads[i_send]))
                     cs.outstanding.add(tag)
                     tags.append(tag)
                     i_send += 1
                 # claim in request order; out-of-order arrivals park in
                 # cs.done, so a slow request never blocks the server
                 env, result = self._wait_tag(cs, tags[len(results)])
+                self._count_wire(recv=_payload_nbytes(result))
                 if env[0] != "ok":
                     if first_err is None:
                         first_err = (self.addr, method, env[1])

@@ -210,13 +210,40 @@ class PsService:
         s.register("load", self._load)
         s.register("status", self._status)
         s.register("ready_for_serving", self._ready)
+        # RPC twin of the sidecar's /healthz (the bench and capacity
+        # tooling read resident bytes without scraping HTTP)
+        s.register("health", self._health_rpc)
+        # per-internal-shard resident-bytes gauges (Python holder only;
+        # the native store has no byte accounting) — refreshed on every
+        # health read and before each /metrics render
+        from persia_tpu.metrics import default_registry
+
+        self._mem_gauges: List = []
+        if hasattr(holder, "resident_bytes_per_shard"):
+            reg = default_registry()
+            port_label = self.server.addr.rsplit(":", 1)[1]
+            self._mem_gauges = [
+                reg.gauge("ps_resident_bytes",
+                          {"server": port_label, "shard": str(i)})
+                for i in range(holder.num_internal_shards)
+            ]
         # observability sidecar: /metrics + /healthz + /trace next to
         # the RPC socket (http_port=0 binds an ephemeral port; None
         # keeps the sidecar off — in-process test holders don't want a
         # listener per instance)
         from persia_tpu import obs_http
 
-        self.http = obs_http.maybe_start(host, http_port, self._health)
+        self.http = obs_http.maybe_start(host, http_port, self._health,
+                                         refresh_fn=self._refresh_mem_gauges)
+
+    def _refresh_mem_gauges(self):
+        if self._mem_gauges:
+            for g, b in zip(self._mem_gauges,
+                            self.holder.resident_bytes_per_shard()):
+                g.set(b)
+
+    def _health_rpc(self, payload: bytes) -> bytes:
+        return msgpack.packb(self._health())
 
     def _health(self) -> dict:
         doc = self.server.health()
@@ -224,6 +251,15 @@ class PsService:
             doc["model_manager_status"] = self.status
         doc["holder_entries"] = len(self.holder)
         doc["shard_parallel"] = self._dispatch.enabled
+        # storage-policy observables: what precision this replica's rows
+        # are stored at and how many data bytes are resident (split so
+        # capacity planning can see the embedding-vs-state share); the
+        # native holder has no byte accounting and reports -1
+        doc["row_dtype"] = getattr(self.holder, "row_dtype", "fp32")
+        doc["resident_bytes"] = getattr(self.holder, "resident_bytes", -1)
+        doc["resident_emb_bytes"] = getattr(
+            self.holder, "resident_emb_bytes", -1)
+        self._refresh_mem_gauges()
         # readiness (distinct from liveness): the sidecar's
         # /healthz?ready=1 returns 503 on False, so supervisors and k8s
         # readiness probes never route traffic to a replica that is
@@ -268,12 +304,32 @@ class PsService:
             # chaos sites: delay == slow shard, die == kill mid-request
             faults.fire("ps.lookup", n=len(signs), dim=meta["dim"])
         out = self._dispatch.lookup(signs, meta["dim"], meta["training"])
+        if meta.get("resp") == "fp16" and self.server._enable_codec:
+            # codec-negotiated client asked for half-precision rows:
+            # the response meta names the encoding, so the client
+            # decodes by what it GOT. The _enable_codec check keeps the
+            # legacy-peer emulation lever honest — a codec-refusing
+            # server answers fp32 on EVERY path, not just the
+            # negotiated ones.
+            from persia_tpu import wire_codec
+
+            return self._pack({"codec": "fp16"},
+                              [wire_codec.encode_fp16_rows(out)])
         # scatter-gather response (default): the (n, dim) result goes
         # to the socket without a tobytes() concatenation copy
         return self._pack({}, [out])
 
     def _update_gradients(self, payload: bytes) -> bytes:
-        meta, (signs, grads) = unpack_arrays(payload)
+        meta, arrays = unpack_arrays(payload)
+        if meta.get("codec") == "int8":
+            # int8 grads + per-row scales (codec-negotiated client;
+            # the fp32 error-feedback residual stays client-side)
+            from persia_tpu import wire_codec
+
+            signs, q, scales = arrays
+            grads = wire_codec.dequantize_int8_rows(q, scales)
+        else:
+            signs, grads = arrays
         if faults._active:
             faults.fire("ps.update", n=len(signs), dim=meta["dim"])
         self._dispatch.update_gradients(signs, grads, meta["dim"])
@@ -427,12 +483,55 @@ class PsClient:
     CB_THRESHOLD = 3
     CB_COOLDOWN = 1.0
 
+    # PERSIA_PS_WIRE_CODEC / wire_codec= values -> (fp16 lookups,
+    # int8 updates). Opt-in: unset/off keeps the fp32 wire
+    # byte-identical to the legacy protocol.
+    _WIRE_CODECS = {
+        "": (False, False), "0": (False, False), "off": (False, False),
+        "fp32": (False, False),
+        "fp16": (True, False),
+        "int8": (False, True),
+        "fp16+int8": (True, True), "full": (True, True),
+    }
+
+    @classmethod
+    def parse_wire_codec(cls, value) -> tuple:
+        """Strict policy parse -> (fp16 lookups, int8 updates). A typo'd
+        PERSIA_PS_WIRE_CODEC must fail LOUDLY everywhere (a silent
+        codec-off is exactly the silent downgrade the native-backend
+        lint exists to prevent)."""
+        try:
+            return cls._WIRE_CODECS[str(value).lower()]
+        except KeyError:
+            raise ValueError(
+                f"unknown wire codec {value!r} (expected one of "
+                f"{sorted(cls._WIRE_CODECS)})") from None
+
     def __init__(self, addr: str, enable_tags: bool = True,
                  legacy_frames: bool = False,
-                 circuit_breaker=None, deadline: Optional[float] = None):
+                 circuit_breaker=None, deadline: Optional[float] = None,
+                 wire_codec: Optional[str] = None):
         self.addr = addr
+        # wire codec policy (None -> PERSIA_PS_WIRE_CODEC env): "fp16"
+        # ships lookup responses as fp16 rows, "fp16+int8" additionally
+        # ships update gradients as int8 + per-row scales with the fp32
+        # error-feedback residual held client-side. Negotiated per
+        # connection (rpc.py __codec__ probe): a legacy server
+        # negotiates down to the fp32 wire transparently, and with the
+        # codec off the wire is byte-identical to the legacy protocol.
+        if wire_codec is None:
+            wire_codec = os.environ.get("PERSIA_PS_WIRE_CODEC", "")
+        self.wire_fp16, self.wire_int8 = self.parse_wire_codec(wire_codec)
         self.client = RpcClient(addr, enable_tags=enable_tags,
-                                deadline=deadline)
+                                deadline=deadline,
+                                enable_codec=self.wire_fp16
+                                or self.wire_int8)
+        if self.wire_int8:
+            from persia_tpu.worker.middleware import GradErrorFeedback
+
+            self._ef = GradErrorFeedback()
+        else:
+            self._ef = None
         # legacy_frames reverts request framing to the concatenating
         # pack_arrays (pre-zero-copy A/B lever; see PsService)
         self._pack = pack_arrays if legacy_frames else pack_arrays_sg
@@ -498,12 +597,50 @@ class PsClient:
             feature_index_prefix_bit=feature_index_prefix_bit,
         ))
 
+    def _lookup_meta(self, dim: int, training: bool) -> dict:
+        meta = {"dim": int(dim), "training": bool(training)}
+        if self.wire_fp16 and self.client.codec_active():
+            meta["resp"] = "fp16"
+        return meta
+
+    @staticmethod
+    def _decode_rows(meta: dict, out: np.ndarray, n: int,
+                     dim: int) -> np.ndarray:
+        """Decode a lookup response by what it SAYS it is (response
+        meta): a legacy server ignores the fp16 request and answers
+        fp32, so the decode must key on the reply, not the ask."""
+        if meta.get("codec") == "fp16":
+            from persia_tpu import wire_codec
+
+            out = wire_codec.decode_fp16_rows(out)
+        return out.reshape(n, dim)
+
+    def _update_payload(self, signs: np.ndarray, grads: np.ndarray,
+                        dim: int):
+        signs = np.ascontiguousarray(signs, np.uint64)
+        grads = np.ascontiguousarray(grads, np.float32)
+        if self.wire_int8 and self.client.codec_active():
+            from persia_tpu import wire_codec
+
+            # error-feedback int8: compensate this shipment with the
+            # signs' stored residuals, quantize per row, store the new
+            # residuals for the next shipment (grads copied — callers'
+            # buffers must not grow feedback noise)
+            g = grads.copy()
+            self._ef.apply(signs, g, dim)
+            q, scales, residual = wire_codec.quantize_int8_rows(g)
+            self._ef.store(signs, residual, dim)
+            return self._pack({"dim": int(dim), "codec": "int8"},
+                              [signs, q, scales])
+        return self._pack({"dim": int(dim)}, [signs, grads])
+
     def lookup(self, signs: np.ndarray, dim: int, training: bool) -> np.ndarray:
-        payload = self._pack({"dim": int(dim), "training": bool(training)},
+        self._check_open()
+        payload = self._pack(self._lookup_meta(dim, training),
                                  [np.ascontiguousarray(signs, np.uint64)])
-        _, (out,) = unpack_arrays(
-            self._guarded(lambda: self.client.call("lookup", payload)))
-        return out.reshape(len(signs), dim)
+        meta, (out,) = unpack_arrays(
+            self._settle(lambda: self.client.call("lookup", payload)))
+        return self._decode_rows(meta, out, len(signs), dim)
 
     def lookup_future(self, signs: np.ndarray, dim: int, training: bool):
         """Issue the lookup without waiting; returns a zero-arg resolver
@@ -514,26 +651,24 @@ class PsClient:
         the resolver's outcome."""
         self._check_open()
         n = len(signs)
-        payload = self._pack({"dim": int(dim), "training": bool(training)},
+        payload = self._pack(self._lookup_meta(dim, training),
                                  [np.ascontiguousarray(signs, np.uint64)])
         fut = self._settle(
             lambda: self.client.call_future("lookup", payload))
 
         def resolve() -> np.ndarray:
-            _, (out,) = unpack_arrays(self._settle(fut.result))
-            return out.reshape(n, dim)
+            meta, (out,) = unpack_arrays(self._settle(fut.result))
+            return self._decode_rows(meta, out, n, dim)
 
         return resolve
 
     def update_gradients(self, signs: np.ndarray, grads: np.ndarray, dim: int):
-        payload = self._pack({"dim": int(dim)}, [
-            np.ascontiguousarray(signs, np.uint64),
-            np.ascontiguousarray(grads, np.float32),
-        ])
+        self._check_open()
+        payload = self._update_payload(signs, grads, dim)
         # non-idempotent: dedup id makes the retry at-most-once server-side
         # (blocking path keeps the client's full retry-with-backoff)
-        self._guarded(lambda: self.client.call("update_gradients", payload,
-                                               dedup=True))
+        self._settle(lambda: self.client.call("update_gradients", payload,
+                                              dedup=True))
 
     def update_gradients_future(self, signs: np.ndarray, grads: np.ndarray,
                                 dim: int):
@@ -541,10 +676,7 @@ class PsClient:
         resolver that raises on failure. Already-aggregated groups ship
         while later ones are still aggregating (worker streaming)."""
         self._check_open()
-        payload = self._pack({"dim": int(dim)}, [
-            np.ascontiguousarray(signs, np.uint64),
-            np.ascontiguousarray(grads, np.float32),
-        ])
+        payload = self._update_payload(signs, grads, dim)
         # non-idempotent: dedup id makes the retry at-most-once server-side
         fut = self._settle(lambda: self.client.call_future(
             "update_gradients", payload, dedup=True))
@@ -553,6 +685,19 @@ class PsClient:
             self._settle(fut.result)
 
         return resolve
+
+    def health(self) -> dict:
+        """The PS replica's health document over RPC (resident bytes,
+        row_dtype, served counts) — what the bench and capacity tooling
+        read without scraping the HTTP sidecar."""
+        return msgpack.unpackb(
+            self._guarded(lambda: self.client.call("health")), raw=False)
+
+    def wire_stats(self) -> dict:
+        """Cumulative payload bytes this client sent/received (rpc.py
+        counters) — the bytes-on-wire accounting ``bench --mode mem``
+        diffs."""
+        return self.client.wire_stats()
 
     def __len__(self) -> int:
         return msgpack.unpackb(
@@ -636,6 +781,15 @@ def main():
     p.add_argument("--addr-file", default=None,
                    help="write the bound address here after listen (with "
                         "--port 0: race-free port handoff to a parent)")
+    p.add_argument("--row-dtype", default=os.environ.get(
+                       "PERSIA_PS_ROW_DTYPE"),
+                   choices=["fp32", "fp16", "bf16"],
+                   help="storage precision of the embedding slice of "
+                        "every row (optimizer state stays fp32); "
+                        "overrides the global config's "
+                        "parameter_server.row_dtype. Python holder only "
+                        "— rejected loudly when the native backend is "
+                        "active (set PERSIA_FORCE_PYTHON_PS=1)")
     from persia_tpu import obs_http
 
     obs_http.add_http_args(p)
@@ -651,10 +805,29 @@ def main():
 
     start_deadlock_detection()
     set_service_name(f"ps{args.replica_index}")
+    if os.environ.get("PERSIA_PS_GC_TUNE", "1") != "0":
+        # A PS replica's store holds millions of gc-tracked objects
+        # (per-entry tuples, dict nodes); CPython's default gen2 cadence
+        # (every ~7k net allocations x 10 x 10) then walks the ENTIRE
+        # store every few seconds of traffic — multi-hundred-ms request
+        # stalls that scale with resident rows. Entries are acyclic
+        # (tuple -> ndarray), so they never need cyclic collection:
+        # freeze the boot state and make full collections ~100x rarer.
+        # PERSIA_PS_GC_TUNE=0 restores the interpreter defaults.
+        # (aliased import: `gc` is this function's GlobalConfig below)
+        import gc as _gcmod
+
+        _gcmod.collect()
+        _gcmod.freeze()
+        _gcmod.set_threshold(50_000, 25, 100)
 
     gc = GlobalConfig.load(args.global_config) if args.global_config else GlobalConfig()
     holder = make_holder(gc.parameter_server.capacity,
-                         gc.parameter_server.num_hashmap_internal_shards)
+                         gc.parameter_server.num_hashmap_internal_shards,
+                         row_dtype=args.row_dtype
+                         or gc.parameter_server.row_dtype,
+                         capacity_bytes=gc.parameter_server.capacity_bytes
+                         or None)
     inc_dumper = None
     if gc.parameter_server.enable_incremental_update:
         from persia_tpu.config import JobType

@@ -140,11 +140,21 @@ class WorkerService:
 
     def _lookup_signs(self, payload: bytes) -> bytes:
         """Dedup'd eval row lookup — the inference hot-row cache's miss
-        fetch (read-only: absent signs zero-fill, nothing is created)."""
+        fetch (read-only: absent signs zero-fill, nothing is created).
+        A client may ask for fp16 rows (``resp`` meta key): the response
+        meta names the encoding, so legacy peers on either side keep the
+        fp32 wire (same self-describing rule as the PS lookup codec)."""
         from persia_tpu.rpc import pack_arrays_sg, unpack_arrays
 
         meta, (signs,) = unpack_arrays(payload)
         rows = self.worker.lookup_signs(signs, meta["dim"])
+        if meta.get("resp") == "fp16" and self.server._enable_codec:
+            # _enable_codec keeps legacy-peer emulation honest (see
+            # PsService._lookup)
+            from persia_tpu import wire_codec
+
+            return pack_arrays_sg({"codec": "fp16"},
+                                  [wire_codec.encode_fp16_rows(rows)])
         return pack_arrays_sg({}, [rows])
 
     def _update_gradients(self, payload: bytes) -> bytes:
@@ -204,6 +214,13 @@ class RemoteEmbeddingWorker:
         self._rr = itertools.cycle(self.addrs)
         self._rr_lock = threading.Lock()
         self.schema = None  # populated lazily for prepare_features parity
+        # the serving tier's miss-fetch hop honors the same wire-codec
+        # policy as the PS hop: fp16 rows when PERSIA_PS_WIRE_CODEC
+        # includes fp16 (self-describing response meta, so any old/new
+        # peer pairing still speaks fp32). Same STRICT parse as
+        # PsClient — a typo'd policy fails loudly, never silently fp32.
+        self._fp16_rows = PsClient.parse_wire_codec(
+            os.environ.get("PERSIA_PS_WIRE_CODEC", ""))[0]
 
     def _next_addr(self) -> str:
         with self._rr_lock:
@@ -242,15 +259,24 @@ class RemoteEmbeddingWorker:
 
     def lookup_signs(self, signs: np.ndarray, dim: int) -> np.ndarray:
         """Serving-tier miss fetch (see EmbeddingWorker.lookup_signs):
-        idempotent read, so no dedup id; round-robin across replicas."""
+        idempotent read, so no dedup id; round-robin across replicas.
+        Rows travel fp16 when the wire-codec policy asks for it (decode
+        keys on the response meta — legacy workers answer fp32)."""
         from persia_tpu.rpc import pack_arrays, unpack_arrays
 
         addr = self._next_addr()
+        meta = {"dim": int(dim)}
+        if self._fp16_rows:
+            meta["resp"] = "fp16"
         resp = self._clients[addr].call(
             "lookup_signs",
-            pack_arrays({"dim": int(dim)},
-                        [np.ascontiguousarray(signs, np.uint64)]))
-        return unpack_arrays(resp)[1][0]
+            pack_arrays(meta, [np.ascontiguousarray(signs, np.uint64)]))
+        rmeta, (rows,) = unpack_arrays(resp)
+        if rmeta.get("codec") == "fp16":
+            from persia_tpu import wire_codec
+
+            rows = wire_codec.decode_fp16_rows(rows)
+        return rows
 
     def lookup_direct_training(self, id_type_features):
         ref = self.put_batch(id_type_features)

@@ -37,6 +37,12 @@ so the legacy serialized behavior is bit-identical):
   written by the serving path (read-only), so it cannot diverge from the
   PS beyond that staleness bound.
 
+The embedding-row wire honors the mixed-precision codec policy
+(``PERSIA_PS_WIRE_CODEC`` / ``--wire-codec``): miss-fetch rows travel
+fp16 on the serving->worker hop and the worker->PS lookups ride the
+negotiated PS codec — roughly half the row bytes per cache miss, with
+the decode keyed on response metadata so any legacy peer keeps fp32.
+
 Serving counters use the reference's ``*_time_cost_sec`` metric style
 and are exported through :mod:`persia_tpu.metrics` (labeled per server
 port) plus a ``stats`` RPC for scrapers and ``bench.py --mode infer``.
@@ -844,11 +850,22 @@ def main(argv=None):
                    help="fail predicts when the embedding tier is "
                         "unreachable instead of serving zero-vector "
                         "embeddings for the affected signs")
+    p.add_argument("--wire-codec", default=None,
+                   choices=["off", "fp16", "fp16+int8"],
+                   help="embedding-row wire precision policy "
+                        "(PERSIA_PS_WIRE_CODEC): the serving tier's "
+                        "miss-fetch hop ships fp16 rows when enabled; "
+                        "legacy peers negotiate down to fp32")
     from persia_tpu import obs_http
 
     obs_http.add_http_args(p)
     args = p.parse_args(argv)
     tracing.set_service_name(f"serving:{args.port}")
+    if args.wire_codec is not None:
+        # the policy env is read by every row-wire client built below
+        # (RemoteEmbeddingWorker's miss-fetch hop, and through the
+        # worker tier, the PS lookup wire)
+        os.environ["PERSIA_PS_WIRE_CODEC"] = args.wire_codec
 
     schema = EmbeddingSchema.load(args.embedding_config)
     model = zoo[args.model]()

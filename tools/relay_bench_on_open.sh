@@ -49,6 +49,12 @@ while [ "$(date +%s)" -lt "$END" ]; do
       #     recovery numbers reflect production-class core counts
       step "bench chaos (fault tolerance)" python bench.py --mode chaos \
         --max-seconds 900
+      # 4d. mixed-precision embedding tier (PR 5): fp32 vs fp16-storage
+      #     vs fp16+int8-wire A/B over real PS subprocesses — wire
+      #     bytes, resident bytes, cycle-time gates; host-only but the
+      #     TPU host's core count derisks the 2-core dev-box numbers
+      step "bench mem (mixed precision)" python bench.py --mode mem \
+        --max-seconds 1100
       # 5. re-capture the headline near the end of the window
       step "re-capture: python bench.py" python bench.py
       echo "$(date -u +%FT%TZ) chip sequence complete — see BENCH_CAPTURE_r05.log" >> "$LOG"
