@@ -862,26 +862,27 @@ def bench_trace(batch_size, steps, n_ps=2, dim=DIM,
         tracing.default_collector().clear()
         with tracing.span("trainer/step", root=True) as root:
             cycle(batch())
-        local = [s.to_dict() for s in tracing.default_collector().recent()]
-        remote = []
+        # multi-process merge through the library (persia_tpu.tracing /
+        # fleet's /fleet/trace use the same path; the raw endpoint's
+        # {"spans": ..., "dropped_total": ...} shape is normalized by
+        # as_span_dicts either way)
+        groups = [tracing.default_collector().recent()]
         for addr in http_addrs:
             with urllib.request.urlopen(
                     f"http://{addr}/trace?n=8192&format=raw",
                     timeout=10) as resp:
-                remote.extend(json.loads(resp.read()))
+                groups.append(json.loads(resp.read()))
         trace_hex = f"{root.trace_id:016x}"
-        merged = [s for s in local + remote if s["trace_id"] == trace_hex]
+        merged = tracing.merge_span_dicts(groups, trace_id=trace_hex)
         with open(trace_out, "w") as f:
             json.dump(tracing.chrome_trace(merged), f)
 
         # validate the acceptance property: one trace_id, resolvable
         # parentage, spans from the driver + worker stages + every PS
-        by_id = {s["span_id"]: s for s in merged}
-        orphans = [s["name"] for s in merged
-                   if s["parent_id"] and s["parent_id"] not in by_id]
-        services = {s["service"] for s in merged}
-        names = {s["name"] for s in merged}
-        assert not orphans, f"unparented spans: {orphans}"
+        v = tracing.validate_span_dicts(merged)
+        services = set(v["services"])
+        names = set(v["names"])
+        assert not v["orphans"], f"unparented spans: {v['orphans']}"
         assert {"worker/preprocess", "worker/rpc",
                 "worker/postprocess"} <= names, names
         assert len([s for s in services if s.startswith("ps")]) == n_ps, \
@@ -972,6 +973,47 @@ def bench_worker_service(batch_size, steps, native_worker, n_ps=2, dim=DIM):
     return steps * batch_size / elapsed
 
 
+def _validate_postmortem(bundle_dir):
+    """Acceptance checks on a crash postmortem bundle: a VALID Chrome
+    trace (at least one intact parent->child chain on one trace_id, no
+    orphan parents — remote parents were promoted at capture), the
+    final health doc, and a parseable last metrics snapshot. Returns a
+    summary dict; raises on violation."""
+    from persia_tpu.metrics import parse_exposition
+
+    with open(os.path.join(bundle_dir, "trace.json")) as f:
+        trace = json.load(f)
+    xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    if not xs:
+        raise AssertionError(f"postmortem trace in {bundle_dir} is empty")
+    ids = {e["args"]["span_id"] for e in xs}
+    orphans = [e["name"] for e in xs
+               if e["args"].get("parent_id")
+               and e["args"]["parent_id"] not in ids]
+    if orphans:
+        raise AssertionError(f"postmortem trace has orphan parents: "
+                             f"{orphans}")
+    children = [e for e in xs if e["args"].get("parent_id")]
+    if not children:
+        raise AssertionError("postmortem trace has no parent->child "
+                             "chain (flat spans only)")
+    tid = children[0]["args"]["trace_id"]
+    chain = [e for e in xs if e["args"]["trace_id"] == tid]
+    if len(chain) < 2:
+        raise AssertionError(f"trace_id {tid} is not a chain")
+    with open(os.path.join(bundle_dir, "health.json")) as f:
+        health = json.load(f)
+    if "model_manager_status" not in health:
+        raise AssertionError(f"final health doc incomplete: {health}")
+    with open(os.path.join(bundle_dir, "metrics.prom")) as f:
+        samples, families = parse_exposition(f.read())
+    if not samples:
+        raise AssertionError("last metrics snapshot is empty")
+    return {"spans": len(xs), "chain_trace_id": tid,
+            "chain_len": len(chain), "metric_samples": len(samples),
+            "health_status": health.get("model_manager_status")}
+
+
 def bench_chaos(batch_size, steps, n_ps=2, dim=8, kill_replica=1,
                 staleness=4):
     """Fault-tolerance bench: a REAL training loop (ForwardEngine +
@@ -989,6 +1031,13 @@ def bench_chaos(batch_size, steps, n_ps=2, dim=8, kill_replica=1,
     checkpoint + incremental packets of the killed replica must read
     back EXACTLY from the restored store (phase-2 training uses a
     disjoint sign range, so the phase-1 rows are immutable witnesses).
+
+    The run traces its traffic (PERSIA_TRACING=1 across every tier) and
+    arms the supervisor's flight recorder: the SIGKILLed replica must
+    leave a postmortem bundle behind, and the bundle must contain a
+    valid Chrome trace (one intact trace chain, no orphan parents), the
+    final health doc, and the last metrics snapshot — hard-failed via
+    ``_validate_postmortem``.
     """
     import tempfile
     import threading
@@ -1033,10 +1082,19 @@ def bench_chaos(batch_size, steps, n_ps=2, dim=8, kill_replica=1,
     kill_at = 3
     t_kill = [0.0]
     result = {}
+    postmortem_dir = os.path.join(tmp, "postmortems")
+    from persia_tpu import tracing as _tracing
+
+    # trace every tier so the killed replica's flight ring holds real
+    # rpc/lookup -> ps/lookup chains for the postmortem trace; enabled
+    # BEFORE any client dials (the __trace__ probe is per-connection)
+    _tracing.enable_tracing(True)
     with ServiceCtx(schema, n_workers=1, n_ps=n_ps,
                     global_config_path=gc_path, supervise_ps=True,
                     ps_restore_dir=ckpt_dir, ps_inc_dir=inc_dir,
-                    ps_probe_interval=0.25) as svc:
+                    ps_probe_interval=0.25,
+                    postmortem_dir=postmortem_dir, flight_interval=0.4,
+                    env={"PERSIA_TRACING": "1"}) as svc:
         w = svc.remote_worker()
         w.configure_parameter_servers(
             "bounded_uniform", {"lower": -0.01, "upper": 0.01}, 1.0, 10.0)
@@ -1112,6 +1170,18 @@ def bench_chaos(batch_size, steps, n_ps=2, dim=8, kill_replica=1,
             got = client.get_entry(sign)
             if got is None or not np.array_equal(got[1][:len(vec)], vec):
                 mismatches += 1
+        # postmortem flight bundle of the killed replica: captured by
+        # the supervisor from its last /flight snapshot before respawn
+        bundle = ev.get("postmortem")
+        if not bundle or not os.path.isdir(bundle):
+            raise RuntimeError(
+                f"no postmortem bundle for killed ps-{kill_replica} "
+                f"(event: {ev})")
+        pm = _validate_postmortem(bundle)
+        log(f"chaos: postmortem bundle {bundle} — {pm['spans']} spans, "
+            f"chain x{pm['chain_len']} on trace {pm['chain_trace_id']}, "
+            f"{pm['metric_samples']} metric samples, health "
+            f"{pm['health_status']}")
         result = {
             "detection_sec": round(detection_sec, 3),
             "recovery_sec": round(recovery_sec, 3),
@@ -1122,7 +1192,10 @@ def bench_chaos(batch_size, steps, n_ps=2, dim=8, kill_replica=1,
             "parity_mismatches": mismatches,
             "phase2_loop_sec": round(loop_sec, 2),
             "restarts": len(events),
+            "postmortem_bundle": bundle,
+            "postmortem": pm,
         }
+    _tracing.enable_tracing(False)
     log(f"chaos: detection {result['detection_sec'] * 1e3:.0f} ms, "
         f"recovery {result['recovery_sec']:.2f} s, "
         f"lost_updates={result['lost_updates']}, "
@@ -1138,6 +1211,287 @@ def bench_chaos(batch_size, steps, n_ps=2, dim=8, kill_replica=1,
             f"{result['staleness_permits_leaked']} staleness permits "
             f"leaked across the kill/recovery cycle")
     return result["kill_to_recovered_sec"], result
+
+
+def bench_fleet(batch_size, steps, n_ps=2, dim=DIM, scrape_interval=0.75,
+                scrape_timeout=0.5):
+    """Fleet-control-plane bench over a REAL worker + PS-subprocess
+    stack (every process carrying its observability sidecar):
+
+    1. **Wire neutrality** (hard gate): the fleet scraper is pull-only —
+       attaching it adds ZERO requests on the RPC plane, pinned via the
+       PS served-request counters over a scrape-only window.
+    2. **Cycle inflation** (hard gate <= 3%): steady-state worker cycle
+       with the fleet scraper attached vs detached, paired interleaved
+       rounds (BASELINE.md round-8 methodology), median of per-round
+       ratios; a second full set re-measures before failing (noise only
+       ever adds time).
+    3. **Breach detection** (hard gate): SIGSTOP one PS replica
+       (sidecar keeps accepting, answers nothing — the wedged-replica
+       shape) and measure injected-fault -> ``target_down`` SLO firing;
+       must trip within 2 scrape intervals. The breach must also leave
+       a postmortem flight bundle.
+    4. Federated views sanity: /fleet/metrics parses as one exposition
+       with service/replica labels, /fleet/status sees every target up
+       with uniform versions, /fleet/trace merges a traced cycle across
+       the trainer + both PS processes on one trace_id.
+    """
+    import signal
+    import statistics
+    import tempfile
+
+    from persia_tpu import tracing
+    from persia_tpu.config import EmbeddingSchema, SlotConfig
+    from persia_tpu.data.batch import IDTypeFeatureWithSingleID
+    from persia_tpu.fleet import FleetMonitor
+    from persia_tpu.metrics import parse_exposition
+    from persia_tpu.obs_http import ObservabilityServer
+    from persia_tpu.slos import SloEngine, default_rules
+
+    INFLATION_GATE = 1.03
+    dims = (dim // 2, dim, 2 * dim, 4 * dim)
+    schema = EmbeddingSchema(slots_config={
+        f"slot_{s}": SlotConfig(name=f"slot_{s}", dim=dims[s % len(dims)])
+        for s in range(NUM_SLOTS)
+    })
+    rng = np.random.default_rng(0)
+
+    def batch():
+        return [
+            IDTypeFeatureWithSingleID(
+                f"slot_{s}",
+                rng.integers(0, 1 << 40, size=batch_size,
+                             dtype=np.uint64))
+            for s in range(NUM_SLOTS)
+        ]
+
+    tracing.set_service_name("trainer")
+    # PS replicas run PERSIA_TRACING=1 but the driver dials untraced
+    # for the A/B (span sites no-op without a propagated context), so
+    # the inflation number isolates the SCRAPER, not tracing
+    worker, (clients, procs, http_addrs) = _worker_rpc_stack(
+        schema, n_ps, overlapped=True,
+        extra_env={"PERSIA_TRACING": "1"}, collect_http=True)
+    sidecar = ObservabilityServer(service="trainer").start()
+    pm_dir = tempfile.mkdtemp(prefix="persia_fleet_pm_")
+    targets = [{"service": f"ps{i}", "http_addr": a, "role": "ps",
+                "replica": i} for i, a in enumerate(http_addrs)]
+    targets.append({"service": "trainer", "http_addr": sidecar.addr,
+                    "role": "trainer", "replica": 0})
+    monitor = FleetMonitor(
+        targets=targets, scrape_interval=scrape_interval,
+        scrape_timeout=scrape_timeout,
+        # flight snapshots (the heavy fetch: spans ride along) on a
+        # slower cadence than the metrics scrape, like a deployment
+        flight_interval=scrape_interval * 4,
+        # interval-paced from the first scrape, so the paired A/B's
+        # on-blocks carry exactly the production scrape duty cycle
+        first_scrape_delay=scrape_interval,
+        slo_engine=SloEngine(default_rules()),
+        postmortem_dir=pm_dir)
+
+    def cycle(b):
+        ref = worker.put_batch(b)
+        lk = worker.lookup(ref)
+        worker.update_gradients(
+            ref, {k: v.embeddings for k, v in lk.items()})
+
+    detail = {}
+    try:
+        for _ in range(3):
+            cycle(batch())
+        hot = batch()
+        cycle(hot)
+
+        # --- 1. wire neutrality: a scrape-only window adds no RPCs ---
+        served0 = [c.health()["served_rpcs"] for c in clients]
+        monitor.start()
+        deadline = time.monotonic() + max(scrape_interval * 5, 4.0)
+        while monitor.rounds < 2 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        monitor.stop()
+        if monitor.rounds < 1:
+            raise RuntimeError("fleet monitor never completed a scrape")
+        served1 = [c.health()["served_rpcs"] for c in clients]
+        # exactly ONE rpc per replica in the window: our own served0
+        # health read (the counter increments after the handler builds
+        # its response, so each read reports the count before itself)
+        extra_rpcs = [b - a - 1 for a, b in zip(served0, served1)]
+        if any(extra_rpcs):
+            raise AssertionError(
+                f"fleet scraping put {extra_rpcs} extra requests on the "
+                f"RPC plane — scrape must be pull-only HTTP")
+        log(f"fleet: wire neutrality OK — {monitor.rounds} scrape "
+            f"rounds, 0 extra RPCs on {n_ps} replicas")
+        detail["scrape_rounds_neutrality_window"] = monitor.rounds
+
+        # --- 2. paired interleaved cycle inflation A/B ---
+        # Block length matters: the scraper fires every scrape_interval
+        # regardless of how fast cycles run, so a block must span
+        # SEVERAL intervals for the measured cycles to carry the same
+        # scrape duty cycle production cycles would. Timing 2 cycles
+        # right after monitor.start() (which scrapes immediately) would
+        # charge one whole scrape round to ~100 ms of work — a duty
+        # cycle no deployment has.
+        t0 = time.perf_counter()
+        for _ in range(3):
+            cycle(hot)
+        est_cycle = (time.perf_counter() - t0) / 3
+        block_steps = max(4, int(2.5 * scrape_interval / est_cycle))
+
+        def measure_inflation(rounds):
+            per_round = {"off": [], "on": []}
+            ratios = []
+            for r in range(rounds):
+                times = {}
+                for phase in (("off", "on") if r % 2 == 0
+                              else ("on", "off")):
+                    if phase == "on":
+                        monitor.start()
+                    t0 = time.perf_counter()
+                    for _ in range(block_steps):
+                        cycle(hot)
+                    times[phase] = ((time.perf_counter() - t0)
+                                    / block_steps)
+                    if phase == "on":
+                        monitor.stop()
+                    per_round[phase].append(times[phase])
+                ratios.append(times["on"] / times["off"])
+            return (statistics.median(ratios),
+                    statistics.median(per_round["off"]) * 1e3,
+                    statistics.median(per_round["on"]) * 1e3)
+
+        rounds = max(4, steps // 4)
+        ratio, off_ms, on_ms = measure_inflation(rounds)
+        if ratio > INFLATION_GATE:
+            # one full re-measure before failing: environment noise
+            # only ever adds time, so the minimum is the estimate
+            ratio2, off2, on2 = measure_inflation(rounds)
+            if ratio2 < ratio:
+                ratio, off_ms, on_ms = ratio2, off2, on2
+        inflation_pct = (ratio - 1.0) * 100.0
+        log(f"fleet: steady worker cycle {off_ms:.1f} ms/batch scraper "
+            f"detached, {on_ms:.1f} ms/batch attached "
+            f"({inflation_pct:+.2f}% median of {rounds} paired "
+            f"interleaved rounds)")
+        detail["cycle_ms_scraper_off"] = round(off_ms, 3)
+        detail["cycle_ms_scraper_on"] = round(on_ms, 3)
+        detail["inflation_pct"] = round(inflation_pct, 3)
+        if ratio > INFLATION_GATE:
+            raise AssertionError(
+                f"fleet scraper inflates the steady worker cycle "
+                f"{ratio:.4f}x > {INFLATION_GATE}x gate")
+
+        # --- 3. injected fault -> SLO breach latency ---
+        r0 = monitor.rounds
+        monitor.start()
+        deadline = time.monotonic() + max(scrape_interval * 4, 3.0)
+        while monitor.rounds == r0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        stall = procs[-1]
+        victim = f"ps{n_ps - 1}"
+        n_breach0 = len(monitor.engine.breach_events())
+        t_fault = time.monotonic()
+        stall.send_signal(signal.SIGSTOP)
+        try:
+            breach = None
+            deadline = time.monotonic() + scrape_interval * 2 + \
+                scrape_timeout * 3 + 5
+            while time.monotonic() < deadline and breach is None:
+                for ev in monitor.engine.breach_events()[n_breach0:]:
+                    if (ev["rule"] == "target_down"
+                            and ev["service"] == victim):
+                        breach = ev
+                        break
+                time.sleep(0.02)
+        finally:
+            stall.send_signal(signal.SIGCONT)
+        monitor.stop()
+        if breach is None:
+            raise AssertionError(
+                f"SIGSTOPped {victim} never tripped target_down "
+                f"(breaches: {monitor.engine.breach_events()})")
+        latency = breach["t"] - t_fault
+        budget = 2 * scrape_interval
+        log(f"fleet: SIGSTOP {victim} -> target_down SLO fired in "
+            f"{latency:.2f}s (budget {budget:.2f}s = 2 scrape "
+            f"intervals)")
+        detail["breach_detect_sec"] = round(latency, 3)
+        detail["breach_budget_sec"] = budget
+        if latency > budget:
+            raise AssertionError(
+                f"breach detection took {latency:.2f}s > "
+                f"{budget:.2f}s (2 scrape intervals)")
+        bundles = [p for p in monitor.recorder.captures if victim in p]
+        if not bundles:
+            raise AssertionError(
+                f"SLO breach on {victim} produced no postmortem bundle")
+        detail["breach_postmortem"] = bundles[-1]
+
+        # let the victim recover, then scrape it back up
+        deadline = time.monotonic() + 10
+        monitor.start()
+        while time.monotonic() < deadline:
+            st = monitor.fleet_status()
+            if st["n_up"] == len(targets):
+                break
+            time.sleep(0.1)
+        monitor.stop()
+
+        # --- 4. federated views ---
+        n_scraped = monitor.scrape_once()
+        if n_scraped != len(targets):
+            raise AssertionError(
+                f"only {n_scraped}/{len(targets)} targets scraped up "
+                f"after recovery")
+        text = monitor.fleet_metrics()
+        samples, families = parse_exposition(text)
+        svc_labels = {l.get("service") for _n, l, _v in samples
+                      if "service" in l}
+        assert {f"ps{i}" for i in range(n_ps)} <= svc_labels, svc_labels
+        status = monitor.fleet_status()
+        assert not status["version_skew"], status
+        detail["federated_series"] = len(samples)
+        detail["topology"] = {t["service"]: t["version"]
+                              for t in status["targets"]}
+
+        # traced cycle -> /fleet/trace merge on one trace_id
+        tracing.enable_tracing(True)
+        for c in clients:
+            c.client.close()  # redial with the __trace__ probe
+        cycle(batch())  # untimed: renegotiates every pooled connection
+        tracing.default_collector().clear()
+        with tracing.span("trainer/step", root=True) as root:
+            cycle(batch())
+        tracing.enable_tracing(False)
+        monitor.scrape_once()
+        trace_doc = monitor.fleet_trace(
+            trace_id=f"{root.trace_id:016x}", fmt="raw")
+        span_services = {s["service"] for s in trace_doc["spans"]}
+        assert len([s for s in span_services
+                    if s.startswith("ps")]) == n_ps, span_services
+        log(f"fleet: /fleet/trace merged {len(trace_doc['spans'])} "
+            f"spans from {sorted(span_services)} on one trace_id; "
+            f"federation carries {len(samples)} series from "
+            f"{len(targets)} targets")
+        detail["fleet_trace_spans"] = len(trace_doc["spans"])
+        return inflation_pct, detail
+    finally:
+        tracing.enable_tracing(False)
+        monitor.stop()
+        sidecar.stop()
+        worker.close()
+        for c in clients:
+            c.shutdown()
+        for p in procs:
+            try:
+                p.send_signal(signal.SIGCONT)  # harmless if running
+            except OSError:
+                pass
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                p.kill()
 
 
 def make_infer_requests(num, rows, n_slots, num_dense, vocab=1 << 18,
@@ -2042,7 +2396,8 @@ def main():
     p.add_argument("--mode",
                    choices=["hybrid", "device", "cached", "attn", "wire",
                             "worker", "worker-svc", "store", "roofline",
-                            "infer", "rpc", "trace", "chaos", "mem"],
+                            "infer", "rpc", "trace", "chaos", "mem",
+                            "fleet"],
                    default="device")
     p.add_argument("--trace-out", default="/tmp/persia_trace_capture.json",
                    help="trace mode: exported Chrome-trace JSON path")
@@ -2076,6 +2431,7 @@ def main():
         "trace": ("trace_overhead_pct", "percent"),
         "chaos": ("chaos_ps_kill_to_recovered_sec", "sec"),
         "mem": ("mem_wire_bytes_reduction_x", "x"),
+        "fleet": ("fleet_scrape_cycle_inflation_pct", "percent"),
     }[args.mode]
 
     # Shared two-tier watchdog (persia_tpu.utils.arm_watchdog — the same
@@ -2095,7 +2451,8 @@ def main():
         args.batch_size, args.steps, args.warmup = 256, 3, 1
 
     if args.mode not in ("wire", "worker", "worker-svc", "store", "rpc",
-                         "trace", "chaos", "mem"):  # host-only modes skip jax
+                         "trace", "chaos", "mem",
+                         "fleet"):  # host-only modes skip jax
         # local verification escape hatch (nn_worker.py honors the same
         # variable); plain JAX_PLATFORMS=cpu also counts — the axon
         # platform plugin re-pins jax.config via sitecustomize, so the
@@ -2158,6 +2515,15 @@ def main():
         # leaked permits, parity-exact restore) are enforced inside —
         # reaching here means they held
         vs_baseline = 1.0
+        extra["detail"] = detail
+    elif args.mode == "fleet":
+        value, detail = bench_fleet(
+            min(args.batch_size, 512) if args.smoke else args.batch_size,
+            max(args.steps, 5))
+        # the hard gates (wire neutrality, <= 3% inflation, breach
+        # detection within 2 scrape intervals, postmortem produced)
+        # fail inside bench_fleet; vs_baseline = inflation headroom
+        vs_baseline = value / 3.0
         extra["detail"] = detail
     elif args.mode == "trace":
         value, detail = bench_trace(args.batch_size, max(args.steps, 5),

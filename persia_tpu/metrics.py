@@ -157,10 +157,11 @@ class MetricsRegistry:
         self.const_labels = const_labels or {}
         self._metrics: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], object] = {}
         self._kinds: Dict[str, str] = {}
+        self._help: Dict[str, str] = {}
         self._lock = threading.Lock()
 
     def _get(self, kind: str, name: str, labels: Optional[Dict[str, str]],
-             factory):
+             factory, help_text: Optional[str] = None):
         key = (name, tuple(sorted((labels or {}).items())))
         with self._lock:
             existing = self._kinds.setdefault(name, kind)
@@ -168,30 +169,47 @@ class MetricsRegistry:
                 raise ValueError(
                     f"metric {name!r} already registered as {existing}"
                 )
+            if help_text:
+                self._help.setdefault(name, help_text)
             if key not in self._metrics:
                 self._metrics[key] = factory()
             return self._metrics[key]
 
-    def counter(self, name: str, labels: Optional[Dict[str, str]] = None) -> Counter:
-        return self._get("counter", name, labels, Counter)
+    def counter(self, name: str, labels: Optional[Dict[str, str]] = None,
+                help_text: Optional[str] = None) -> Counter:
+        return self._get("counter", name, labels, Counter, help_text)
 
-    def gauge(self, name: str, labels: Optional[Dict[str, str]] = None) -> Gauge:
-        return self._get("gauge", name, labels, Gauge)
+    def gauge(self, name: str, labels: Optional[Dict[str, str]] = None,
+              help_text: Optional[str] = None) -> Gauge:
+        return self._get("gauge", name, labels, Gauge, help_text)
 
-    def histogram(self, name: str, labels: Optional[Dict[str, str]] = None) -> Histogram:
-        return self._get("histogram", name, labels, Histogram)
+    def histogram(self, name: str, labels: Optional[Dict[str, str]] = None,
+                  help_text: Optional[str] = None) -> Histogram:
+        return self._get("histogram", name, labels, Histogram, help_text)
 
     def render(self) -> str:
-        """Prometheus text exposition format. Histogram series are read
-        through ``snapshot_full()`` so a concurrent ``observe`` cannot
-        tear a bucket/count pair mid-render."""
+        """Prometheus text exposition format, with ``# TYPE`` (and
+        ``# HELP`` where registered) comment lines per metric family so
+        standard parsers (promtool, the fleet federation layer) accept
+        the output without heuristics. Histogram series are read through
+        ``snapshot_full()`` so a concurrent ``observe`` cannot tear a
+        bucket/count pair mid-render."""
         lines: List[str] = []
         with self._lock:
             items = sorted(self._metrics.items())
             kinds = dict(self._kinds)
+            helps = dict(self._help)
+        last_family = None
         for (name, labels), metric in items:
             all_labels = {**self.const_labels, **dict(labels)}
             kind = kinds[name]
+            if name != last_family:
+                # family header once, before the family's first series
+                if name in helps:
+                    lines.append(f"# HELP {name} "
+                                 + _escape_help(helps[name]))
+                lines.append(f"# TYPE {name} {kind}")
+                last_family = name
             if kind == "histogram":
                 assert isinstance(metric, Histogram)
                 counts, hsum, total = metric.snapshot_full()
@@ -241,6 +259,89 @@ class MetricsRegistry:
         t = threading.Thread(target=run, daemon=True, name="metrics-pusher")
         t.start()
         return t, stop
+
+
+def _escape_help(v: str) -> str:
+    """HELP text escaping (backslash and line feed; quotes are legal)."""
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def parse_exposition(text: str):
+    """Parse Prometheus text exposition back into samples — the inverse
+    of :meth:`MetricsRegistry.render`, used by the fleet federation
+    layer and the SLO engine (and by the parse-back tests that pin the
+    exposition's validity).
+
+    Returns ``(samples, families)``: ``samples`` is a list of
+    ``(name, labels_dict, value)`` tuples in document order;
+    ``families`` maps metric family name -> ``{"type": ..., "help":
+    ...}`` (missing keys omitted). Unparseable lines raise ValueError —
+    a scraper that wants to tolerate garbage catches it at the call
+    site and marks the target down."""
+    samples = []
+    families: Dict[str, Dict[str, str]] = {}
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                families.setdefault(parts[2], {})["type"] = parts[3]
+            elif len(parts) >= 3 and parts[1] == "HELP":
+                families.setdefault(parts[2], {})["help"] = (
+                    parts[3] if len(parts) > 3 else "")
+            continue
+        name, labels, value = _parse_sample_line(line)
+        samples.append((name, labels, value))
+    return samples, families
+
+
+def _parse_sample_line(line: str):
+    """One sample line: ``name[{k="v",...}] value [timestamp]`` with
+    the text-format label-value escapes (\\\\ \\" \\n) honored."""
+    brace = line.find("{")
+    sp = line.find(" ")
+    if brace != -1 and (sp == -1 or brace < sp):
+        name = line[:brace]
+        labels: Dict[str, str] = {}
+        i = brace + 1
+        while i < len(line) and line[i] != "}":
+            eq = line.index("=", i)
+            key = line[i:eq].strip().lstrip(",").strip()
+            if line[eq + 1] != '"':
+                raise ValueError(f"unquoted label value in {line!r}")
+            j = eq + 2
+            buf = []
+            while True:
+                c = line[j]
+                if c == "\\":
+                    nxt = line[j + 1]
+                    buf.append({"n": "\n", '"': '"', "\\": "\\"}
+                               .get(nxt, "\\" + nxt))
+                    j += 2
+                elif c == '"':
+                    j += 1
+                    break
+                else:
+                    buf.append(c)
+                    j += 1
+            labels[key] = "".join(buf)
+            i = j
+        rest = line[i + 1:].strip()
+    else:
+        if sp == -1:
+            raise ValueError(f"no value on sample line {line!r}")
+        name, rest = line[:sp], line[sp + 1:].strip()
+        labels = {}
+    value_str = rest.split()[0]
+    if value_str == "+Inf":
+        value = float("inf")
+    elif value_str == "-Inf":
+        value = float("-inf")
+    else:
+        value = float(value_str)
+    return name, labels, value
 
 
 def _escape_label_value(v) -> str:

@@ -23,11 +23,26 @@ slow batch can be followed across tiers without restarting anything:
 - ``GET /trace?n=K[&format=chrome|raw]`` — the most recent K spans from
   the process-local trace collector. ``chrome`` (default) is a
   Chrome-trace/Perfetto ``traceEvents`` JSON ready to load as-is;
-  ``raw`` is the span-dict list ``bench.py --mode trace`` scrapes to
-  merge multi-process captures into one timeline.
+  ``raw`` is ``{"spans": [...], "dropped_total": N}`` — the span-dict
+  window the fleet monitor and ``bench.py --mode trace`` merge into one
+  multi-process timeline, with the ring's eviction count so a consumer
+  knows whether the window is complete.
+- ``GET /flight`` — the flight-recorder snapshot: ONE JSON document
+  bundling the health doc, the current metrics exposition, the recent
+  span window, the armed fault rules, and the PERSIA_* environment.
+  Supervisors poll it cheaply and keep the last copy, so when this
+  process dies (SIGKILL keeps no last words) the postmortem bundle
+  still has the final observable state.
 
 Dependency-free (http.server), daemon-threaded, bound to an ephemeral
 port by default so test stacks never collide.
+
+Fault-injection site ``obs.http`` (:mod:`persia_tpu.faults`, kwarg
+``path=`` filters per endpoint): ``delay`` stalls a response (a hung
+sidecar), ``drop`` swallows the request (reply never comes), ``corrupt``
+returns garbage bytes, ``error`` answers 500 — the scrape-resilience
+tests and the fleet bench arm these to prove a bad target cannot wedge
+the scrape loop.
 """
 
 import json
@@ -38,7 +53,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
 from urllib.parse import parse_qs, urlparse
 
+from persia_tpu import faults
 from persia_tpu.logger import get_default_logger
+from persia_tpu.version import __version__
 
 _logger = get_default_logger(__name__)
 
@@ -86,6 +103,22 @@ class ObservabilityServer:
                 status = 200
                 try:
                     url = urlparse(self.path)
+                    if faults._active:
+                        # chaos sites for scrape-resilience testing:
+                        # delay = hung sidecar, drop = request swallowed
+                        # (peer read times out), corrupt = garbage body,
+                        # error -> 500 below, die = process exit
+                        action = faults.fire("obs.http", path=url.path)
+                        if action == "drop":
+                            return  # no response; scraper must time out
+                        if action == "corrupt":
+                            body = b"\x00garbage not exposition\xff"
+                            self.send_response(200)
+                            self.send_header("Content-Length",
+                                             str(len(body)))
+                            self.end_headers()
+                            self.wfile.write(body)
+                            return
                     if url.path == "/metrics":
                         if sidecar.refresh_fn is not None:
                             try:
@@ -112,6 +145,9 @@ class ObservabilityServer:
                         fmt = q.get("format", ["chrome"])[0]
                         body = sidecar._trace(n, fmt).encode()
                         ctype = "application/json"
+                    elif url.path == "/flight":
+                        body = json.dumps(sidecar._flight()).encode()
+                        ctype = "application/json"
                     else:
                         self.send_error(404, "unknown path")
                         return
@@ -134,6 +170,9 @@ class ObservabilityServer:
             "status": "ok",
             "service": self.service,
             "pid": os.getpid(),
+            # version lets the fleet topology view spot replica skew
+            # (a half-finished rollout mixes versions silently otherwise)
+            "version": __version__,
             "uptime_sec": round(time.monotonic() - self._t0, 3),
         }
         if self.health_fn is not None:
@@ -146,11 +185,43 @@ class ObservabilityServer:
 
     def _trace(self, n: int, fmt: str) -> str:
         spans = self.collector.recent(n)
+        dropped = self.collector.dropped_total
         if fmt == "raw":
-            return json.dumps([s.to_dict() for s in spans])
+            return json.dumps({"spans": [s.to_dict() for s in spans],
+                               "dropped_total": dropped})
         from persia_tpu.tracing import chrome_trace
 
-        return json.dumps(chrome_trace(spans))
+        doc = chrome_trace(spans)
+        doc["otherData"] = {"spans_dropped_total": dropped}
+        return json.dumps(doc)
+
+    FLIGHT_SPANS = 2048
+    _FLIGHT_ENV_PREFIXES = ("PERSIA_", "REPLICA_", "JAX_")
+
+    def _flight(self) -> Dict:
+        """Flight-recorder snapshot: everything a postmortem needs, in
+        one GET (supervisors poll this; a crashed process cannot be
+        asked afterwards). Refreshes pull-style gauges like /metrics
+        does, so the captured exposition is current."""
+        if self.refresh_fn is not None:
+            try:
+                self.refresh_fn()
+            except Exception:
+                pass
+        return {
+            "t_wall": time.time(),
+            "service": self.service,
+            "pid": os.getpid(),
+            "version": __version__,
+            "health": self._health(),
+            "metrics": self.registry.render(),
+            "spans": [s.to_dict()
+                      for s in self.collector.recent(self.FLIGHT_SPANS)],
+            "spans_dropped_total": self.collector.dropped_total,
+            "faults": faults.default_injector().rules(),
+            "env": {k: v for k, v in os.environ.items()
+                    if k.startswith(self._FLIGHT_ENV_PREFIXES)},
+        }
 
     def start(self):
         self._thread = threading.Thread(

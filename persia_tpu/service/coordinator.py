@@ -13,7 +13,7 @@ Run: ``python -m persia_tpu.service.coordinator --port 23333``
 import argparse
 import threading
 import time
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import msgpack
 
@@ -26,6 +26,7 @@ ROLE_PS = "embedding-parameter-server"
 ROLE_WORKER = "embedding-worker"
 ROLE_TRAINER = "nn-worker"
 ROLE_DATALOADER = "data-loader"
+ROLE_INFERENCE = "inference-server"
 
 
 class Coordinator:
@@ -33,11 +34,15 @@ class Coordinator:
         self._lock = threading.Lock()
         # role -> {replica_index: addr}
         self._services: Dict[str, Dict[int, str]] = {}
+        # role -> {replica_index: observability sidecar addr} (optional
+        # field of register; the fleet monitor's discovery channel)
+        self._http: Dict[str, Dict[int, str]] = {}
         self._kv: Dict[str, bytes] = {}
         self.server = RpcServer(host, port)
         self.server.register("register", self._register)
         self.server.register("deregister", self._deregister)
         self.server.register("list", self._list)
+        self.server.register("topology", self._topology)
         self.server.register("kv_put", self._kv_put)
         self.server.register("kv_get", self._kv_get)
         self.server.register("ping", lambda p: b"pong")
@@ -52,14 +57,25 @@ class Coordinator:
             self._services.setdefault(req["role"], {})[req["replica_index"]] = (
                 req["addr"]
             )
-        _logger.info("registered %s[%d] at %s", req["role"],
-                     req["replica_index"], req["addr"])
+            if req.get("http_addr"):
+                self._http.setdefault(
+                    req["role"], {})[req["replica_index"]] = req["http_addr"]
+            else:
+                # re-registration WITHOUT a sidecar (restarted with the
+                # sidecar off, or an older binary mid-rollout) must not
+                # leave the dead previous sidecar address in topology
+                self._http.get(req["role"], {}).pop(
+                    req["replica_index"], None)
+        _logger.info("registered %s[%d] at %s (sidecar %s)", req["role"],
+                     req["replica_index"], req["addr"],
+                     req.get("http_addr") or "none")
         return b""
 
     def _deregister(self, payload: bytes) -> bytes:
         req = msgpack.unpackb(payload, raw=False)
         with self._lock:
             self._services.get(req["role"], {}).pop(req["replica_index"], None)
+            self._http.get(req["role"], {}).pop(req["replica_index"], None)
         return b""
 
     def _list(self, payload: bytes) -> bytes:
@@ -68,6 +84,19 @@ class Coordinator:
             members = self._services.get(req["role"], {})
             addrs = [members[i] for i in sorted(members)]
         return msgpack.packb({"addrs": addrs}, use_bin_type=True)
+
+    def _topology(self, payload: bytes) -> bytes:
+        """The fleet monitor's discovery read: every registered service
+        with its replica index, RPC address, and (when the service
+        published one) observability sidecar address."""
+        with self._lock:
+            members = [
+                {"role": role, "replica": i, "addr": addr,
+                 "http_addr": self._http.get(role, {}).get(i)}
+                for role, reps in sorted(self._services.items())
+                for i, addr in sorted(reps.items())
+            ]
+        return msgpack.packb({"members": members}, use_bin_type=True)
 
     def _kv_put(self, payload: bytes) -> bytes:
         req = msgpack.unpackb(payload, raw=False)
@@ -89,9 +118,20 @@ class CoordinatorClient:
     def __init__(self, addr: str):
         self.client = RpcClient(addr)
 
-    def register(self, role: str, replica_index: int, addr: str):
+    def register(self, role: str, replica_index: int, addr: str,
+                 http_addr: Optional[str] = None):
+        # http_addr (the observability sidecar) is an optional extra
+        # field: an old coordinator ignores unknown keys, so mixed
+        # versions keep registering fine — the fleet view just lacks
+        # the sidecar address for that replica
         self.client.call_msg("register", role=role,
-                             replica_index=replica_index, addr=addr)
+                             replica_index=replica_index, addr=addr,
+                             http_addr=http_addr)
+
+    def topology(self):
+        """Full service topology incl. sidecar addresses (fleet
+        discovery). Raises RpcError against a pre-fleet coordinator."""
+        return self.client.call_msg("topology")["members"]
 
     def deregister(self, role: str, replica_index: int):
         self.client.call_msg("deregister", role=role,

@@ -100,6 +100,9 @@ class ServiceCtx:
         ps_probe_interval: float = 0.5,
         ps_probe_failures: int = 4,
         ps_max_restarts: int = 5,
+        postmortem_dir: Optional[str] = None,
+        flight_interval: float = 1.0,
+        http_all: bool = False,
     ):
         self.schema = schema
         self.n_workers = n_workers
@@ -139,6 +142,24 @@ class ServiceCtx:
         self._ps_probe_fails: dict = {}
         self._ps_restarts: dict = {}
         self._last_probe = 0.0
+        # flight recorder (postmortem_dir arms it): the supervisor's
+        # probe loop also polls each supervised replica's /flight
+        # snapshot every ``flight_interval`` seconds and keeps the last
+        # copies, so a SIGKILLed replica still leaves a postmortem
+        # bundle behind (trace ring + health + metrics + armed faults)
+        self.flight_recorder = None
+        if postmortem_dir is not None:
+            from persia_tpu.fleet import FlightRecorder
+
+            self.flight_recorder = FlightRecorder(postmortem_dir)
+        self.flight_interval = flight_interval
+        self._ps_last_flight: dict = {}
+        # http_all: every Python service gets an observability sidecar
+        # (supervised PS replicas always have one — it is the
+        # supervisor's detection channel); the service binaries publish
+        # the sidecar address to the coordinator, so fleet_targets()
+        # sees the whole topology
+        self.http_all = http_all
 
     def _spawn(self, args: List[str], name: str, replica_index: int,
                replica_size: int) -> subprocess.Popen:
@@ -232,6 +253,8 @@ class ServiceCtx:
                     "--num-ps", str(self.n_ps)]
             if self.global_config_path:
                 args += ["--global-config", self.global_config_path]
+            if self.http_all:
+                args += ["--http-port", "0"]
             self._spawn(args, f"worker-{i}", i, self.n_workers)
 
         try:
@@ -269,6 +292,10 @@ class ServiceCtx:
             self._ps_http_addr.pop(i, None)
             self._ps_probe_fails[i] = 0
             args += ["--http-port", "0", "--http-addr-file", http_file]
+        elif self.http_all:
+            # unsupervised but fleet-observable: sidecar on, address
+            # discovered through the coordinator registration
+            args += ["--http-port", "0"]
         if restore:
             if self.ps_restore_dir:
                 ckpt = os.path.join(self.ps_restore_dir,
@@ -349,6 +376,7 @@ class ServiceCtx:
                 with urllib.request.urlopen(
                         f"http://{addr}/healthz", timeout=1.0):
                     self._ps_probe_fails[i] = 0
+                self._maybe_fetch_flight(i, addr)
             except Exception:
                 self._ps_probe_fails[i] = self._ps_probe_fails.get(i, 0) + 1
                 if self._ps_probe_fails[i] >= self.ps_probe_failures:
@@ -365,6 +393,27 @@ class ServiceCtx:
                         continue  # unkillable; retry next sweep
                     self._recover_ps(p, "sidecar unresponsive")
 
+    def _maybe_fetch_flight(self, i: int, addr: str):
+        """Poll replica ``i``'s /flight snapshot into the recorder when
+        due (its own try/except: a flight hiccup is not a liveness
+        failure — the /healthz probe above already answered)."""
+        if self.flight_recorder is None:
+            return
+        now = time.monotonic()
+        last = self._ps_last_flight.get(i)
+        if last is not None and now - last < self.flight_interval:
+            return
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                    f"http://{addr}/flight", timeout=2.0) as r:
+                doc = json.loads(r.read().decode())
+            self._ps_last_flight[i] = now
+            self.flight_recorder.observe(f"ps{i}", doc)
+        except Exception as e:
+            _logger.debug("flight fetch for ps%d failed: %s", i, e)
+
     def _recover_ps(self, proc: subprocess.Popen, reason: str):
         """Restart a dead supervised PS replica and record the recovery
         event. Recovered == the replacement wrote its sidecar addr file
@@ -380,6 +429,16 @@ class ServiceCtx:
                  "restart_no": self._ps_restarts[i]}
         _logger.error("supervised PS %d down (%s); restarting (%d/%d)",
                       i, reason, self._ps_restarts[i], self.ps_max_restarts)
+        if self.flight_recorder is not None:
+            # the crashed process cannot be asked anything anymore: the
+            # bundle is built from the last /flight snapshot the probe
+            # loop cached — its final observable state
+            try:
+                event["postmortem"] = self.flight_recorder.capture(
+                    f"ps{i}", f"crash:{reason}",
+                    extra={"restart_no": self._ps_restarts[i]})
+            except Exception:
+                _logger.exception("postmortem capture for ps%d failed", i)
         new_proc = self._spawn_ps(i, restore=True)
         deadline = time.monotonic() + self.startup_timeout
         addr = None
@@ -455,6 +514,20 @@ class ServiceCtx:
         w = RemoteEmbeddingWorker(self.worker_addrs)
         w.schema = self.schema
         return w
+
+    def fleet_targets(self) -> List[dict]:
+        """Every observability sidecar in this cluster's topology (the
+        services publish their sidecar address when registering) — the
+        fleet monitor's discovery input."""
+        from persia_tpu.service_discovery import get_fleet_targets
+
+        return get_fleet_targets(self.coordinator_addr)
+
+    def fleet_monitor(self, **kw):
+        """Construct (not start) a FleetMonitor watching this cluster."""
+        from persia_tpu.fleet import FleetMonitor
+
+        return FleetMonitor(coordinator_addr=self.coordinator_addr, **kw)
 
     def coordinator_client(self) -> CoordinatorClient:
         return CoordinatorClient(self.coordinator_addr)

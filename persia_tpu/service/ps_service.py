@@ -303,7 +303,15 @@ class PsService:
         if faults._active:
             # chaos sites: delay == slow shard, die == kill mid-request
             faults.fire("ps.lookup", n=len(signs), dim=meta["dim"])
-        out = self._dispatch.lookup(signs, meta["dim"], meta["training"])
+        # store-work span nests under the rpc/lookup handler span (same
+        # thread): the one in-process parent->child chain a postmortem
+        # bundle of THIS replica's ring can always validate. ctx= keeps
+        # untraced requests untraced (no orphan roots) — same rule as
+        # the shard dispatcher's sub-spans.
+        with tracing.span("ps/lookup", ctx=tracing.current_context(),
+                          n=len(signs), dim=meta["dim"]):
+            out = self._dispatch.lookup(signs, meta["dim"],
+                                        meta["training"])
         if meta.get("resp") == "fp16" and self.server._enable_codec:
             # codec-negotiated client asked for half-precision rows:
             # the response meta names the encoding, so the client
@@ -332,7 +340,9 @@ class PsService:
             signs, grads = arrays
         if faults._active:
             faults.fire("ps.update", n=len(signs), dim=meta["dim"])
-        self._dispatch.update_gradients(signs, grads, meta["dim"])
+        with tracing.span("ps/update", ctx=tracing.current_context(),
+                          n=len(signs), dim=meta["dim"]):
+            self._dispatch.update_gradients(signs, grads, meta["dim"])
         if self.inc_dumper is not None:
             self.inc_dumper.commit(signs)
         return b""
@@ -866,8 +876,11 @@ def main():
         write_addr_file(service.addr, args.addr_file)
     obs_http.write_addr_file_from_args(service.http, args)
     if args.coordinator:
+        # the sidecar address rides the registration so the fleet
+        # monitor can discover every scrape target from the coordinator
         CoordinatorClient(args.coordinator).register(
-            ROLE_PS, args.replica_index, service.addr)
+            ROLE_PS, args.replica_index, service.addr,
+            http_addr=service.http.addr if service.http else None)
     service.server.serve_forever()
 
 

@@ -414,8 +414,10 @@ def main():
                  service.http.addr if service.http else "off")
     obs_http.write_addr_file_from_args(service.http, args)
     if args.coordinator:
+        # sidecar addr rides the registration (fleet-monitor discovery)
         CoordinatorClient(args.coordinator).register(
-            ROLE_WORKER, args.replica_index, service.addr)
+            ROLE_WORKER, args.replica_index, service.addr,
+            http_addr=service.http.addr if service.http else None)
     service.server.serve_forever()
 
 

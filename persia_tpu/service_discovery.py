@@ -1,12 +1,27 @@
-"""Inference-time service discovery (reference: persia/service.py).
+"""Service discovery (reference: persia/service.py).
 
 Resolves embedding-worker addresses for InferCtx from either the
 ``EMBEDDING_WORKER_SERVICE`` env (host:port[,host:port...] — the
-reference's contract) or a live coordinator.
+reference's contract) or a live coordinator, and resolves the fleet
+monitor's scrape targets (every observability sidecar in the topology)
+from the coordinator or a static ``PERSIA_FLEET_TARGETS`` list.
 """
 
 import os
-from typing import List, Optional
+from typing import Dict, List, Optional
+
+# short per-role track prefixes for fleet service names (ps0, worker1,
+# ...) — matching the tracing.set_service_name convention the service
+# binaries already use, so the fleet topology, the merged traces, and
+# the logs all name a replica the same way
+_ROLE_PREFIX = {
+    "embedding-parameter-server": "ps",
+    "embedding-worker": "worker",
+    "nn-worker": "trainer",
+    "data-loader": "loader",
+    "inference-server": "serving",
+    "fleet-monitor": "fleet",
+}
 
 
 def get_embedding_worker_services(
@@ -28,3 +43,62 @@ def get_embedding_worker_services(
         "set EMBEDDING_WORKER_SERVICE or PERSIA_COORDINATOR_ADDR to locate "
         "embedding workers"
     )
+
+
+def service_name_for(role: str, replica: int) -> str:
+    return f"{_ROLE_PREFIX.get(role, role)}{replica}"
+
+
+def get_fleet_targets(
+    coordinator_addr: Optional[str] = None,
+    static: Optional[str] = None,
+) -> List[Dict]:
+    """Scrape targets for the fleet monitor: every service that
+    published an observability sidecar.
+
+    Sources, in order:
+
+    - ``static`` / ``PERSIA_FLEET_TARGETS`` — ``name=host:port`` pairs
+      joined by commas (fixed fleets, serving tiers outside the
+      coordinator's world);
+    - the coordinator's ``topology`` RPC (``coordinator_addr`` /
+      ``PERSIA_COORDINATOR_ADDR``) — services registered with an
+      ``http_addr``.
+
+    Both may contribute; targets are deduped by sidecar address.
+    Returns ``[{service, role, replica, rpc_addr, http_addr}, ...]``.
+    """
+    targets: List[Dict] = []
+    seen = set()
+    static = static if static is not None else os.environ.get(
+        "PERSIA_FLEET_TARGETS", "")
+    for part in (static or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, addr = part.partition("=")
+        if not addr:
+            name, addr = addr or f"svc{len(targets)}", name
+        if addr in seen:
+            continue
+        seen.add(addr)
+        targets.append({"service": name or f"svc{len(targets)}",
+                        "role": "static", "replica": len(targets),
+                        "rpc_addr": None, "http_addr": addr})
+    if coordinator_addr is None:
+        coordinator_addr = os.environ.get("PERSIA_COORDINATOR_ADDR")
+    if coordinator_addr:
+        from persia_tpu.service.coordinator import CoordinatorClient
+
+        for m in CoordinatorClient(coordinator_addr).topology():
+            if not m.get("http_addr") or m["http_addr"] in seen:
+                continue
+            seen.add(m["http_addr"])
+            targets.append({
+                "service": service_name_for(m["role"], m["replica"]),
+                "role": m["role"],
+                "replica": m["replica"],
+                "rpc_addr": m["addr"],
+                "http_addr": m["http_addr"],
+            })
+    return targets

@@ -837,6 +837,13 @@ def main(argv=None):
     p.add_argument("--port", type=int, default=8501)
     p.add_argument("--worker-addrs", default=None,
                    help="comma-separated; default EMBEDDING_WORKER_SERVICE")
+    p.add_argument("--coordinator",
+                   default=os.environ.get("PERSIA_COORDINATOR_ADDR"),
+                   help="register this serving replica (and its "
+                        "observability sidecar) with the coordinator so "
+                        "the fleet monitor scrapes it")
+    p.add_argument("--replica-index", type=int,
+                   default=int(os.environ.get("REPLICA_INDEX", 0)))
     p.add_argument("--max-batch-rows", type=int, default=0,
                    help="enable micro-batching up to this many coalesced "
                         "rows (0 = serialized legacy path)")
@@ -884,6 +891,15 @@ def main(argv=None):
                              http_port=obs_http.port_from_args(args),
                              degraded_fallback=not args.no_degraded_fallback)
     obs_http.write_addr_file_from_args(server.http, args)
+    if args.coordinator:
+        from persia_tpu.service.coordinator import (
+            ROLE_INFERENCE,
+            CoordinatorClient,
+        )
+
+        CoordinatorClient(args.coordinator).register(
+            ROLE_INFERENCE, args.replica_index, server.addr,
+            http_addr=server.http.addr if server.http else None)
     server.serve_forever()
 
 

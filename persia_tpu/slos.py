@@ -1,0 +1,430 @@
+"""Declarative SLO engine over scraped metric windows.
+
+The fleet monitor (:mod:`persia_tpu.fleet`) scrapes every service's
+``/metrics`` exposition; this module turns those per-target sample
+snapshots into *judgements*: a rule is ``metric expression + comparison
++ threshold + burn window``, evaluated continuously, with alerts that
+carry the breaching service's name and a bounded breach-event log for
+postmortems and CI gates.
+
+Expression grammar (deliberately small — every form is something the
+scrape windows can answer without a query language):
+
+- ``up``                  — synthetic per-target liveness (1 scraped ok,
+  0 down); the "replica dead / sidecar wedged" rule.
+- ``<metric>``            — the latest value of a gauge/counter, summed
+  across the service's matching series.
+- ``rate(<metric>)``      — per-second increase over the burn window
+  (counter-reset aware: a restart counts from zero, not negative).
+- ``increase(<metric>)``  — absolute increase over the burn window.
+- ``ratio(<a>, <b>)``     — increase(a) / increase(b) over the window
+  (0 when b did not move): error ratios, degradation ratios.
+- ``p50/p90/p95/p99(<metric>)`` — quantile from a Prometheus histogram's
+  ``_bucket`` series, computed on the window's bucket *increases* (the
+  recent distribution, not the since-boot one).
+
+Rules evaluate per matching service by default (``scope: service``) so
+an alert names the replica that breached; ``scope: fleet`` aggregates
+the expression across all matching services first (fleet-wide budgets).
+
+A rule fires after the condition has held for ``for_sec`` (0 = first
+breach fires immediately); each 0->1 firing transition is recorded in
+``breaches`` (bounded) and handed to the ``on_breach`` callback — the
+fleet monitor uses that hook to capture postmortem flight bundles.
+"""
+
+import re
+import threading
+import time
+from collections import deque, namedtuple
+from typing import Callable, Dict, List, Optional, Tuple
+
+from persia_tpu.logger import get_default_logger
+
+_logger = get_default_logger(__name__)
+
+_EXPR_RE = re.compile(
+    r"^\s*(?:(?P<fn>rate|increase|ratio|p50|p90|p95|p99)\s*\(\s*"
+    r"(?P<arg1>[a-zA-Z_:][a-zA-Z0-9_:]*)\s*"
+    r"(?:,\s*(?P<arg2>[a-zA-Z_:][a-zA-Z0-9_:]*)\s*)?\)"
+    r"|(?P<metric>[a-zA-Z_:][a-zA-Z0-9_:]*))\s*$")
+
+_OPS = {
+    ">": lambda a, b: a > b,
+    ">=": lambda a, b: a >= b,
+    "<": lambda a, b: a < b,
+    "<=": lambda a, b: a <= b,
+    "==": lambda a, b: a == b,
+    "!=": lambda a, b: a != b,
+}
+
+
+class SloRule:
+    """One declarative objective. ``service`` is a regex matched against
+    fleet service names (``ps0``, ``worker1``, ``serving:9000``...);
+    None matches every service."""
+
+    def __init__(self, name: str, expr: str, op: str, threshold: float,
+                 window_sec: float = 60.0, for_sec: float = 0.0,
+                 service: Optional[str] = None, scope: str = "service",
+                 severity: str = "page", description: str = ""):
+        m = _EXPR_RE.match(expr)
+        if m is None:
+            raise ValueError(f"rule {name!r}: bad expression {expr!r}")
+        if op not in _OPS:
+            raise ValueError(f"rule {name!r}: bad comparison {op!r} "
+                             f"(one of {sorted(_OPS)})")
+        if scope not in ("service", "fleet"):
+            raise ValueError(f"rule {name!r}: scope must be service|fleet")
+        self.name = name
+        self.expr = expr
+        self.fn = m.group("fn")          # None for bare metric / up
+        self.arg1 = m.group("arg1") or m.group("metric")
+        self.arg2 = m.group("arg2")
+        if self.fn == "ratio" and not self.arg2:
+            raise ValueError(f"rule {name!r}: ratio() needs two metrics")
+        self.op = op
+        self.threshold = float(threshold)
+        self.window_sec = float(window_sec)
+        self.for_sec = float(for_sec)
+        self.service = service
+        self._service_re = re.compile(service) if service else None
+        self.scope = scope
+        self.severity = severity
+        self.description = description
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "SloRule":
+        return cls(
+            name=d["name"], expr=d["expr"], op=d.get("op", ">"),
+            threshold=d["threshold"],
+            window_sec=d.get("window_sec", 60.0),
+            for_sec=d.get("for_sec", 0.0),
+            service=d.get("service"), scope=d.get("scope", "service"),
+            severity=d.get("severity", "page"),
+            description=d.get("description", ""),
+        )
+
+    def matches(self, service: str) -> bool:
+        return self._service_re is None or bool(
+            self._service_re.search(service))
+
+    def describe(self) -> Dict:
+        return {"name": self.name, "expr": self.expr, "op": self.op,
+                "threshold": self.threshold,
+                "window_sec": self.window_sec, "for_sec": self.for_sec,
+                "service": self.service, "scope": self.scope,
+                "severity": self.severity,
+                "description": self.description}
+
+
+def load_rules(path: str) -> List[SloRule]:
+    """Load a YAML (or JSON — YAML is a superset) rule file: a list of
+    rule dicts, or ``{"rules": [...]}``."""
+    import yaml
+
+    with open(path) as f:
+        doc = yaml.safe_load(f)
+    if isinstance(doc, dict):
+        doc = doc.get("rules", [])
+    return [SloRule.from_dict(d) for d in doc or []]
+
+
+def default_rules() -> List[SloRule]:
+    """The paved-road fleet objectives — every signal a hybrid
+    train+serve deployment pages on today. A rule file replaces these
+    wholesale; they double as grammar documentation."""
+    return [
+        SloRule("target_down", "up", "<", 1.0, window_sec=30.0,
+                description="a service's sidecar stopped answering "
+                            "scrapes (crashed or wedged replica)"),
+        SloRule("lost_updates", "rate(pipeline_lost_updates_total)",
+                ">", 0.0, window_sec=60.0,
+                description="backward ships exhausted retries — "
+                            "gradient updates are being dropped"),
+        SloRule("serving_degraded",
+                "ratio(inference_degraded_lookups_total,"
+                " inference_requests_total)",
+                ">", 0.05, window_sec=120.0,
+                description="more than 5% of predicts served with "
+                            "zero-vector embedding fallback"),
+        SloRule("lookup_p99_slow", "p99(lookup_rpc_time_cost_sec)",
+                ">", 1.0, window_sec=120.0,
+                description="worker-observed PS lookup p99 above 1s"),
+        SloRule("trace_ring_overrun", "rate(tracing_spans_dropped_total)",
+                ">", 100.0, window_sec=60.0, severity="ticket",
+                description="trace ring evicting >100 spans/s — "
+                            "captures are incomplete"),
+    ]
+
+
+class _Window:
+    """Per-service scrape history: a deque of ``(t, series)`` snapshots
+    where ``series`` maps ``(name, labels_tuple) -> value``."""
+
+    def __init__(self):
+        self.snaps: "deque[Tuple[float, Dict]]" = deque()
+        self.up = True
+
+    def add(self, t: float, series: Dict, keep_sec: float):
+        self.snaps.append((t, series))
+        while self.snaps and self.snaps[0][0] < t - keep_sec:
+            self.snaps.popleft()
+
+
+# immutable view handed to expression evaluation (the scrape thread
+# keeps appending to the live deques; evaluation reads a frozen copy)
+_Frozen = namedtuple("_Frozen", ["snaps", "up"])
+
+
+class SloEngine:
+    """Continuous evaluation of :class:`SloRule` objectives over
+    per-service scrape snapshots.
+
+    Thread-safe: the fleet scrape loop calls :meth:`ingest` /
+    :meth:`mark_down` per target, anyone may call :meth:`evaluate` /
+    :meth:`alerts`. Breach events (0->1 firing transitions) land in
+    ``breaches`` (bounded ring) and fire ``on_breach(alert_dict)``.
+    """
+
+    MAX_BREACHES = 256
+
+    def __init__(self, rules: Optional[List[SloRule]] = None,
+                 on_breach: Optional[Callable[[Dict], None]] = None):
+        self.rules = list(rules) if rules is not None else default_rules()
+        self.on_breach = on_breach
+        self._lock = threading.Lock()
+        self._windows: Dict[str, _Window] = {}
+        # (rule name, service) -> {"pending_since", "firing_since"}
+        self._state: Dict[Tuple[str, str], Dict] = {}
+        self.breaches: "deque[Dict]" = deque(maxlen=self.MAX_BREACHES)
+        self._keep_sec = max([r.window_sec for r in self.rules] + [60.0])
+
+    # --- ingestion -------------------------------------------------------
+
+    def ingest(self, service: str, samples, t: Optional[float] = None):
+        """Feed one scrape's parsed samples (``metrics.parse_exposition``
+        output, or any iterable of (name, labels, value))."""
+        t = time.monotonic() if t is None else t
+        series: Dict = {}
+        for name, labels, value in samples:
+            key = (name, tuple(sorted(labels.items())))
+            # duplicate series within one scrape (multiple servers in
+            # one process): sum — one exposition, one sample per key
+            series[key] = series.get(key, 0.0) + value
+        with self._lock:
+            w = self._windows.setdefault(service, _Window())
+            w.up = True
+            w.add(t, series, self._keep_sec)
+
+    def mark_down(self, service: str, t: Optional[float] = None):
+        """A scrape failed: the service contributes ``up == 0`` and its
+        stale series stop advancing (rates decay to 0 naturally)."""
+        with self._lock:
+            w = self._windows.setdefault(service, _Window())
+            w.up = False
+
+    def forget(self, service: str):
+        with self._lock:
+            self._windows.pop(service, None)
+            for key in [k for k in self._state if k[1] == service]:
+                self._state.pop(key, None)
+
+    # --- expression evaluation -------------------------------------------
+
+    @staticmethod
+    def _latest(w: _Window, name: str) -> Optional[float]:
+        if not w.snaps:
+            return None
+        _, series = w.snaps[-1]
+        vals = [v for (n, _l), v in series.items() if n == name]
+        return sum(vals) if vals else None
+
+    @staticmethod
+    def _series_increase(w: _Window, name: str, window_sec: float,
+                         now: float):
+        """Per-series (increase, dt) over the window, counter-reset
+        aware. Returns dict keyed by labels_tuple."""
+        if not w.snaps:
+            return {}
+        t_last, last = w.snaps[-1]
+        first_by_key: Dict = {}
+        t_first_by_key: Dict = {}
+        for t, series in w.snaps:
+            if t < now - window_sec:
+                continue
+            for key, v in series.items():
+                if key not in first_by_key:
+                    first_by_key[key] = v
+                    t_first_by_key[key] = t
+        out = {}
+        for (n, lbl), v_last in last.items():
+            if n != name:
+                continue
+            v_first = first_by_key.get((n, lbl), v_last)
+            inc = v_last - v_first
+            if inc < 0:  # counter reset mid-window (service restart)
+                inc = v_last
+            out[lbl] = (inc, max(t_last - t_first_by_key.get((n, lbl),
+                                                            t_last), 0.0))
+        return out
+
+    def _increase(self, w: _Window, name: str, window_sec: float,
+                  now: float) -> Optional[float]:
+        per = self._series_increase(w, name, window_sec, now)
+        if not per:
+            return None
+        return sum(inc for inc, _ in per.values())
+
+    def _rate(self, w: _Window, name: str, window_sec: float,
+              now: float) -> Optional[float]:
+        per = self._series_increase(w, name, window_sec, now)
+        vals = [inc / dt for inc, dt in per.values() if dt > 0]
+        if not vals:
+            return None
+        return sum(vals)
+
+    def _quantile(self, w: _Window, name: str, q: float,
+                  window_sec: float, now: float) -> Optional[float]:
+        """Histogram quantile over the window's bucket increases; falls
+        back to the cumulative buckets when the window saw no traffic
+        start (fresh window)."""
+        per = self._series_increase(w, name + "_bucket", window_sec, now)
+        buckets: Dict[float, float] = {}
+        for lbl, (inc, _dt) in per.items():
+            le = dict(lbl).get("le")
+            if le is None:
+                continue
+            bound = float("inf") if le == "+Inf" else float(le)
+            buckets[bound] = buckets.get(bound, 0.0) + inc
+        if not buckets or all(v <= 0 for v in buckets.values()):
+            return None
+        bounds = sorted(buckets)
+        total = buckets[bounds[-1]]  # +Inf cumulative == count
+        if total <= 0:
+            return None
+        rank = q * total
+        lo = 0.0
+        prev_cum = 0.0
+        for b in bounds:
+            cum = buckets[b]
+            if cum >= rank:
+                if b == float("inf"):
+                    return lo  # pessimistic finite answer
+                width = cum - prev_cum
+                frac = ((rank - prev_cum) / width) if width > 0 else 1.0
+                return lo + (b - lo) * min(max(frac, 0.0), 1.0)
+            prev_cum = cum
+            lo = b if b != float("inf") else lo
+        return bounds[-2] if len(bounds) > 1 else 0.0
+
+    def _eval_expr(self, rule: SloRule, w: _Window,
+                   now: float) -> Optional[float]:
+        if rule.arg1 == "up" and rule.fn is None:
+            return 1.0 if w.up else 0.0
+        if rule.fn is None:
+            return self._latest(w, rule.arg1)
+        if rule.fn == "rate":
+            return self._rate(w, rule.arg1, rule.window_sec, now)
+        if rule.fn == "increase":
+            return self._increase(w, rule.arg1, rule.window_sec, now)
+        if rule.fn == "ratio":
+            num = self._increase(w, rule.arg1, rule.window_sec, now)
+            den = self._increase(w, rule.arg2, rule.window_sec, now)
+            if num is None and den is None:
+                return None
+            if not den:
+                return 0.0
+            return (num or 0.0) / den
+        q = {"p50": 0.50, "p90": 0.90, "p95": 0.95, "p99": 0.99}[rule.fn]
+        return self._quantile(w, rule.arg1, q, rule.window_sec, now)
+
+    # --- evaluation ------------------------------------------------------
+
+    def evaluate(self, now: Optional[float] = None) -> List[Dict]:
+        """Evaluate every rule against the current windows; returns the
+        full alert list (firing and healthy) and records/announces new
+        breaches."""
+        now = time.monotonic() if now is None else now
+        fired: List[Dict] = []
+        with self._lock:
+            windows = {s: _Frozen(list(w.snaps), w.up)
+                       for s, w in self._windows.items()}
+        alerts: List[Dict] = []
+        for rule in self.rules:
+            matched = {s: w for s, w in windows.items()
+                       if rule.matches(s)}
+            if rule.scope == "fleet":
+                vals = [self._eval_expr(rule, w, now)
+                        for w in matched.values()]
+                vals = [v for v in vals if v is not None]
+                value = sum(vals) if vals else None
+                alerts.append(self._judge(rule, "fleet", value, now,
+                                          fired))
+            else:
+                for service in sorted(matched):
+                    value = self._eval_expr(rule, matched[service], now)
+                    alerts.append(self._judge(rule, service, value, now,
+                                              fired))
+        for alert in fired:
+            self.breaches.append(alert)
+            _logger.warning("SLO breach: %s on %s — %s %s %s (value %s)",
+                            alert["rule"], alert["service"], alert["expr"],
+                            alert["op"], alert["threshold"],
+                            alert["value"])
+            if self.on_breach is not None:
+                try:
+                    self.on_breach(alert)
+                except Exception:
+                    _logger.exception("on_breach callback failed")
+        return alerts
+
+    def _judge(self, rule: SloRule, service: str, value: Optional[float],
+               now: float, fired: List[Dict]) -> Dict:
+        key = (rule.name, service)
+        breaching = value is not None and _OPS[rule.op](value,
+                                                        rule.threshold)
+        with self._lock:
+            st = self._state.setdefault(
+                key, {"pending_since": None, "firing_since": None})
+            if breaching:
+                if st["pending_since"] is None:
+                    st["pending_since"] = now
+                held = now - st["pending_since"]
+                if held >= rule.for_sec and st["firing_since"] is None:
+                    st["firing_since"] = now
+                    new_breach = True
+                else:
+                    new_breach = False
+            else:
+                st["pending_since"] = None
+                st["firing_since"] = None
+                new_breach = False
+            firing = st["firing_since"] is not None
+            firing_since = st["firing_since"]
+        alert = {
+            "rule": rule.name, "service": service,
+            "expr": rule.expr, "op": rule.op,
+            "threshold": rule.threshold,
+            "value": value, "firing": firing,
+            "firing_since": firing_since, "t": now,
+            "severity": rule.severity,
+            "description": rule.description,
+        }
+        if new_breach:
+            fired.append(dict(alert))
+        return alert
+
+    def alerts(self, firing_only: bool = False) -> List[Dict]:
+        out = self.evaluate()
+        if firing_only:
+            out = [a for a in out if a["firing"]]
+        return out
+
+    def breach_events(self) -> List[Dict]:
+        with self._lock:
+            return list(self.breaches)
+
+    def exit_code(self) -> int:
+        """CI gate: nonzero iff any rule is currently firing."""
+        return 1 if any(a["firing"] for a in self.evaluate()) else 0

@@ -227,15 +227,33 @@ def span(name: str, ctx=_UNSET, root: bool = False, service: Optional[str] = Non
 
 class TraceCollector:
     """Bounded ring of finished spans, process-wide. Old spans fall off
-    the back; ``/trace?n=K`` and the bench read the recent window."""
+    the back; ``/trace?n=K`` and the bench read the recent window.
+
+    Eviction is COUNTED, not silent: ``dropped_total`` (mirrored to the
+    ``tracing_spans_dropped_total`` registry counter) tells a consumer
+    whether the window it scraped is complete — a merge that quietly
+    lost spans reads as a pipeline that skipped work."""
 
     def __init__(self, capacity: int = 8192):
         self._dq: "deque[Span]" = deque(maxlen=capacity)
         self._lock = threading.Lock()
+        self._dropped = 0
+        self._drop_counter = default_registry().counter(
+            "tracing_spans_dropped_total",
+            help_text="spans evicted from the bounded trace ring before "
+                      "any consumer read them")
 
     def add(self, s: Span):
         with self._lock:
+            if (self._dq.maxlen is not None
+                    and len(self._dq) == self._dq.maxlen):
+                self._dropped += 1
+                self._drop_counter.inc()
             self._dq.append(s)
+
+    @property
+    def dropped_total(self) -> int:
+        return self._dropped
 
     def recent(self, n: Optional[int] = None) -> List[Span]:
         with self._lock:
@@ -298,6 +316,68 @@ def export_chrome_trace(path: str, spans=None) -> str:
     with open(path, "w") as f:
         json.dump(chrome_trace(spans), f)
     return path
+
+
+# --- multi-process merge (library form of the bench's trace scrape) -------
+
+
+def as_span_dicts(spans) -> List[Dict]:
+    """Normalize a span source to ``to_dict()`` form: Span objects, raw
+    dicts, or a ``/trace?format=raw`` response body (either the legacy
+    bare list or the ``{"spans": [...], "dropped_total": N}`` object)."""
+    if isinstance(spans, dict):
+        spans = spans.get("spans", [])
+    return [s.to_dict() if isinstance(s, Span) else s for s in spans]
+
+
+def merge_span_dicts(groups, trace_id: Optional[str] = None) -> List[Dict]:
+    """Merge span captures from several processes (each element of
+    ``groups`` is one process's spans in any :func:`as_span_dicts`-
+    accepted form) into one flat list, optionally filtered to a single
+    ``trace_id`` (hex string)."""
+    merged: List[Dict] = []
+    for g in groups:
+        merged.extend(as_span_dicts(g))
+    if trace_id is not None:
+        merged = [s for s in merged if s["trace_id"] == trace_id]
+    return merged
+
+
+def promote_remote_parents(spans: List[Dict]) -> List[Dict]:
+    """Resolve cross-process parentage for a PARTIAL capture: a span
+    whose parent was recorded in a process that is not part of the
+    capture (a crashed peer, a scrape that raced the ring) is promoted
+    to a root, keeping the original parent id as a ``remote_parent``
+    tag. The result always validates orphan-free — the contract the
+    postmortem bundle's trace relies on."""
+    have = {s["span_id"] for s in spans}
+    out = []
+    for s in spans:
+        if s.get("parent_id") and s["parent_id"] not in have:
+            s = dict(s)
+            tags = dict(s.get("tags") or {})
+            tags["remote_parent"] = s["parent_id"]
+            s["tags"] = tags
+            s["parent_id"] = None
+        out.append(s)
+    return out
+
+
+def validate_span_dicts(spans: List[Dict]) -> Dict:
+    """Structural validation of a merged capture: trace-id population,
+    unresolvable parents, services and span names present. The bench
+    acceptance checks (one trace_id, no orphan parents, every tier
+    present) read this instead of re-deriving it."""
+    by_id = {s["span_id"]: s for s in spans}
+    orphans = [s["name"] for s in spans
+               if s.get("parent_id") and s["parent_id"] not in by_id]
+    return {
+        "n_spans": len(spans),
+        "trace_ids": sorted({s["trace_id"] for s in spans}),
+        "orphans": orphans,
+        "services": sorted({s["service"] for s in spans}),
+        "names": sorted({s["name"] for s in spans}),
+    }
 
 
 # --- device profiler hooks ------------------------------------------------

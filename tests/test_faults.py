@@ -454,14 +454,18 @@ def test_healthz_ready_split_during_restore():
 # --- supervisor: crash recovery with checkpoint + inc replay -------------
 
 
-def test_supervised_ps_kill_restart_restores_checkpoint_plus_inc(tmp_path):
+def test_supervised_ps_kill_restart_restores_checkpoint_plus_inc(
+        tmp_path, request):
     """Kill a supervised PS replica mid-training: the ServiceCtx
     supervisor restarts it with --initial-checkpoint + --replay-inc-dir,
     the worker re-resolves + re-arms, training resumes, and every row
     covered by the checkpoint + this replica's packets reads back
-    EXACTLY from the restored store."""
+    EXACTLY from the restored store. The supervisor's flight recorder
+    (postmortem_dir armed) must also leave a crash bundle for the
+    killed replica built from its last /flight snapshot."""
     import yaml
 
+    from persia_tpu import tracing
     from persia_tpu.checkpoint import iter_psd_entries
     from persia_tpu.service.helper import ServiceCtx
     from persia_tpu.service.ps_service import PsClient
@@ -470,6 +474,7 @@ def test_supervised_ps_kill_restart_restores_checkpoint_plus_inc(tmp_path):
         slots_config=uniform_slots(["slot_a", "slot_b"], dim=DIM))
     ckpt = str(tmp_path / "ckpt")
     inc = str(tmp_path / "inc")
+    pm_dir = str(tmp_path / "postmortems")
     gc_path = tmp_path / "gc.yml"
     yaml.safe_dump({"parameter_server": {
         "capacity": 100_000, "num_hashmap_internal_shards": 2,
@@ -477,10 +482,18 @@ def test_supervised_ps_kill_restart_restores_checkpoint_plus_inc(tmp_path):
         "incremental_dir": inc}}, gc_path.open("w"))
 
     rng = np.random.default_rng(0)
+    # traced end to end so the killed replica's flight ring carries
+    # rpc -> ps span chains for the bundle (enabled before any dial;
+    # the finalizer restores the disabled default even on failure —
+    # later tests assert the untraced wire)
+    tracing.enable_tracing(True)
+    request.addfinalizer(lambda: tracing.enable_tracing(False))
     with ServiceCtx(schema, n_workers=1, n_ps=2,
                     global_config_path=str(gc_path), supervise_ps=True,
                     ps_restore_dir=ckpt, ps_inc_dir=inc,
-                    ps_probe_interval=0.25) as svc:
+                    ps_probe_interval=0.25,
+                    postmortem_dir=pm_dir, flight_interval=0.3,
+                    env={"PERSIA_TRACING": "1"}) as svc:
         w = svc.remote_worker()
         w.configure_parameter_servers(
             "bounded_uniform", {"lower": -0.1, "upper": 0.1}, 1.0, 10.0)
@@ -502,12 +515,45 @@ def test_supervised_ps_kill_restart_restores_checkpoint_plus_inc(tmp_path):
         for _ in range(4):
             step(0, 4096)          # a few packets past the checkpoint
 
+        # let the flight recorder observe a POST-traffic snapshot of
+        # the victim before the kill (the probe loop polls every 0.3s;
+        # the first snapshot may predate the training steps above and
+        # would make for a span-less bundle)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            doc = svc.flight_recorder.last("ps1")
+            if doc is not None and doc.get("spans"):
+                break
+            time.sleep(0.05)
+        assert svc.flight_recorder.last("ps1").get("spans")
+
         proc = svc.ps_proc(1)
         t_kill = time.monotonic()
         proc.kill()
         events = svc.wait_ps_recoveries(1, timeout=60)
         assert "failed" not in events[0]
         assert events[0]["t_detected"] - t_kill < 10.0
+
+        # crash postmortem bundle: written before the respawn, from the
+        # last observed flight snapshot
+        bundle = events[0].get("postmortem")
+        assert bundle and os.path.isdir(bundle), events[0]
+        import json
+
+        with open(os.path.join(bundle, "health.json")) as f:
+            health = json.load(f)
+        assert health["model_manager_status"] == "Idle"
+        with open(os.path.join(bundle, "trace.json")) as f:
+            trace = json.load(f)
+        xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+        assert xs, "postmortem trace is empty"
+        ids = {e["args"]["span_id"] for e in xs}
+        assert all(not e["args"].get("parent_id")
+                   or e["args"]["parent_id"] in ids
+                   for e in xs), "orphan parents in postmortem trace"
+        assert os.path.getsize(os.path.join(bundle, "metrics.prom")) > 0
+        with open(os.path.join(bundle, "reason.json")) as f:
+            assert json.load(f)["service"] == "ps1"
         for _ in range(4):
             step(1 << 20, (1 << 20) + 4096)  # disjoint range post-kill
         assert w.staleness == 0

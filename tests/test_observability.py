@@ -244,12 +244,45 @@ def test_sidecar_metrics_healthz_trace(traced):
         assert health["service"] == "testsvc"
         assert health["queue_depth"] == 7
         assert health["uptime_sec"] >= 0
+        from persia_tpu.version import __version__
+
+        assert health["version"] == __version__  # fleet skew detection
         trace = json.loads(_get(f"http://{sidecar.addr}/trace?n=10"))
         names = [e["name"] for e in trace["traceEvents"]
                  if e["ph"] == "X"]
         assert "sidecar/span" in names
+        assert trace["otherData"]["spans_dropped_total"] == 0
         raw = json.loads(_get(f"http://{sidecar.addr}/trace?n=5&format=raw"))
-        assert any(s["name"] == "sidecar/span" for s in raw)
+        assert any(s["name"] == "sidecar/span" for s in raw["spans"])
+        assert raw["dropped_total"] == 0
+        flight = json.loads(_get(f"http://{sidecar.addr}/flight"))
+        assert flight["health"]["queue_depth"] == 7
+        assert 'obs_test_requests_total{svc="t"} 3.0' in flight["metrics"]
+        assert any(s["name"] == "sidecar/span" for s in flight["spans"])
+        assert isinstance(flight["faults"], list)
+    finally:
+        sidecar.stop()
+
+
+def test_trace_ring_counts_drops(traced):
+    """A full bounded ring counts evictions instead of discarding
+    silently, and the sidecar's /trace responses carry the count."""
+    from persia_tpu.obs_http import ObservabilityServer
+
+    coll = tracing.TraceCollector(capacity=8)
+    for i in range(20):
+        with tracing.span(f"drop/span{i}"):
+            pass
+        coll.add(tracing.default_collector().recent(1)[0])
+    assert coll.dropped_total == 12
+    sidecar = ObservabilityServer(collector=coll, service="dropper").start()
+    try:
+        raw = json.loads(
+            _get(f"http://{sidecar.addr}/trace?format=raw"))
+        assert raw["dropped_total"] == 12
+        assert len(raw["spans"]) == 8
+        chrome = json.loads(_get(f"http://{sidecar.addr}/trace"))
+        assert chrome["otherData"]["spans_dropped_total"] == 12
     finally:
         sidecar.stop()
 
@@ -321,8 +354,51 @@ def test_exposition_escapes_label_values():
     out = reg.render()
     (line,) = [l for l in out.splitlines() if l.startswith("esc_total")]
     assert line == 'esc_total{addr="a\\"b\\\\c\\nd"} 1.0'
-    # one metric line stays ONE line (no exposition injection)
-    assert len([l for l in out.splitlines() if "esc" in l]) == 1
+    # one metric line stays ONE line (no exposition injection): the
+    # family's TYPE comment plus exactly one sample line
+    esc_lines = [l for l in out.splitlines() if "esc" in l]
+    assert esc_lines == ["# TYPE esc_total counter", line]
+    # and the escaped value survives a parse round trip
+    from persia_tpu.metrics import parse_exposition
+
+    samples, families = parse_exposition(out)
+    d = {(n, tuple(sorted(l.items()))): v for n, l, v in samples}
+    assert d[("esc_total", (("addr", 'a"b\\c\nd'),))] == 1.0
+    assert families["esc_total"]["type"] == "counter"
+
+
+def test_exposition_type_help_parse_back():
+    """Satellite: render() emits # TYPE (and # HELP where available)
+    for every family — counter, gauge, histogram — and the output
+    parses back sample-exact."""
+    reg = MetricsRegistry()
+    reg.counter("pb_reqs_total", {"svc": "a"},
+                help_text="requests served").inc(5)
+    reg.counter("pb_reqs_total", {"svc": "b"}).inc(2)
+    reg.gauge("pb_depth").set(3)
+    h = reg.histogram("pb_lat_sec")
+    h.observe(0.002)
+    h.observe(7.0)
+    out = reg.render()
+    lines = out.splitlines()
+    assert "# TYPE pb_reqs_total counter" in lines
+    assert "# HELP pb_reqs_total requests served" in lines
+    assert "# TYPE pb_depth gauge" in lines
+    assert "# TYPE pb_lat_sec histogram" in lines
+    # TYPE once per family, not per series
+    assert lines.count("# TYPE pb_reqs_total counter") == 1
+    from persia_tpu.metrics import parse_exposition
+
+    samples, families = parse_exposition(out)
+    d = {(n, tuple(sorted(l.items()))): v for n, l, v in samples}
+    assert d[("pb_reqs_total", (("svc", "a"),))] == 5.0
+    assert d[("pb_reqs_total", (("svc", "b"),))] == 2.0
+    assert d[("pb_depth", ())] == 3.0
+    assert d[("pb_lat_sec_count", ())] == 2.0
+    assert d[("pb_lat_sec_sum", ())] == 7.002
+    assert d[("pb_lat_sec_bucket", (("le", "+Inf"),))] == 2.0
+    assert families["pb_lat_sec"]["type"] == "histogram"
+    assert families["pb_reqs_total"]["help"] == "requests served"
 
 
 def test_render_vs_observe_race_is_consistent():

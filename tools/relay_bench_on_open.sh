@@ -55,6 +55,14 @@ while [ "$(date +%s)" -lt "$END" ]; do
       #     TPU host's core count derisks the 2-core dev-box numbers
       step "bench mem (mixed precision)" python bench.py --mode mem \
         --max-seconds 1100
+      # 4e. fleet control plane (PR 6): scrape-on vs scrape-off cycle
+      #     inflation (<= 3% gate), SLO breach-detection latency for an
+      #     injected PS fault (<= 2 scrape intervals), federated
+      #     /fleet/* views + postmortem bundle — host-only, but the
+      #     inflation number on production-class cores is the one that
+      #     matters (the 2-core dev box exaggerates scraper GIL cost)
+      step "bench fleet (control plane)" python bench.py --mode fleet \
+        --max-seconds 900
       # 5. re-capture the headline near the end of the window
       step "re-capture: python bench.py" python bench.py
       echo "$(date -u +%FT%TZ) chip sequence complete — see BENCH_CAPTURE_r05.log" >> "$LOG"
