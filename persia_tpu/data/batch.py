@@ -18,7 +18,7 @@ from typing import List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
-from persia_tpu.env import PERSIA_SKIP_CHECK_DATA
+from persia_tpu.env import skip_check_data
 
 # Maximum supported batch size: sample indices travel as u16 pairs in the
 # worker's dedup maps (reference: persia/embedding/data.py:14).
@@ -64,7 +64,7 @@ class IDTypeFeature:
     uint64 ID arrays (LIL). Stored internally as CSR."""
 
     def __init__(self, name: str, data: List[np.ndarray]):
-        if not PERSIA_SKIP_CHECK_DATA:
+        if not skip_check_data():
             for x in data:
                 if not isinstance(x, np.ndarray) or x.ndim != 1 or x.dtype != np.uint64:
                     raise TypeError(
@@ -109,7 +109,7 @@ class IDTypeFeatureWithSingleID(IDTypeFeature):
     (reference: embedding/data.py:116-157)."""
 
     def __init__(self, name: str, data: np.ndarray):
-        if not PERSIA_SKIP_CHECK_DATA:
+        if not skip_check_data():
             if (
                 not isinstance(data, np.ndarray)
                 or data.ndim != 1
@@ -127,7 +127,7 @@ class NdarrayBase:
     DEFAULT_NAME = "ndarray_base"
 
     def __init__(self, data: np.ndarray, name: Optional[str] = None):
-        if not PERSIA_SKIP_CHECK_DATA:
+        if not skip_check_data():
             if not isinstance(data, np.ndarray):
                 raise TypeError(f"{name or self.DEFAULT_NAME} must be np.ndarray")
             if data.dtype.type not in _ND_SUPPORTED_DTYPES:

@@ -8,17 +8,23 @@ launchers and k8s manifests stay interchangeable.
 import os
 from typing import Optional
 
+from persia_tpu import knobs
+
 
 def _int_env(name: str) -> Optional[int]:
     val = os.environ.get(name)
     return int(val) if val is not None else None
 
 
-PERSIA_SKIP_CHECK_DATA = os.environ.get("PERSIA_SKIP_CHECK_DATA", "false").lower() in (
-    "1",
-    "true",
-    "yes",
-)
+def skip_check_data() -> bool:
+    """Whether PersiaBatch input validation is disabled.
+
+    Read at CALL time via the knob registry. This used to be a module
+    constant frozen at import — so `PERSIA_SKIP_CHECK_DATA=1` set by a
+    launcher or test after the first `persia_tpu` import was silently
+    ignored (persialint's knob-registry pass now rejects that pattern
+    outright)."""
+    return knobs.get("PERSIA_SKIP_CHECK_DATA")
 
 
 def get_rank() -> int:
@@ -67,8 +73,8 @@ def get_coordinator_addr() -> str:
     Plays the role NATS plays in the reference (PERSIA_NATS_URL,
     rust/others/persia-nats-client/src/lib.rs:98-108).
     """
-    return os.environ.get("PERSIA_COORDINATOR_ADDR", "127.0.0.1:23333")
+    return knobs.get("PERSIA_COORDINATOR_ADDR")
 
 
 def get_metrics_gateway_addr() -> Optional[str]:
-    return os.environ.get("PERSIA_METRICS_GATEWAY_ADDR")
+    return knobs.get("PERSIA_METRICS_GATEWAY_ADDR")

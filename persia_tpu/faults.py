@@ -49,6 +49,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from persia_tpu import knobs
 from persia_tpu.logger import get_default_logger
 
 _logger = get_default_logger(__name__)
@@ -283,12 +284,12 @@ def _handle_control(payload: bytes) -> bytes:
 
 
 # env arming at import: subprocess service replicas inherit the spec
-_env_spec = os.environ.get("PERSIA_FAULTS")
+# import_time_safe knobs: arming must happen at import so
+# subprocess service replicas inherit the spec from their parent
+_env_spec = knobs.get("PERSIA_FAULTS")
 if _env_spec:
     try:
-        install(_env_spec,
-                seed=int(os.environ["PERSIA_FAULTS_SEED"])
-                if os.environ.get("PERSIA_FAULTS_SEED") else None)
+        install(_env_spec, seed=knobs.get("PERSIA_FAULTS_SEED"))
         _logger.warning("fault injection armed from PERSIA_FAULTS: %s",
                         _env_spec)
     except ValueError as e:

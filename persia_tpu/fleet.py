@@ -55,6 +55,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 from urllib.parse import parse_qs, urlparse
 
+from persia_tpu import knobs
 from persia_tpu import tracing
 from persia_tpu.logger import get_default_logger
 from persia_tpu.metrics import MetricsRegistry, parse_exposition
@@ -344,10 +345,15 @@ class FleetMonitor:
                 now - self._last_discover >= self.rediscover_interval):
             self.discover()
         targets = self.targets()
-        if self._pool is None:
-            self._pool = ThreadPoolExecutor(
-                max_workers=min(16, max(4, len(targets) or 1)),
-                thread_name_prefix="fleet-scrape")
+        # lazy pool init under the lock: scrape_once is public API, and
+        # two overlapping first rounds (background loop + a caller-
+        # driven round) racing the None check would each build a pool —
+        # one of them orphaned with live worker threads, never shut down
+        with self._targets_lock:
+            if self._pool is None:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=min(16, max(4, len(targets) or 1)),
+                    thread_name_prefix="fleet-scrape")
         t_round0 = time.perf_counter()
         futs = {}
         for t in targets:
@@ -384,7 +390,12 @@ class FleetMonitor:
             self.engine.ingest(t.service, res["samples"])
         self.engine.evaluate()
         self._m_rounds.inc()
-        self.rounds += 1
+        # under the targets lock: scrape_once is public API — the
+        # background loop and a caller-driven round (tests, the CLI
+        # --check gate) may overlap, and an unguarded += here is the
+        # lost-increment shape persialint's lock pass flags
+        with self._targets_lock:
+            self.rounds += 1
         self._t_round.observe(time.perf_counter() - t_round0)
         return n_up
 
@@ -436,9 +447,10 @@ class FleetMonitor:
         if self._thread is not None:
             self._thread.join(timeout=10)
             self._thread = None
-        if self._pool is not None:
-            self._pool.shutdown(wait=False)
-            self._pool = None  # start() after stop() gets a fresh pool
+        with self._targets_lock:
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)  # start() after stop(): fresh pool
 
     # --- federated views -------------------------------------------------
 
@@ -631,10 +643,10 @@ def main(argv=None):
     p.add_argument("--addr-file", default=None,
                    help="write the bound address here after listen")
     p.add_argument("--coordinator",
-                   default=os.environ.get("PERSIA_COORDINATOR_ADDR"),
+                   default=knobs.get_raw("PERSIA_COORDINATOR_ADDR"),
                    help="coordinator for sidecar discovery")
     p.add_argument("--targets",
-                   default=os.environ.get("PERSIA_FLEET_TARGETS"),
+                   default=knobs.get_raw("PERSIA_FLEET_TARGETS"),
                    help="static name=host:port targets, comma separated")
     p.add_argument("--scrape-interval", type=float, default=5.0)
     p.add_argument("--scrape-timeout", type=float, default=2.0)
@@ -642,7 +654,7 @@ def main(argv=None):
     p.add_argument("--slo-rules", default=None,
                    help="YAML rule file (default: built-in rules)")
     p.add_argument("--postmortem-dir",
-                   default=os.environ.get("PERSIA_POSTMORTEM_DIR"),
+                   default=knobs.get_raw("PERSIA_POSTMORTEM_DIR"),
                    help="where breach/crash bundles land (enables the "
                         "flight recorder)")
     p.add_argument("--check", type=int, default=0, metavar="ROUNDS",

@@ -49,16 +49,19 @@ class IncrementalUpdateDumper:
             if len(self._buffer) >= self.buffer_size:
                 flush = self._buffer
                 self._buffer = set()
+                seq = self._seq = self._seq + 1
         if flush:
-            self._dump_packet(flush)
+            self._dump_packet(flush, seq)
 
     def flush(self):
         with self._lock:
             flush, self._buffer = self._buffer, set()
+            if flush:
+                seq = self._seq = self._seq + 1
         if flush:
-            self._dump_packet(flush)
+            self._dump_packet(flush, seq)
 
-    def _dump_packet(self, signs: Set[int]):
+    def _dump_packet(self, signs: Set[int], seq: int):
         import struct
 
         from persia_tpu.ps.optim import RowPrecision
@@ -72,7 +75,6 @@ class IncrementalUpdateDumper:
         rp = RowPrecision(row_dtype)
         version = 1 if rp.is_fp32 else 2
 
-        self._seq += 1
         # the replica index is part of the packet NAME, not just the
         # file inside: all replicas share one inc_dir (global config),
         # and two replicas flushing in the same second used to collide
@@ -80,7 +82,13 @@ class IncrementalUpdateDumper:
         # the update RPC that triggered the flush failed). A restarted
         # replica restarts seq at 1, so the pid suffix keeps a fresh
         # incarnation from colliding with its predecessor's packets.
-        name = (f"inc_{time.strftime('%Y%m%d%H%M%S')}_{self._seq:06d}"
+        # ``seq`` is allocated inside commit/flush's locked region:
+        # concurrent update handlers (dispatch pool, shard-parallel)
+        # both flushing used to race the unguarded `self._seq += 1`
+        # here and could mint the SAME packet name within one second of
+        # one pid — the within-replica twin of the cross-replica
+        # collision above, surfaced by persialint's lock pass.
+        name = (f"inc_{time.strftime('%Y%m%d%H%M%S')}_{seq:06d}"
                 f"_r{self.replica_index}_p{os.getpid()}")
         pkt_dir = os.path.join(self.inc_dir, name)
         tmp_dir = pkt_dir + ".tmp"

@@ -1,0 +1,252 @@
+"""Central typed registry of every ``PERSIA_*`` environment knob.
+
+Before this module existed the stack had ~35 ``PERSIA_*`` reads
+scattered over 15 modules, each with its own parse convention
+(``== "1"`` vs ``!= "0"`` vs ``in ("1", "true", "yes")``), no single
+place to look up what exists, and one real footgun: a module-level
+``os.environ.get`` freezes the knob at import time, silently ignoring
+anything a test or launcher sets later (the old ``env.py``
+``PERSIA_SKIP_CHECK_DATA`` bug). ``tools/persialint``'s knob-registry
+pass now rejects any direct ``os.environ`` read of a ``PERSIA_*`` name
+outside this file, any ``knobs.get`` of an unregistered name (typo
+guard), and any import-time read of a knob not explicitly marked
+``import_time_safe`` — and ``docs/KNOBS.md`` is rendered from this
+registry, so the docs cannot drift.
+
+Parse conventions (kept bit-compatible with the historical call sites):
+
+- ``bool`` knobs whose default is **False** are enabled by
+  ``1``/``true``/``yes`` (case-insensitive) — the ``== "1"`` family;
+- ``bool`` knobs whose default is **True** are disabled only by the
+  literal ``0`` — the ``!= "0"`` family (any other value keeps them on);
+- ``int`` knobs parse with ``int()``; unset -> the registered default;
+- ``str`` knobs return the raw value; unset -> the registered default.
+
+``get`` applies the registered default; ``get_raw`` returns the
+environment string (or the caller's fallback) for sites whose local
+default differs from the canonical one (argparse ``default=None``
+"was it set at all?" probes). Both read ``os.environ`` at CALL time —
+never cache the result at import unless the knob is registered
+``import_time_safe`` (in which case the freeze is a documented,
+deliberate perf choice, e.g. the tracing gate's zero-overhead
+disabled path).
+"""
+
+import os
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+__all__ = ["Knob", "REGISTRY", "get", "get_raw", "all_knobs",
+           "render_markdown"]
+
+_TRUTHY = ("1", "true", "yes")
+
+
+@dataclass(frozen=True)
+class Knob:
+    name: str
+    type: str  # "bool" | "int" | "float" | "str"
+    default: object
+    doc: str
+    # True == reading this knob at module import time is a deliberate,
+    # documented freeze (zero-overhead gates, subprocess inheritance).
+    # Everything else must be read lazily, at call time.
+    import_time_safe: bool = False
+
+
+def _k(name, type_, default, doc, **kw) -> Knob:
+    return Knob(name, type_, default, doc, **kw)
+
+
+# One entry per knob, alphabetical. The doc string is what
+# docs/KNOBS.md renders, so write it for an operator, not for the code.
+REGISTRY: Dict[str, Knob] = {k.name: k for k in [
+    _k("PERSIA_COORDINATOR_ADDR", "str", "127.0.0.1:23333",
+       "Address of the persia-coordinator control-plane service (the "
+       "NATS analogue). Service binaries take it as their argparse "
+       "default; client helpers fall back to the canonical default."),
+    _k("PERSIA_DATALOADER_ENTRY", "str", None,
+       "Script the `persia_tpu.launcher data-loader` role runs when no "
+       "script argument is given (declarative k8s manifests)."),
+    _k("PERSIA_DEADLOCK_DETECTION", "bool", False,
+       "Arm the stall watchdog thread: logs an error when in-flight "
+       "work stops heartbeating (reference env gate)."),
+    _k("PERSIA_ENABLE_MONITOR", "bool", False,
+       "Embedding worker: estimate distinct ids per feature with an "
+       "HLL gauge (extra per-batch hashing cost)."),
+    _k("PERSIA_FAULTS", "str", None,
+       "Fault-injection spec armed at import (e.g. "
+       "`ps.lookup:delay:0.2:0.5`); subprocess service replicas "
+       "inherit it through the environment. See faults.py.",
+       import_time_safe=True),
+    _k("PERSIA_FAULTS_RPC", "bool", False,
+       "Expose the `__faults__` RPC control method so a live process "
+       "can be re-armed remotely (chaos bench). Never on by default."),
+    _k("PERSIA_FAULTS_SEED", "int", None,
+       "Deterministic seed for the fault injector's RNG.",
+       import_time_safe=True),
+    _k("PERSIA_FLEET_TARGETS", "str", "",
+       "Static fleet-monitor scrape targets: comma-joined "
+       "`name=host:port` pairs, merged with coordinator discovery."),
+    _k("PERSIA_FORCE_JAX_PLATFORM", "str", None,
+       "Serving binary: re-pin jax.config's platform (the axon plugin "
+       "overrides JAX_PLATFORMS via sitecustomize)."),
+    _k("PERSIA_FORCE_PYTHON_MW", "bool", False,
+       "Skip the native middleware kernels and use the numpy twins."),
+    _k("PERSIA_FORCE_PYTHON_PS", "bool", False,
+       "Skip the native embedding store and use the Python holder "
+       "(required for fp16/bf16 row storage)."),
+    _k("PERSIA_HTTP_PORT", "int", 0,
+       "Default observability sidecar port for the service binaries "
+       "(0 = ephemeral, -1 = disabled)."),
+    _k("PERSIA_NATIVE_LIB", "str", None,
+       "Explicit path to libpersia_native.so, tried before the normal "
+       "candidates. The ASan parity hook points it at the "
+       "`make -C native sanitize` build (native/build/asan/)."),
+    _k("PERSIA_METRICS_GATEWAY_ADDR", "str", None,
+       "Prometheus push-gateway address for metrics.push_loop. Unset "
+       "= pull-only via the /metrics sidecar."),
+    _k("PERSIA_NN_WORKER_ENTRY", "str", None,
+       "Script the `persia_tpu.launcher nn-worker` role runs when no "
+       "script argument is given."),
+    _k("PERSIA_NUM_DATALOADERS", "int", 1,
+       "Data-loader replica count (k8s manifests, examples' EOS "
+       "accounting)."),
+    _k("PERSIA_NUM_PS", "int", 1,
+       "Parameter-server replica count the worker binary expects."),
+    _k("PERSIA_NUM_WORKERS", "int", 1,
+       "Embedding-worker replica count (k8s manifests, examples)."),
+    _k("PERSIA_POSTMORTEM_DIR", "str", None,
+       "Where the fleet monitor / PS supervisor write breach and crash "
+       "flight-recorder bundles. Unset = recorder disabled."),
+    _k("PERSIA_PROFILE_DIR", "str", None,
+       "Enables the step-windowed jax.profiler capture; traces land "
+       "here."),
+    _k("PERSIA_PROFILE_NUM_STEPS", "int", 5,
+       "How many steps the profiler window captures."),
+    _k("PERSIA_PROFILE_START_STEP", "int", 10,
+       "First step of the profiler capture window."),
+    _k("PERSIA_PS_CIRCUIT_BREAKER", "bool", True,
+       "Per-replica circuit breaker on every PsClient RPC (fail fast "
+       "while a background TCP probe watches the address). `0` "
+       "disables."),
+    _k("PERSIA_PS_CONCURRENT_STREAMS", "int", 8,
+       "PS per-connection dispatch-pool depth (1 = the legacy "
+       "strictly-serial per-connection loop)."),
+    _k("PERSIA_PS_GC_TUNE", "bool", True,
+       "PS replica: freeze boot state and make full GC ~100x rarer "
+       "(a multi-million-entry store makes gen2 walks multi-hundred-ms "
+       "stalls). `0` restores interpreter defaults."),
+    _k("PERSIA_PS_LEGACY_FRAMES", "bool", False,
+       "Revert PS request framing to the concatenating pack_arrays "
+       "(pre-zero-copy A/B lever for the worker-cycle bench)."),
+    _k("PERSIA_PS_ROW_DTYPE", "str", None,
+       "Storage precision of the embedding slice of every PS row "
+       "(fp32|fp16|bf16; optimizer state stays fp32). Python holder "
+       "only."),
+    _k("PERSIA_PS_SHARD_PARALLEL", "bool", True,
+       "PS shard-parallel dispatch (per-internal-shard buckets). `0` "
+       "forces single-threaded dispatch regardless of core count."),
+    _k("PERSIA_PS_WIRE_CODEC", "str", "",
+       "Embedding-row wire precision policy: ``fp16`` ships lookup "
+       "responses as fp16 rows, ``fp16+int8`` additionally ships "
+       "update gradients as int8 + per-row scales (error feedback "
+       "client-side). Unset/off keeps the fp32 wire byte-identical to "
+       "the legacy protocol."),
+    _k("PERSIA_RPC_FORCE_BLOCK", "bool", False,
+       "Force negotiated block compression even on loopback (tests and "
+       "benches exercise the codec path without a real DCN link).",
+       import_time_safe=True),
+    _k("PERSIA_SKIP_CHECK_DATA", "bool", False,
+       "Skip PersiaBatch input validation (shape/dtype checks) on the "
+       "data-loader hot path. Read at call time — setting it after "
+       "import works (the old import-time freeze was a bug)."),
+    _k("PERSIA_TEST_TPU", "bool", False,
+       "Run the TPU-gated hardware-validation tests (pytest conftest "
+       "arms a per-test watchdog instead of skipping them)."),
+    _k("PERSIA_TRACING", "bool", False,
+       "Cross-tier span capture. Frozen at import ON PURPOSE: the "
+       "disabled path must cost nothing, so the gate is a module "
+       "constant; tests toggle via subprocess env.",
+       import_time_safe=True),
+    _k("PERSIA_WORKER_STREAMING", "bool", True,
+       "Embedding worker streaming data plane (scatter-per-completion "
+       "lookups, ship-as-aggregated updates). `0` restores the "
+       "serialized gather-then-scatter plane."),
+]}
+
+
+def _parse(knob: Knob, raw: str):
+    if knob.type == "bool":
+        # default-True knobs are the `!= "0"` family, default-False
+        # knobs the `== "1"/true/yes` family — bit-compatible with
+        # every historical call site.
+        if knob.default:
+            return raw != "0"
+        return raw.lower() in _TRUTHY
+    if knob.type in ("int", "float"):
+        # an EMPTY numeric knob means unset (shell blocks interpolate
+        # unset variables as ""); the historical sites treated it that
+        # way (`if os.environ.get(X)` is falsy on ""), and int("")
+        # raising here would silently disarm e.g. PERSIA_FAULTS_SEED
+        if raw == "":
+            return knob.default
+        return int(raw) if knob.type == "int" else float(raw)
+    return raw
+
+
+def get(name: str):
+    """Typed value of knob ``name`` from the CURRENT environment,
+    falling back to the registered default. Unknown names raise — the
+    runtime twin of the lint pass's typo guard."""
+    knob = REGISTRY.get(name)
+    if knob is None:
+        raise KeyError(f"unregistered PERSIA knob {name!r}; add it to "
+                       "persia_tpu/knobs.py (persialint enforces this)")
+    raw = os.environ.get(name)
+    if raw is None:
+        return knob.default
+    return _parse(knob, raw)
+
+
+def get_raw(name: str, default: Optional[str] = None) -> Optional[str]:
+    """Raw environment string for knob ``name`` (or ``default`` when
+    unset). For call sites whose local fallback differs from the
+    canonical default — argparse "was it set?" probes and the like.
+    Still registry-checked, so typos fail loudly."""
+    if name not in REGISTRY:
+        raise KeyError(f"unregistered PERSIA knob {name!r}; add it to "
+                       "persia_tpu/knobs.py (persialint enforces this)")
+    return os.environ.get(name, default)
+
+
+def all_knobs():
+    return [REGISTRY[k] for k in sorted(REGISTRY)]
+
+
+def render_markdown() -> str:
+    """The full knob reference, rendered for docs/KNOBS.md. persialint
+    --check-knob-docs fails when the checked-in file drifts from this."""
+    lines = [
+        "# PERSIA_* environment knobs",
+        "",
+        "Generated from `persia_tpu/knobs.py` — do not edit by hand.",
+        "Regenerate with `python -m tools.persialint --render-knobs`.",
+        "",
+        "Boolean knobs whose default is **on** are disabled only by the",
+        "literal `0`; boolean knobs whose default is **off** are enabled",
+        "by `1`/`true`/`yes`. All knobs are read at call time unless",
+        "marked *frozen at import*.",
+        "",
+        "| Knob | Type | Default | Description |",
+        "|---|---|---|---|",
+    ]
+    for knob in all_knobs():
+        default = ("*(unset)*" if knob.default is None
+                   else f"`{knob.default}`")
+        doc = " ".join(knob.doc.split())
+        if knob.import_time_safe:
+            doc += " *(frozen at import)*"
+        lines.append(f"| `{knob.name}` | {knob.type} | {default} | {doc} |")
+    lines.append("")
+    return "\n".join(lines)

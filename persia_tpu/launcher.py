@@ -18,6 +18,7 @@ import argparse
 import os
 import sys
 
+from persia_tpu import knobs
 from persia_tpu.logger import get_default_logger
 from persia_tpu.utils import run_command
 
@@ -25,7 +26,7 @@ _logger = get_default_logger("persia_tpu.launcher")
 
 
 def _run_script(entry_env: str, argv):
-    script = argv[0] if argv else os.environ.get(entry_env)
+    script = argv[0] if argv else knobs.get(entry_env)
     if not script:
         raise SystemExit(
             f"no script given and {entry_env} not set"

@@ -53,6 +53,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
 from urllib.parse import parse_qs, urlparse
 
+from persia_tpu import knobs
 from persia_tpu import faults
 from persia_tpu.logger import get_default_logger
 from persia_tpu.version import __version__
@@ -261,7 +262,7 @@ def add_http_args(parser):
     PERSIA_HTTP_PORT default)."""
     parser.add_argument(
         "--http-port", type=int,
-        default=int(os.environ.get("PERSIA_HTTP_PORT", 0)),
+        default=knobs.get("PERSIA_HTTP_PORT"),
         help="observability sidecar port (/metrics /healthz /trace); "
              "0 = ephemeral, -1 = disabled")
     parser.add_argument(

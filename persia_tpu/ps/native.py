@@ -14,6 +14,7 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from persia_tpu import knobs
 from persia_tpu.logger import get_default_logger
 
 _logger = get_default_logger(__name__)
@@ -57,9 +58,19 @@ def load_native_lib(build_if_missing: bool = True) -> Optional[ctypes.CDLL]:
     global _lib
     if _lib is not None:
         return _lib
-    path = next((p for p in _LIB_CANDIDATES if os.path.exists(p)), None)
+    # explicit override first: the ASan parity hook (and any operator
+    # pinning a specific build) names the .so directly. A missing
+    # override raises instead of silently falling back to the default
+    # candidates — the operator believes a SPECIFIC build is loaded
+    override = knobs.get("PERSIA_NATIVE_LIB")
+    if override and not os.path.exists(override):
+        raise FileNotFoundError(
+            f"PERSIA_NATIVE_LIB={override!r} does not exist; unset it "
+            "or rebuild (e.g. `make -C native sanitize`)")
+    candidates = ([override] if override else []) + _LIB_CANDIDATES
+    path = next((p for p in candidates if os.path.exists(p)), None)
     if path is None and build_if_missing and _build_native():
-        path = next((p for p in _LIB_CANDIDATES if os.path.exists(p)), None)
+        path = next((p for p in candidates if os.path.exists(p)), None)
     if path is None:
         return None
     lib = ctypes.CDLL(path)
@@ -315,7 +326,7 @@ def lint_row_dtype(row_dtype: str = "fp32", prefer_native: bool = True,
     including the config-default 0 — means the byte policy is OFF."""
     if (row_dtype in (None, "fp32")) and not capacity_bytes:
         return
-    if not prefer_native or os.environ.get("PERSIA_FORCE_PYTHON_PS") == "1":
+    if not prefer_native or knobs.get("PERSIA_FORCE_PYTHON_PS"):
         return
     if load_native_lib(build_if_missing=False) is None:
         return
@@ -342,7 +353,7 @@ def make_holder(capacity: int, num_internal_shards: int,
     want_python = (row_dtype not in (None, "fp32")
                    or capacity_bytes is not None)
     if (prefer_native and not want_python
-            and os.environ.get("PERSIA_FORCE_PYTHON_PS") != "1"):
+            and not knobs.get("PERSIA_FORCE_PYTHON_PS")):
         try:
             return NativeEmbeddingHolder(capacity, num_internal_shards)
         except RuntimeError:

@@ -240,6 +240,7 @@ class EmbeddingHolder:
         self.configured = True
 
     def register_optimizer(self, config: dict, feature_index_prefix_bit: int = 0):
+        # persialint: ok[lock-discipline] arm-time reference swap; the shard locks guard entry buffers (which optimizer.update mutates in place), not the optimizer binding itself
         self.optimizer = SparseOptimizer.from_config(
             config, feature_index_prefix_bit=feature_index_prefix_bit
         )

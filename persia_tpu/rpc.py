@@ -72,7 +72,7 @@ from typing import Callable, Dict, List, Optional, Tuple, Union
 import msgpack
 import numpy as np
 
-from persia_tpu import faults, tracing
+from persia_tpu import faults, knobs, tracing
 
 try:
     import zstandard
@@ -128,8 +128,50 @@ import zlib as _zlib
 
 # force block compression even on loopback (tests + bench exercise the
 # codec path without a real DCN link; normal loopback traffic skips it,
-# same rule as the zstd path — pure CPU tax there)
-_FORCE_BLOCK = os.environ.get("PERSIA_RPC_FORCE_BLOCK") == "1"
+# same rule as the zstd path — pure CPU tax there). Frozen at import on
+# purpose (registered import_time_safe): this sits on the per-frame
+# hot path.
+_FORCE_BLOCK = knobs.get("PERSIA_RPC_FORCE_BLOCK")
+
+# The server-side refusal table for dunder-named wire extensions: every
+# ``__x__`` method a client may probe MUST be declared here, and
+# :meth:`RpcServer.register` rejects any dunder handler that is not —
+# an undeclared extension cannot ship by accident. ``envelope`` kind ==
+# an opt-in negotiated envelope slot whose OFF wire must stay
+# byte-identical to the legacy protocol (pinned by served-request-count
+# tests); ``control`` kind == a plain opt-in control method with no
+# envelope slot. tools/persialint's wire-protocol pass cross-checks
+# every probe literal in the tree against this table and against the
+# pinning tests in tests/.
+ENVELOPE_EXTENSIONS: Dict[str, Dict[str, str]] = {
+    "__tags__": {
+        "kind": "envelope",
+        "doc": "tagged frames: u32 request ids, out-of-order responses",
+    },
+    "__trace__": {
+        "kind": "envelope",
+        "doc": "distributed-tracing context rides an extra envelope slot",
+    },
+    "__deadline__": {
+        "kind": "envelope",
+        "doc": "per-call deadline propagation; servers shed expired work",
+    },
+    "__codec__": {
+        "kind": "envelope",
+        "doc": "negotiated payload codec: block compression + half-"
+               "precision rows",
+    },
+    "__faults__": {
+        "kind": "control",
+        "doc": "remote fault-injection control, opt-in via "
+               "PERSIA_FAULTS_RPC=1",
+    },
+    "__shutdown__": {
+        "kind": "control",
+        "doc": "cooperative server stop (handled inline by the serve "
+               "loops, never dispatched to a handler)",
+    },
+}
 
 
 def block_codecs() -> List[str]:
@@ -616,7 +658,7 @@ class RpcServer:
             self._handlers["__deadline__"] = lambda payload: b""
         # remote fault-injection control (chaos bench re-arms a live PS
         # subprocess): opt-in by env — never exposed by default
-        if os.environ.get("PERSIA_FAULTS_RPC") == "1":
+        if knobs.get("PERSIA_FAULTS_RPC"):
             self._handlers["__faults__"] = faults._handle_control
         # /healthz surface: in-flight + served handler counts and the
         # age of the last request seen (scrapers distinguish "idle" from
@@ -652,6 +694,14 @@ class RpcServer:
         self._shutdown_cb: Optional[Callable[[], None]] = None
 
     def register(self, name: str, fn: Callable[[bytes], bytes]):
+        if (name.startswith("__") and name.endswith("__")
+                and name not in ENVELOPE_EXTENSIONS):
+            # dunder method names are reserved for declared wire
+            # extensions: an undeclared one would dodge the negotiate-
+            # down/byte-identical discipline persialint enforces
+            raise ValueError(
+                f"dunder RPC method {name!r} is not a declared wire "
+                "extension; add it to rpc.ENVELOPE_EXTENSIONS first")
         self._handlers[name] = fn
 
     def on_shutdown(self, cb: Callable[[], None]):

@@ -24,6 +24,7 @@ from typing import List, Optional
 import msgpack
 import numpy as np
 
+from persia_tpu import knobs
 from persia_tpu import faults, tracing
 from persia_tpu.logger import get_default_logger
 from persia_tpu.rpc import (
@@ -90,7 +91,7 @@ class ShardParallelDispatcher:
             (force or enabled)
             and n > 1
             and (force or cpus >= 4)
-            and os.environ.get("PERSIA_PS_SHARD_PARALLEL") != "0"
+            and knobs.get("PERSIA_PS_SHARD_PARALLEL")
         )
         self._pool = None
         if self.enabled:
@@ -530,7 +531,7 @@ class PsClient:
         # negotiates down to the fp32 wire transparently, and with the
         # codec off the wire is byte-identical to the legacy protocol.
         if wire_codec is None:
-            wire_codec = os.environ.get("PERSIA_PS_WIRE_CODEC", "")
+            wire_codec = knobs.get("PERSIA_PS_WIRE_CODEC")
         self.wire_fp16, self.wire_int8 = self.parse_wire_codec(wire_codec)
         self.client = RpcClient(addr, enable_tags=enable_tags,
                                 deadline=deadline,
@@ -547,7 +548,7 @@ class PsClient:
         self._pack = pack_arrays if legacy_frames else pack_arrays_sg
         if circuit_breaker is None:
             circuit_breaker = (
-                os.environ.get("PERSIA_PS_CIRCUIT_BREAKER") != "0")
+                knobs.get("PERSIA_PS_CIRCUIT_BREAKER"))
         if circuit_breaker is True:
             circuit_breaker = CircuitBreaker(
                 threshold=self.CB_THRESHOLD, cooldown=self.CB_COOLDOWN,
@@ -780,7 +781,7 @@ def main():
     p.add_argument("--replica-size", type=int,
                    default=int(os.environ.get("REPLICA_SIZE", 1)))
     p.add_argument("--coordinator",
-                   default=os.environ.get("PERSIA_COORDINATOR_ADDR"))
+                   default=knobs.get_raw("PERSIA_COORDINATOR_ADDR"))
     p.add_argument("--global-config", default=None)
     p.add_argument("--initial-checkpoint", default=None)
     p.add_argument("--replay-inc-dir", default=None,
@@ -791,8 +792,8 @@ def main():
     p.add_argument("--addr-file", default=None,
                    help="write the bound address here after listen (with "
                         "--port 0: race-free port handoff to a parent)")
-    p.add_argument("--row-dtype", default=os.environ.get(
-                       "PERSIA_PS_ROW_DTYPE"),
+    p.add_argument("--row-dtype",
+                   default=knobs.get("PERSIA_PS_ROW_DTYPE"),
                    choices=["fp32", "fp16", "bf16"],
                    help="storage precision of the embedding slice of "
                         "every row (optimizer state stays fp32); "
@@ -804,8 +805,7 @@ def main():
 
     obs_http.add_http_args(p)
     p.add_argument("--concurrent-streams", type=int,
-                   default=int(os.environ.get(
-                       "PERSIA_PS_CONCURRENT_STREAMS", 8)),
+                   default=knobs.get("PERSIA_PS_CONCURRENT_STREAMS"),
                    help="per-connection dispatch pool depth (1 = the "
                         "legacy strictly-serial per-connection loop); "
                         "shard-parallel execution is controlled "
@@ -815,7 +815,7 @@ def main():
 
     start_deadlock_detection()
     set_service_name(f"ps{args.replica_index}")
-    if os.environ.get("PERSIA_PS_GC_TUNE", "1") != "0":
+    if knobs.get("PERSIA_PS_GC_TUNE"):
         # A PS replica's store holds millions of gc-tracked objects
         # (per-entry tuples, dict nodes); CPython's default gen2 cadence
         # (every ~7k net allocations x 10 x 10) then walks the ENTIRE
@@ -859,7 +859,7 @@ def main():
         holder, args.host, args.port, inc_dumper=inc_dumper,
         concurrent_streams=args.concurrent_streams,
         # A/B lever for the worker-cycle bench's serialized baseline
-        legacy_frames=os.environ.get("PERSIA_PS_LEGACY_FRAMES") == "1",
+        legacy_frames=knobs.get("PERSIA_PS_LEGACY_FRAMES"),
         http_port=obs_http.port_from_args(args))
     if args.initial_checkpoint or args.replay_inc_dir:
         # restore BEFORE registering with the coordinator, so workers

@@ -28,6 +28,7 @@ from typing import Dict, List, Optional, Sequence
 import msgpack
 import numpy as np
 
+from persia_tpu import knobs
 from persia_tpu.config import EmbeddingSchema, GlobalConfig
 from persia_tpu.logger import get_default_logger
 from persia_tpu.rpc import RpcClient, RpcServer
@@ -220,7 +221,7 @@ class RemoteEmbeddingWorker:
         # peer pairing still speaks fp32). Same STRICT parse as
         # PsClient — a typo'd policy fails loudly, never silently fp32.
         self._fp16_rows = PsClient.parse_wire_codec(
-            os.environ.get("PERSIA_PS_WIRE_CODEC", ""))[0]
+            knobs.get("PERSIA_PS_WIRE_CODEC"))[0]
 
     def _next_addr(self) -> str:
         with self._rr_lock:
@@ -364,16 +365,16 @@ def main():
     p.add_argument("--replica-size", type=int,
                    default=int(os.environ.get("REPLICA_SIZE", 1)))
     p.add_argument("--coordinator",
-                   default=os.environ.get("PERSIA_COORDINATOR_ADDR"))
+                   default=knobs.get_raw("PERSIA_COORDINATOR_ADDR"))
     p.add_argument("--embedding-config", required=True,
                    help="embedding schema YAML")
     p.add_argument("--global-config", default=None)
     p.add_argument("--num-ps", type=int,
-                   default=int(os.environ.get("PERSIA_NUM_PS", 1)))
+                   default=knobs.get("PERSIA_NUM_PS"))
     p.add_argument("--ps-addrs", default=None,
                    help="comma-separated fixed PS addresses (Infer mode)")
     p.add_argument("--enable-monitor", action="store_true",
-                   default=os.environ.get("PERSIA_ENABLE_MONITOR") == "1",
+                   default=knobs.get("PERSIA_ENABLE_MONITOR"),
                    help="estimate distinct ids per feature (HLL gauge)")
     from persia_tpu import obs_http
 

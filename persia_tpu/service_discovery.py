@@ -10,6 +10,8 @@ from the coordinator or a static ``PERSIA_FLEET_TARGETS`` list.
 import os
 from typing import Dict, List, Optional
 
+from persia_tpu import knobs
+
 # short per-role track prefixes for fleet service names (ps0, worker1,
 # ...) — matching the tracing.set_service_name convention the service
 # binaries already use, so the fleet topology, the merged traces, and
@@ -31,7 +33,7 @@ def get_embedding_worker_services(
     if env:
         return [a.strip() for a in env.split(",") if a.strip()]
     if coordinator_addr is None:
-        coordinator_addr = os.environ.get("PERSIA_COORDINATOR_ADDR")
+        coordinator_addr = knobs.get_raw("PERSIA_COORDINATOR_ADDR")
     if coordinator_addr:
         from persia_tpu.service.coordinator import (
             ROLE_WORKER,
@@ -70,8 +72,8 @@ def get_fleet_targets(
     """
     targets: List[Dict] = []
     seen = set()
-    static = static if static is not None else os.environ.get(
-        "PERSIA_FLEET_TARGETS", "")
+    static = static if static is not None else knobs.get(
+        "PERSIA_FLEET_TARGETS")
     for part in (static or "").split(","):
         part = part.strip()
         if not part:
@@ -86,7 +88,7 @@ def get_fleet_targets(
                         "role": "static", "replica": len(targets),
                         "rpc_addr": None, "http_addr": addr})
     if coordinator_addr is None:
-        coordinator_addr = os.environ.get("PERSIA_COORDINATOR_ADDR")
+        coordinator_addr = knobs.get_raw("PERSIA_COORDINATOR_ADDR")
     if coordinator_addr:
         from persia_tpu.service.coordinator import CoordinatorClient
 

@@ -66,6 +66,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import msgpack
 import numpy as np
 
+from persia_tpu import knobs
 from persia_tpu import tracing
 from persia_tpu.config import EmbeddingSchema
 from persia_tpu.ctx import InferCtx
@@ -815,7 +816,7 @@ def main(argv=None):
     # same local-verification escape hatch as bench.py / nn_worker.py:
     # the axon platform plugin re-pins jax.config via sitecustomize, so
     # the plain env var alone is silently ignored
-    forced = os.environ.get("PERSIA_FORCE_JAX_PLATFORM") or (
+    forced = knobs.get("PERSIA_FORCE_JAX_PLATFORM") or (
         "cpu" if os.environ.get("JAX_PLATFORMS") == "cpu" else None)
     if forced:
         import jax
@@ -838,7 +839,7 @@ def main(argv=None):
     p.add_argument("--worker-addrs", default=None,
                    help="comma-separated; default EMBEDDING_WORKER_SERVICE")
     p.add_argument("--coordinator",
-                   default=os.environ.get("PERSIA_COORDINATOR_ADDR"),
+                   default=knobs.get_raw("PERSIA_COORDINATOR_ADDR"),
                    help="register this serving replica (and its "
                         "observability sidecar) with the coordinator so "
                         "the fleet monitor scrapes it")

@@ -40,6 +40,7 @@ from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 from persia_tpu.logger import get_default_logger
+from persia_tpu import knobs
 from persia_tpu.metrics import default_registry
 
 _logger = get_default_logger(__name__)
@@ -47,7 +48,9 @@ _logger = get_default_logger(__name__)
 
 # --- span context ---------------------------------------------------------
 
-_enabled = os.environ.get("PERSIA_TRACING") == "1"
+# frozen at import ON PURPOSE (registered import_time_safe): the
+# disabled path must cost nothing, so the gate is a module constant
+_enabled = knobs.get("PERSIA_TRACING")
 _tls = threading.local()
 # chrome-trace "pid" label; set_service_name() names this process's track
 _service = [f"pid{os.getpid()}"]
@@ -437,13 +440,13 @@ class StepProfiler:
 
 def profiler_from_env() -> Optional[StepProfiler]:
     """Build a StepProfiler from PERSIA_PROFILE_* env vars, or None."""
-    logdir = os.environ.get("PERSIA_PROFILE_DIR")
+    logdir = knobs.get("PERSIA_PROFILE_DIR")
     if not logdir:
         return None
     return StepProfiler(
         logdir,
-        start_step=int(os.environ.get("PERSIA_PROFILE_START_STEP", "10")),
-        num_steps=int(os.environ.get("PERSIA_PROFILE_NUM_STEPS", "5")),
+        start_step=knobs.get("PERSIA_PROFILE_START_STEP"),
+        num_steps=knobs.get("PERSIA_PROFILE_NUM_STEPS"),
     )
 
 
@@ -484,7 +487,7 @@ def dump_all_stacks(out=sys.stderr):
 def start_deadlock_detection(interval_sec: float = 30.0) -> Optional[threading.Thread]:
     """Start the stall watchdog (no-op unless PERSIA_DEADLOCK_DETECTION=1,
     matching the reference's env gate)."""
-    if os.environ.get("PERSIA_DEADLOCK_DETECTION") != "1":
+    if not knobs.get("PERSIA_DEADLOCK_DETECTION"):
         return None
 
     def run():

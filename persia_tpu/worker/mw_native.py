@@ -10,10 +10,11 @@ enforced by tests/test_native_parity.py. Set
 """
 
 import ctypes
-import os
 from typing import Optional, Tuple
 
 import numpy as np
+
+from persia_tpu import knobs
 
 _lib = None
 _checked = False
@@ -24,7 +25,7 @@ def _load():
     if _checked:
         return _lib
     _checked = True
-    if os.environ.get("PERSIA_FORCE_PYTHON_MW") == "1":
+    if knobs.get("PERSIA_FORCE_PYTHON_MW"):
         return None
     from persia_tpu.ps.native import load_native_lib
 

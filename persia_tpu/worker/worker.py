@@ -121,9 +121,9 @@ class EmbeddingWorker:
         # aggregating. streaming=False restores the gather-then-scatter /
         # aggregate-then-ship serialized plane (the bench baseline).
         if streaming is None:
-            import os as _os
+            from persia_tpu import knobs
 
-            streaming = _os.environ.get("PERSIA_WORKER_STREAMING") != "0"
+            streaming = knobs.get("PERSIA_WORKER_STREAMING")
         self.streaming = bool(streaming)
         reg = default_registry()
         # each worker instance gets its own labeled series so two

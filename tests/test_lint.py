@@ -1,0 +1,563 @@
+"""persialint: per-pass fixture coverage, baseline semantics, the
+run-on-repo gate, and regression tests for the real defects the lint
+surfaced in this tree (the inc_update duplicate-seq race, the
+import-time PERSIA_SKIP_CHECK_DATA freeze, the FleetMonitor round
+counter, the undeclared __shutdown__ extension).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.persialint import core  # noqa: E402
+from tools.persialint.core import load_baseline, run_lint, write_baseline  # noqa: E402
+
+
+def _lint_snippet(tmp_path, source, name="mod.py", tests=None):
+    """Run every pass over one synthetic module rooted at tmp_path."""
+    root = str(tmp_path)
+    path = os.path.join(root, name)
+    with open(path, "w") as f:
+        f.write(textwrap.dedent(source))
+    tests_dir = os.path.join(root, "tests")
+    os.makedirs(tests_dir, exist_ok=True)
+    with open(os.path.join(tests_dir, "test_pin.py"), "w") as f:
+        f.write(tests or "")
+    return run_lint([path], baseline_path=None, repo_root=root,
+                    tests_dir=tests_dir,
+                    rpc_path=os.path.join(root, "rpc.py"))
+
+
+def _passes(result):
+    return {f.pass_id for f in result.new}
+
+
+# --- pass 1: lock-discipline ---------------------------------------------
+
+LOCK_VIOLATION = """
+    import threading
+
+    class Stats:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.served = 0
+
+        def good(self):
+            with self._lock:
+                self.served += 1
+
+        def racy(self):
+            self.served += 1
+"""
+
+LOCK_CLEAN = """
+    import threading
+
+    class Stats:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.served = 0
+
+        def good(self):
+            with self._lock:
+                self.served += 1
+
+        def also_good(self):
+            with self._lock:
+                self.served -= 1
+
+        def _drain_locked(self):
+            self.served = 0
+"""
+
+
+def test_lock_pass_flags_unguarded_mutation(tmp_path):
+    r = _lint_snippet(tmp_path, LOCK_VIOLATION)
+    assert "lock-discipline" in _passes(r)
+    [f] = [f for f in r.new if f.pass_id == "lock-discipline"]
+    assert "served" in f.message and f.symbol == "Stats.racy"
+
+
+def test_lock_pass_clean_fixture(tmp_path):
+    r = _lint_snippet(tmp_path, LOCK_CLEAN)
+    assert "lock-discipline" not in _passes(r)
+
+
+def test_lock_pass_flags_rmw_in_lock_owning_class(tmp_path):
+    r = _lint_snippet(tmp_path, """
+        import threading
+
+        class Seq:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._buf = []
+                self._seq = 0
+
+            def push(self, x):
+                with self._lock:
+                    self._buf.append(x)
+
+            def next_name(self):
+                self._seq += 1
+                return f"pkt_{self._seq}"
+    """)
+    msgs = [f.message for f in r.new if f.pass_id == "lock-discipline"]
+    assert any("read-modify-write" in m and "_seq" in m for m in msgs)
+
+
+def test_lock_pass_honors_locked_suffix_and_shard_locks(tmp_path):
+    r = _lint_snippet(tmp_path, """
+        import threading
+
+        class Sharded:
+            def __init__(self):
+                self._locks = [threading.Lock() for _ in range(4)]
+                self.n = 0
+
+            def update(self, i):
+                with self._locks[i]:
+                    self.n += 1
+
+            def _sync_locked(self):
+                self.n = self.n + 0
+    """)
+    assert "lock-discipline" not in _passes(r)
+
+
+# --- pass 2: thread-lifecycle --------------------------------------------
+
+def test_thread_pass_flags_undaemonized_unjoined(tmp_path):
+    r = _lint_snippet(tmp_path, """
+        import threading
+
+        def leak():
+            t = threading.Thread(target=print)
+            t.start()
+    """)
+    assert "thread-lifecycle" in _passes(r)
+
+
+def test_thread_pass_clean_daemon_and_joined(tmp_path):
+    r = _lint_snippet(tmp_path, """
+        import threading
+
+        def ok_daemon():
+            threading.Thread(target=print, daemon=True).start()
+
+        class Owner:
+            def start(self):
+                self._t = threading.Thread(target=print)
+                self._t.start()
+
+            def stop(self):
+                self._t.join()
+
+        def scoped():
+            workers = [threading.Thread(target=print) for _ in range(2)]
+            for t in workers:
+                t.start()
+            for t in workers:
+                t.join()
+    """)
+    assert "thread-lifecycle" not in _passes(r)
+
+
+# --- pass 3: wire-protocol -----------------------------------------------
+
+WIRE_RPC_TABLE = """
+    ENVELOPE_EXTENSIONS = {
+        "__tags__": {"kind": "envelope", "doc": "tagged frames"},
+        "__faults__": {"kind": "control", "doc": "chaos control"},
+    }
+
+    def _dial(sock):
+        _send_msg(sock, ["__tags__"], b"")
+        env = recv(sock)
+        return env[0] == "ok"
+"""
+
+
+def _wire_fixture(tmp_path, client_src, tests=""):
+    root = str(tmp_path)
+    with open(os.path.join(root, "rpc.py"), "w") as f:
+        f.write(textwrap.dedent(WIRE_RPC_TABLE))
+    return _lint_snippet(tmp_path, client_src, name="client.py",
+                         tests=tests)
+
+
+def test_wire_pass_flags_undeclared_extension(tmp_path):
+    r = _wire_fixture(tmp_path, """
+        def probe(client):
+            client.call("__mystery__")
+    """, tests='PIN = "__tags__"\n')
+    msgs = [f.message for f in r.new if f.pass_id == "wire-protocol"]
+    assert any("__mystery__" in m and "not declared" in m for m in msgs)
+
+
+def test_wire_pass_flags_missing_test_pin(tmp_path):
+    r = _wire_fixture(tmp_path, """
+        def probe(client):
+            client.call("__faults__")
+    """, tests="")
+    msgs = [f.message for f in r.new if f.pass_id == "wire-protocol"]
+    assert any("__faults__" in m and "no test" in m for m in msgs)
+
+
+def test_wire_pass_clean_declared_and_pinned(tmp_path):
+    r = _wire_fixture(tmp_path, """
+        def probe(client):
+            client.call("__tags__")
+    """, tests='PIN = "__tags__"\n')
+    assert "wire-protocol" not in _passes(r)
+
+
+def test_wire_pass_requires_negotiate_down(tmp_path):
+    root = str(tmp_path)
+    # a table that declares an envelope extension rpc.py never probes
+    # refusal-tolerantly
+    with open(os.path.join(root, "rpc.py"), "w") as f:
+        f.write('ENVELOPE_EXTENSIONS = {\n'
+                '    "__newslot__": {"kind": "envelope", "doc": "x"},\n'
+                '}\n')
+    r = _lint_snippet(tmp_path, """
+        def probe(client):
+            client.call("__newslot__")
+    """, name="client.py", tests='PIN = "__newslot__"\n')
+    msgs = [f.message for f in r.new if f.pass_id == "wire-protocol"]
+    assert any("negotiate-down" in m for m in msgs)
+
+
+# --- pass 4: knob-registry -----------------------------------------------
+
+KNOBS_FIXTURE = """
+    REGISTRY = {}
+
+    def _k(name, type_, default, doc, import_time_safe=False):
+        pass
+
+    _k("PERSIA_GOOD", "bool", False, "fine")
+    _k("PERSIA_FROZEN", "bool", False, "frozen", import_time_safe=True)
+"""
+
+
+def _knob_fixture(tmp_path, source):
+    root = str(tmp_path)
+    os.makedirs(os.path.join(root, "persia_tpu"), exist_ok=True)
+    with open(os.path.join(root, "persia_tpu", "knobs.py"), "w") as f:
+        f.write(textwrap.dedent(KNOBS_FIXTURE))
+    return _lint_snippet(tmp_path, source, name="svc.py")
+
+
+def test_knob_pass_flags_direct_env_read(tmp_path):
+    r = _knob_fixture(tmp_path, """
+        import os
+
+        def f():
+            return os.environ.get("PERSIA_GOOD")
+    """)
+    msgs = [f.message for f in r.new if f.pass_id == "knob-registry"]
+    assert any("direct os.environ read" in m for m in msgs)
+
+
+def test_knob_pass_flags_typo_and_import_time_read(tmp_path):
+    r = _knob_fixture(tmp_path, """
+        from persia_tpu import knobs
+
+        TYPO = knobs.get("PERSIA_GODO")
+        FROZEN_OK = knobs.get("PERSIA_FROZEN")
+        EAGER = knobs.get("PERSIA_GOOD")
+    """)
+    msgs = [f.message for f in r.new if f.pass_id == "knob-registry"]
+    assert any("unregistered name 'PERSIA_GODO'" in m for m in msgs)
+    assert any("import-time read of PERSIA_GOOD" in m for m in msgs)
+    assert not any("PERSIA_FROZEN" in m for m in msgs)
+
+
+def test_knob_pass_clean_lazy_reads_and_env_writes(tmp_path):
+    r = _knob_fixture(tmp_path, """
+        import os
+
+        from persia_tpu import knobs
+
+        def f():
+            os.environ["PERSIA_GOOD"] = "1"   # writes are fine
+            return knobs.get("PERSIA_GOOD")
+
+        def g():
+            return knobs.get("PERSIA_FROZEN")
+    """)
+    assert "knob-registry" not in _passes(r)
+
+
+# --- pass 5: blocking-in-handler -----------------------------------------
+
+def test_blocking_pass_flags_sleep_reachable_from_handler(tmp_path):
+    r = _lint_snippet(tmp_path, """
+        import time
+
+        class Svc:
+            def __init__(self, server):
+                server.register("work", self._work)
+
+            def _work(self, payload):
+                self._retry()
+                return b""
+
+            def _retry(self):
+                time.sleep(1.0)
+    """)
+    [f] = [f for f in r.new if f.pass_id == "blocking-in-handler"]
+    assert "time.sleep" in f.message and "Svc._work" in f.message
+
+
+def test_blocking_pass_clean_deadline_bounded_and_nonhandler(tmp_path):
+    r = _lint_snippet(tmp_path, """
+        import time
+
+        class Svc:
+            def __init__(self, server):
+                server.register("work", self._work)
+
+            def _work(self, payload):
+                self._wait_ready()
+                return b""
+
+            def _wait_ready(self):
+                deadline = time.monotonic() + 5.0
+                while time.monotonic() < deadline:
+                    time.sleep(0.05)
+
+        def client_side_backoff():
+            time.sleep(1.0)   # not reachable from any handler
+    """)
+    assert "blocking-in-handler" not in _passes(r)
+
+
+# --- baseline + suppression semantics ------------------------------------
+
+def test_baseline_add_and_expire(tmp_path):
+    src_bad = LOCK_VIOLATION
+    src_good = LOCK_CLEAN
+    baseline = os.path.join(str(tmp_path), "baseline.json")
+
+    def lint(src):
+        mod = os.path.join(str(tmp_path), "mod.py")
+        with open(mod, "w") as f:
+            f.write(textwrap.dedent(src))
+        return run_lint([mod], baseline_path=baseline,
+                        repo_root=str(tmp_path),
+                        tests_dir=os.path.join(str(tmp_path), "tests"),
+                        rpc_path=os.path.join(str(tmp_path), "rpc.py"))
+
+    r = lint(src_bad)
+    assert r.exit_code == 1 and len(r.new) == 1
+
+    # write-baseline emits TODO justifications — hygiene must reject them
+    write_baseline(baseline, r.new)
+    r2 = lint(src_bad)
+    assert r2.exit_code == 1
+    assert any("justification" in e for e in r2.baseline_errors)
+
+    # a justified entry suppresses the finding
+    doc = json.load(open(baseline))
+    for e in doc["entries"]:
+        e["justification"] = "single-threaded in this fixture"
+    json.dump(doc, open(baseline, "w"))
+    r3 = lint(src_bad)
+    assert r3.exit_code == 0 and len(r3.baselined) == 1 and not r3.new
+
+    # fixing the violation makes the entry STALE: the gate fails until
+    # the ledger ratchets down
+    r4 = lint(src_good)
+    assert r4.exit_code == 1 and len(r4.stale_baseline) == 1 and not r4.new
+
+
+def test_inline_suppression_requires_reason(tmp_path):
+    with_reason = """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def a(self):
+                with self._lock:
+                    self.n += 1
+
+            def b(self):
+                # persialint: ok[lock-discipline] fixture knows best
+                self.n += 1
+    """
+    r = _lint_snippet(tmp_path, with_reason)
+    assert not r.new and len(r.suppressed) == 1
+
+    r2 = _lint_snippet(tmp_path, with_reason.replace(
+        " fixture knows best", ""))
+    assert len(r2.new) == 1  # reasonless ok-comment does not suppress
+
+
+# --- the gate itself ------------------------------------------------------
+
+def test_repo_is_lint_clean():
+    """`python -m tools.persialint persia_tpu/` on THIS tree: zero new
+    findings, zero stale entries, and a baseline within the reviewed
+    budget (<= 10 justified entries)."""
+    result = run_lint([os.path.join(REPO, "persia_tpu")],
+                      baseline_path=core.DEFAULT_BASELINE,
+                      check_knob_docs=True)
+    assert not result.new, "\n".join(f.render() for f in result.new)
+    assert not result.stale_baseline and not result.baseline_errors
+    entries, errors = load_baseline(core.DEFAULT_BASELINE)
+    assert len(entries) <= 10 and not errors
+
+
+def test_cli_json_output(tmp_path):
+    mod = os.path.join(str(tmp_path), "mod.py")
+    with open(mod, "w") as f:
+        f.write(textwrap.dedent(LOCK_VIOLATION))
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.persialint", mod, "--json",
+         "--no-baseline"],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)
+    assert doc["new"] and doc["new"][0]["pass"] == "lock-discipline"
+    assert doc["exit_code"] == 1
+
+
+def test_knob_docs_are_fresh():
+    from persia_tpu import knobs
+
+    with open(os.path.join(REPO, "docs", "KNOBS.md")) as f:
+        assert f.read() == knobs.render_markdown()
+
+
+# --- regressions: the real defects the lint surfaced ----------------------
+
+def test_skip_check_data_reads_env_at_call_time(monkeypatch):
+    """The old module-level read froze PERSIA_SKIP_CHECK_DATA at first
+    import; setting it later was silently ignored."""
+    from persia_tpu.data.batch import IDTypeFeature
+
+    bad = [np.array([1.5], dtype=np.float32)]  # wrong dtype
+    monkeypatch.delenv("PERSIA_SKIP_CHECK_DATA", raising=False)
+    with pytest.raises(TypeError):
+        IDTypeFeature("f", bad)
+    monkeypatch.setenv("PERSIA_SKIP_CHECK_DATA", "1")
+    IDTypeFeature("f", [np.array([1], dtype=np.uint64)])
+    # the frozen version would still raise here
+    IDTypeFeature("f", bad)
+    monkeypatch.setenv("PERSIA_SKIP_CHECK_DATA", "0")
+    with pytest.raises(TypeError):
+        IDTypeFeature("f", bad)
+
+
+def test_inc_dumper_concurrent_flush_unique_seqs(tmp_path):
+    """Concurrent update handlers flushing used to race the unguarded
+    `self._seq += 1` in _dump_packet and mint duplicate packet seqs
+    (same-second, same-pid name collision -> failed update RPC). The
+    seq is now allocated inside the commit/flush locked region."""
+    from persia_tpu.inc_update import IncrementalUpdateDumper
+
+    seen = []
+    seen_lock = threading.Lock()
+
+    class RecordingDumper(IncrementalUpdateDumper):
+        def _dump_packet(self, signs, seq):
+            time.sleep(0.001)  # widen the historical race window
+            with seen_lock:
+                seen.append(seq)
+
+    d = RecordingDumper(holder=None, inc_dir=str(tmp_path), buffer_size=1)
+    n_threads, per_thread = 8, 25
+
+    def hammer(i):
+        for j in range(per_thread):
+            d.commit(np.array([i * 1000 + j], dtype=np.uint64))
+
+    threads = [threading.Thread(target=hammer, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = n_threads * per_thread
+    assert sorted(seen) == list(range(1, total + 1)), (
+        f"duplicate/missing packet seqs: {len(seen)} packets, "
+        f"{len(set(seen))} unique")
+
+
+def test_inc_dumper_packet_name_carries_seq(tmp_path):
+    from persia_tpu.inc_update import IncrementalUpdateDumper
+    from persia_tpu.ps.store import EmbeddingHolder
+
+    h = EmbeddingHolder(capacity=100, num_internal_shards=1)
+    h.configure("zero", {})
+    h.register_optimizer({"type": "sgd", "lr": 0.1, "wd": 0.0})
+    signs = np.array([7, 8], dtype=np.uint64)
+    h.lookup(signs, dim=4, training=True)
+    d = IncrementalUpdateDumper(h, str(tmp_path), buffer_size=10_000)
+    d.commit(signs)
+    d.flush()
+    pkts = sorted(os.listdir(str(tmp_path)))
+    assert len(pkts) == 1 and "_000001_" in pkts[0]
+    d.commit(signs)
+    d.flush()
+    pkts = sorted(os.listdir(str(tmp_path)))
+    assert len(pkts) == 2 and "_000002_" in pkts[1]
+
+
+def test_fleet_round_counter_exact_under_concurrency():
+    """scrape_once is public API: the background loop and caller-driven
+    rounds may overlap, and `rounds += 1` was unguarded."""
+    from persia_tpu.fleet import FleetMonitor
+
+    m = FleetMonitor()  # zero targets: rounds are cheap no-op scrapes
+    n_threads, per_thread = 8, 25
+
+    def hammer():
+        for _ in range(per_thread):
+            m.scrape_once()
+
+    threads = [threading.Thread(target=hammer) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert m.rounds == n_threads * per_thread
+
+
+def test_shutdown_extension_declared_and_register_guard():
+    """__shutdown__ is a declared control extension (wire pass pins this
+    string), and RpcServer.register refuses undeclared dunder methods —
+    an undeclared extension cannot ship by accident."""
+    from persia_tpu.rpc import ENVELOPE_EXTENSIONS, RpcClient, RpcServer
+
+    assert ENVELOPE_EXTENSIONS["__shutdown__"]["kind"] == "control"
+    s = RpcServer(port=0)
+    with pytest.raises(ValueError, match="__sneaky__"):
+        s.register("__sneaky__", lambda p: b"")
+    s.register("echo", lambda p: p)
+    s.serve_background()
+    try:
+        c = RpcClient(s.addr)
+        assert c.call("echo", b"hi") == b"hi"
+        c.shutdown_server()
+        deadline = time.monotonic() + 5.0
+        while s._running and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert not s._running
+        c.close()
+    finally:
+        s.stop()

@@ -362,3 +362,48 @@ def test_mw_shard_order_matches_numpy_split():
             sel = order[int(starts[s]):int(starts[s + 1])]
             ref = np.nonzero(shards == s)[0]
             np.testing.assert_array_equal(sel, ref.astype(np.int32))
+
+
+@pytest.mark.slow
+def test_parity_under_asan():
+    """Re-run this module's parity suite against the sanitizer build
+    (`make -C native sanitize`), in a subprocess with the ASan runtime
+    preloaded. Skipped when the ASan artifacts or toolchain are absent;
+    CI at minimum compiles the target so sanitizer bitrot fails fast."""
+    import os
+    import shutil
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    asan_so = os.path.join(repo, "native", "build", "asan",
+                           "libpersia_native.so")
+    if not os.path.exists(asan_so):
+        pytest.skip("no ASan build; run `make -C native sanitize`")
+    gxx = shutil.which("g++")
+    if gxx is None:
+        pytest.skip("g++ unavailable to locate the ASan runtime")
+    preload = []
+    for rt in ("libasan.so", "libubsan.so"):
+        p = subprocess.run([gxx, f"-print-file-name={rt}"],
+                           capture_output=True, text=True).stdout.strip()
+        if not os.path.isabs(p):
+            pytest.skip(f"{rt} not found by {gxx}")
+        preload.append(p)
+
+    env = dict(os.environ)
+    env.update({
+        "LD_PRELOAD": " ".join(preload),
+        # python itself "leaks" at interpreter exit; halt_on_error stays
+        # on for real memory bugs, which is the point of the run
+        "ASAN_OPTIONS": "detect_leaks=0",
+        "PERSIA_NATIVE_LIB": asan_so,
+        "JAX_PLATFORMS": "cpu",
+    })
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", os.path.abspath(__file__), "-q",
+         "-p", "no:cacheprovider", "-k", "not asan"],
+        capture_output=True, text=True, env=env, cwd=repo, timeout=900)
+    tail = (proc.stdout + proc.stderr)[-4000:]
+    assert proc.returncode == 0, f"parity under ASan failed:\n{tail}"
+    assert "AddressSanitizer" not in tail, f"sanitizer report:\n{tail}"

@@ -1,0 +1,35 @@
+"""persialint — an invariant-enforcing static analyzer for the hybrid stack.
+
+Five passes over ``persia_tpu/`` (AST + symtable, stdlib only), each
+enforcing a convention the stack's correctness rests on but that no
+general-purpose tool checks:
+
+- ``lock-discipline``: per-class inference of the lock-guarded
+  attribute set; mutations (and compound read-modify-writes) of shared
+  state outside any lock are flagged.
+- ``thread-lifecycle``: every ``threading.Thread`` must be a daemon or
+  have a join/stop owner.
+- ``wire-protocol``: every ``__x__`` envelope probe must be declared in
+  ``rpc.ENVELOPE_EXTENSIONS``, have a negotiate-down client path, and
+  be pinned by a test in ``tests/``.
+- ``knob-registry``: every ``PERSIA_*`` environment read must route
+  through ``persia_tpu/knobs.py``; import-time reads need the knob's
+  ``import_time_safe`` flag; ``docs/KNOBS.md`` must match the registry.
+- ``blocking-in-handler``: ``time.sleep``/unbounded socket ops
+  reachable from RPC handlers without a deadline bound.
+
+Run ``python -m tools.persialint persia_tpu/``. Findings not in the
+reviewed baseline (``tools/persialint/baseline.json``, every entry
+justified) fail the run; so do stale baseline entries — the suppression
+count only ratchets down.
+"""
+
+from tools.persialint.core import Finding, LintResult, run_lint  # noqa: F401
+
+PASS_IDS = (
+    "lock-discipline",
+    "thread-lifecycle",
+    "wire-protocol",
+    "knob-registry",
+    "blocking-in-handler",
+)

@@ -1,0 +1,73 @@
+"""CLI: ``python -m tools.persialint [paths...]``.
+
+Exit nonzero on any NEW finding (not in the reviewed baseline), any
+STALE baseline entry (the suppressed finding is gone — remove the
+entry), or any baseline-hygiene error (missing justification). The
+summary line always prints the baseline count so CI logs show the debt
+ledger ratcheting down.
+"""
+
+import argparse
+import os
+import sys
+
+from tools.persialint import core
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="persialint",
+        description="invariant-enforcing static analyzer for the "
+                    "persia_tpu hybrid stack")
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files/dirs to lint (default: persia_tpu/)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable findings on stdout")
+    p.add_argument("--baseline", default=core.DEFAULT_BASELINE,
+                   help="reviewed suppression ledger (default: "
+                        "tools/persialint/baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline: report everything as new")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write all current findings to the baseline "
+                        "file with TODO justifications (the lint FAILS "
+                        "until each is justified by a human)")
+    p.add_argument("--check-knob-docs", action="store_true",
+                   help="also verify docs/KNOBS.md matches the registry")
+    p.add_argument("--render-knobs", action="store_true",
+                   help="regenerate docs/KNOBS.md from the registry and "
+                        "exit")
+    args = p.parse_args(argv)
+
+    if args.render_knobs:
+        sys.path.insert(0, core.REPO_ROOT)
+        from persia_tpu import knobs
+
+        out = os.path.join(core.REPO_ROOT, "docs", "KNOBS.md")
+        with open(out, "w", encoding="utf-8") as f:
+            f.write(knobs.render_markdown())
+        print(f"wrote {os.path.relpath(out, core.REPO_ROOT)} "
+              f"({len(knobs.REGISTRY)} knobs)")
+        return 0
+
+    paths = args.paths or [os.path.join(core.REPO_ROOT, "persia_tpu")]
+    baseline = None if args.no_baseline else args.baseline
+    result = core.run_lint(paths, baseline_path=baseline,
+                           check_knob_docs=args.check_knob_docs)
+
+    if args.write_baseline:
+        all_findings = result.new + result.baselined
+        core.write_baseline(args.baseline, all_findings)
+        print(f"wrote {len(all_findings)} entr(ies) to {args.baseline}; "
+              "justify each before the gate passes")
+        return 1 if all_findings else 0
+
+    if args.json:
+        core.render_json(result)
+    else:
+        core.render_human(result)
+    return result.exit_code
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

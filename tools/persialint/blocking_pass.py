@@ -1,0 +1,179 @@
+"""Pass 5 — blocking calls reachable from RPC handlers.
+
+RPC handlers run on server dispatch threads: on a serial connection a
+sleeping handler head-of-line blocks every later request, and even on
+the dispatch-pool path it burns a pool slot. The deadline machinery
+(``__deadline__``) sheds expired work *before* dispatch — it cannot
+rescue a handler that parks itself mid-execution.
+
+Handler discovery: any function registered via ``X.register("name",
+fn)`` or assigned into a ``_handlers[...]`` table. Reachability: the
+handler's own body plus same-class ``self.X()`` / same-module ``f()``
+calls, transitively. Flagged inside reachable functions:
+
+- ``time.sleep(...)`` (any alias ``*.sleep``),
+- ``socket.create_connection(...)`` without a ``timeout=`` kwarg,
+- ``<sock>.settimeout(None)``,
+- no-argument ``.wait()`` (Event/Condition wait without a bound).
+
+A function that manages its own budget — references a name containing
+``deadline`` (the repo's convention: ``deadline = monotonic() + ...``,
+or consulting the propagated RPC deadline) — is exempt: the rule is
+"no UNBOUNDED blocking", not "no blocking".
+"""
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.persialint.core import Finding, ParsedFile
+
+PASS_ID = "blocking-in-handler"
+
+
+def _const_str(node) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+class _ModuleIndex:
+    """Functions and methods of one module, plus handler roots."""
+
+    def __init__(self, pf: ParsedFile):
+        self.pf = pf
+        # key: ("", fname) for module functions, (Class, method) for methods
+        self.functions: Dict[Tuple[str, str], ast.AST] = {}
+        self.handlers: List[Tuple[str, str]] = []
+        for node in pf.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[("", node.name)] = node
+            elif isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        self.functions[(node.name, item.name)] = item
+        for (cls, fname), fn in self.functions.items():
+            for sub in ast.walk(fn):
+                if not isinstance(sub, ast.Call):
+                    continue
+                f = sub.func
+                # server.register("method", <ref>)
+                if (isinstance(f, ast.Attribute) and f.attr == "register"
+                        and len(sub.args) >= 2):
+                    self._note_handler(sub.args[1], cls)
+            # self._handlers["x"] = <ref>
+            for sub in ast.walk(fn):
+                if (isinstance(sub, ast.Assign)
+                        and isinstance(sub.targets[0], ast.Subscript)):
+                    base = sub.targets[0].value
+                    if (isinstance(base, ast.Attribute)
+                            and base.attr == "_handlers"):
+                        self._note_handler(sub.value, cls)
+
+    def _note_handler(self, ref: ast.AST, cls: str):
+        if isinstance(ref, ast.Attribute) and isinstance(
+                ref.value, ast.Name) and ref.value.id == "self":
+            if (cls, ref.attr) in self.functions:
+                self.handlers.append((cls, ref.attr))
+        elif isinstance(ref, ast.Name):
+            if ("", ref.id) in self.functions:
+                self.handlers.append(("", ref.id))
+            elif (_first_class_with(self, ref.id)) is not None:
+                self.handlers.append((_first_class_with(self, ref.id),
+                                      ref.id))
+
+    def callees(self, key: Tuple[str, str]) -> Set[Tuple[str, str]]:
+        cls, _ = key
+        fn = self.functions[key]
+        out: Set[Tuple[str, str]] = set()
+        for sub in ast.walk(fn):
+            if not isinstance(sub, ast.Call):
+                continue
+            f = sub.func
+            if (isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "self"
+                    and (cls, f.attr) in self.functions):
+                out.add((cls, f.attr))
+            elif isinstance(f, ast.Name) and ("", f.id) in self.functions:
+                out.add(("", f.id))
+        return out
+
+
+def _first_class_with(idx: "_ModuleIndex", fname: str) -> Optional[str]:
+    for (cls, name) in idx.functions:
+        if name == fname and cls:
+            return cls
+    return None
+
+
+def _has_deadline_discipline(fn: ast.AST) -> bool:
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Name) and "deadline" in sub.id.lower():
+            return True
+        if isinstance(sub, ast.Attribute) and "deadline" in sub.attr.lower():
+            return True
+    return False
+
+
+def _blocking_sites(fn: ast.AST) -> List[Tuple[int, str]]:
+    sites: List[Tuple[int, str]] = []
+    for sub in ast.walk(fn):
+        if not isinstance(sub, ast.Call):
+            continue
+        f = sub.func
+        if isinstance(f, ast.Attribute):
+            if f.attr == "sleep":
+                sites.append((sub.lineno, "time.sleep"))
+            elif f.attr == "create_connection":
+                if not any(kw.arg == "timeout" for kw in sub.keywords) \
+                        and len(sub.args) < 2:
+                    sites.append((sub.lineno,
+                                  "socket.create_connection without "
+                                  "timeout"))
+            elif f.attr == "settimeout":
+                if (sub.args and isinstance(sub.args[0], ast.Constant)
+                        and sub.args[0].value is None):
+                    sites.append((sub.lineno, "settimeout(None)"))
+            elif f.attr == "wait" and not sub.args and not sub.keywords:
+                sites.append((sub.lineno, "unbounded .wait()"))
+    return sites
+
+
+def run(files: List[ParsedFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    for pf in files:
+        idx = _ModuleIndex(pf)
+        if not idx.handlers:
+            continue
+        # BFS from each handler root
+        for root in idx.handlers:
+            seen: Set[Tuple[str, str]] = set()
+            frontier = [root]
+            while frontier:
+                key = frontier.pop()
+                if key in seen:
+                    continue
+                seen.add(key)
+                fn = idx.functions[key]
+                if _has_deadline_discipline(fn):
+                    # bounded by construction; don't traverse further
+                    # from here either (its callees run under its budget)
+                    continue
+                for line, what in _blocking_sites(fn):
+                    cls, fname = key
+                    rcls, rname = root
+                    sym = f"{cls + '.' if cls else ''}{fname}"
+                    rsym = f"{rcls + '.' if rcls else ''}{rname}"
+                    findings.append(Finding(
+                        PASS_ID, pf.relpath, line, sym,
+                        f"{what} reachable from RPC handler {rsym} "
+                        "with no deadline bound — a parked handler "
+                        "head-of-line blocks the connection"))
+                frontier.extend(idx.callees(key) - seen)
+    # one finding per (path,line,message) even when multiple handlers reach it
+    uniq = {}
+    for f in findings:
+        uniq.setdefault((f.path, f.line, f.message.split(" reachable")[0],
+                         f.symbol), f)
+    return list(uniq.values())

@@ -1,0 +1,247 @@
+"""persialint core: findings, fingerprints, baseline, suppressions, runner.
+
+Design notes:
+
+- A finding's **fingerprint** deliberately excludes the line number:
+  baselined findings must survive unrelated edits above them. It hashes
+  (pass, repo-relative path, symbol, message), so a baselined finding
+  "moves" with its function/class, and editing the offending code in a
+  way that changes the message re-surfaces it.
+- The **baseline** is the reviewed debt ledger: every entry carries a
+  human justification (enforced — an empty or TODO justification is
+  itself an error), and entries that no longer match any finding are
+  STALE and fail the run, so the ledger only ratchets down.
+- **Inline suppressions** (``# persialint: ok[pass-id] reason``) are for
+  point false-positives where the code itself is the best place to
+  record why; the reason is mandatory there too.
+"""
+
+import ast
+import hashlib
+import json
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, "tools", "persialint",
+                                "baseline.json")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*persialint:\s*ok\[([a-z0-9-]+)\]\s*(.*)")
+
+
+@dataclass
+class Finding:
+    pass_id: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    symbol: str  # "Class.method", "module", "<knob NAME>", ...
+    message: str
+
+    @property
+    def fingerprint(self) -> str:
+        key = "|".join((self.pass_id, self.path, self.symbol, self.message))
+        return hashlib.sha1(key.encode()).hexdigest()[:16]
+
+    def to_dict(self) -> Dict:
+        return {"pass": self.pass_id, "path": self.path, "line": self.line,
+                "symbol": self.symbol, "message": self.message,
+                "fingerprint": self.fingerprint}
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.pass_id}] "
+                f"{self.symbol}: {self.message}")
+
+
+@dataclass
+class LintResult:
+    new: List[Finding] = field(default_factory=list)
+    baselined: List[Finding] = field(default_factory=list)
+    suppressed: List[Finding] = field(default_factory=list)
+    stale_baseline: List[Dict] = field(default_factory=list)
+    baseline_errors: List[str] = field(default_factory=list)
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if (self.new or self.stale_baseline
+                     or self.baseline_errors) else 0
+
+
+class ParsedFile:
+    """One source file, parsed once and shared by every pass."""
+
+    def __init__(self, abspath: str, relpath: str):
+        self.abspath = abspath
+        self.relpath = relpath.replace(os.sep, "/")
+        with open(abspath, "r", encoding="utf-8") as f:
+            self.source = f.read()
+        self.lines = self.source.splitlines()
+        self.tree = ast.parse(self.source, filename=relpath)
+        # line -> (pass_id, reason) for inline suppressions
+        self.suppressions: Dict[int, Tuple[str, str]] = {}
+        for i, text in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(text)
+            if m:
+                self.suppressions[i] = (m.group(1), m.group(2).strip())
+
+    def suppressed(self, finding: Finding) -> bool:
+        """A finding is suppressed by an ok-comment on its own line or
+        the line directly above, naming its pass, with a reason."""
+        for ln in (finding.line, finding.line - 1):
+            sup = self.suppressions.get(ln)
+            if sup and sup[0] == finding.pass_id and sup[1]:
+                return True
+        return False
+
+
+def collect_files(paths: Iterable[str],
+                  repo_root: str = REPO_ROOT) -> List[ParsedFile]:
+    out: List[ParsedFile] = []
+    seen = set()
+    for p in paths:
+        ap = os.path.abspath(p)
+        if os.path.isdir(ap):
+            for dirpath, dirnames, filenames in os.walk(ap):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git")]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        fp = os.path.join(dirpath, fn)
+                        if fp not in seen:
+                            seen.add(fp)
+                            out.append(_parse_one(fp, repo_root))
+        elif ap.endswith(".py"):
+            if ap not in seen:
+                seen.add(ap)
+                out.append(_parse_one(ap, repo_root))
+    return out
+
+
+def _parse_one(abspath: str, repo_root: str) -> ParsedFile:
+    rel = os.path.relpath(abspath, repo_root)
+    return ParsedFile(abspath, rel)
+
+
+# --- baseline -------------------------------------------------------------
+
+def load_baseline(path: str) -> Tuple[List[Dict], List[str]]:
+    """Returns (entries, errors). Hygiene is checked here: every entry
+    needs a fingerprint and a non-placeholder justification."""
+    if not os.path.exists(path):
+        return [], []
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    entries = doc.get("entries", [])
+    errors = []
+    seen = set()
+    for i, e in enumerate(entries):
+        fp = e.get("fingerprint")
+        just = (e.get("justification") or "").strip()
+        if not fp:
+            errors.append(f"baseline entry #{i} has no fingerprint")
+            continue
+        if fp in seen:
+            errors.append(f"baseline entry #{i} duplicates fingerprint {fp}")
+        seen.add(fp)
+        if not just or just.upper().startswith("TODO"):
+            errors.append(
+                f"baseline entry {fp} ({e.get('symbol', '?')}) has no "
+                "justification — every suppression must say why it is safe")
+    return entries, errors
+
+
+def write_baseline(path: str, findings: List[Finding]):
+    entries = [{
+        "fingerprint": f.fingerprint,
+        "pass": f.pass_id,
+        "path": f.path,
+        "symbol": f.symbol,
+        "message": f.message,
+        "justification": "TODO: justify or fix",
+    } for f in sorted(findings, key=lambda f: (f.path, f.line))]
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"entries": entries}, fh, indent=2)
+        fh.write("\n")
+
+
+# --- runner ---------------------------------------------------------------
+
+def run_lint(paths: Iterable[str], baseline_path: Optional[str] = None,
+             check_knob_docs: bool = False,
+             repo_root: str = REPO_ROOT,
+             tests_dir: Optional[str] = None,
+             rpc_path: Optional[str] = None) -> LintResult:
+    """Run every pass over ``paths`` and split findings against the
+    baseline. ``tests_dir``/``rpc_path`` exist so fixture tests can
+    point the wire pass at a synthetic tree."""
+    from tools.persialint import (blocking_pass, knob_pass, lock_pass,
+                                  thread_pass, wire_pass)
+
+    files = collect_files(paths, repo_root)
+    findings: List[Finding] = []
+    findings += lock_pass.run(files)
+    findings += thread_pass.run(files)
+    findings += wire_pass.run(
+        files,
+        rpc_path=rpc_path or os.path.join(repo_root, "persia_tpu", "rpc.py"),
+        tests_dir=tests_dir or os.path.join(repo_root, "tests"),
+        repo_root=repo_root)
+    findings += knob_pass.run(files, repo_root=repo_root,
+                              check_docs=check_knob_docs)
+    findings += blocking_pass.run(files)
+
+    by_path = {f.relpath: f for f in files}
+    result = LintResult()
+    entries, errors = ([], []) if baseline_path is None else load_baseline(
+        baseline_path)
+    result.baseline_errors = errors
+    baseline_fps = {e["fingerprint"]: e for e in entries if "fingerprint"
+                    in e}
+    matched = set()
+    for f in sorted(findings, key=lambda f: (f.path, f.line)):
+        pf = by_path.get(f.path)
+        if pf is not None and pf.suppressed(f):
+            result.suppressed.append(f)
+        elif f.fingerprint in baseline_fps:
+            matched.add(f.fingerprint)
+            result.baselined.append(f)
+        else:
+            result.new.append(f)
+    result.stale_baseline = [e for fp, e in baseline_fps.items()
+                             if fp not in matched]
+    return result
+
+
+def render_human(result: LintResult, stream=None):
+    stream = stream or sys.stdout
+    w = stream.write
+    for f in result.new:
+        w(f.render() + "\n")
+    for e in result.stale_baseline:
+        w(f"STALE baseline entry {e['fingerprint']} "
+          f"({e.get('path', '?')} {e.get('symbol', '?')}): the finding it "
+          "suppressed is gone — remove the entry (the ledger only "
+          "ratchets down)\n")
+    for msg in result.baseline_errors:
+        w(f"BASELINE ERROR: {msg}\n")
+    w(f"persialint: {len(result.new)} new finding(s), "
+      f"{len(result.baselined)} baselined (justified suppressions), "
+      f"{len(result.suppressed)} inline-suppressed, "
+      f"{len(result.stale_baseline)} stale baseline entr(ies)\n")
+
+
+def render_json(result: LintResult, stream=None):
+    stream = stream or sys.stdout
+    json.dump({
+        "new": [f.to_dict() for f in result.new],
+        "baselined": [f.to_dict() for f in result.baselined],
+        "suppressed": [f.to_dict() for f in result.suppressed],
+        "stale_baseline": result.stale_baseline,
+        "baseline_errors": result.baseline_errors,
+        "exit_code": result.exit_code,
+    }, stream, indent=2)
+    stream.write("\n")

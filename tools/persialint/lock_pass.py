@@ -1,0 +1,224 @@
+"""Pass 1 — lock-discipline / race detection.
+
+Per class that OWNS a lock (``self.X = threading.Lock()/RLock()/
+Condition()``, or a list of locks), infer the guarded attribute set:
+every ``self.Y`` mutated anywhere inside a ``with self.X:`` block.
+Then flag:
+
+- ``mutation-outside-lock``: any mutation of a guarded attribute
+  outside every lock (plain assign, augmented assign, subscript store,
+  or a mutating method call like ``.append``/``.pop``);
+- ``rmw-outside-lock``: a compound read-modify-write (``self.n += 1``
+  or ``self.n = self.n + ...``) of ANY attribute outside every lock in
+  a lock-owning class — the lost-increment shape, racy even when the
+  attribute never appears under a lock (that is exactly how the
+  ``inc_update._seq`` duplicate-packet bug survived six PRs).
+
+Conventions honored (these are the codebase's, not invented here):
+
+- ``__init__``/``__del__``/``__enter__`` run before/after the object is
+  shared — exempt;
+- methods whose name ends in ``_locked`` document "caller holds the
+  lock" — their bodies count as locked;
+- a ``with`` on ``self._lock``, ``self._cond``, a subscripted
+  ``self._locks[i]``, or any attribute assigned a Lock/RLock/Condition
+  counts as holding a lock. Nested functions inherit the analysis of
+  their enclosing method (a closure mutating under the method's lock
+  is locked).
+"""
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from tools.persialint.core import Finding, ParsedFile
+
+PASS_ID = "lock-discipline"
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+_MUTATING_METHODS = {
+    "append", "appendleft", "add", "update", "pop", "popleft", "popitem",
+    "clear", "extend", "extendleft", "remove", "discard", "insert",
+    "setdefault", "rotate",
+}
+_EXEMPT_METHODS = {"__init__", "__del__", "__enter__", "__new__",
+                   "__post_init__"}
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    """threading.Lock() / Lock() / threading.Condition() ... including
+    list-of-locks comprehensions and literals."""
+    if isinstance(node, ast.Call):
+        fn = node.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else None)
+        return name in _LOCK_CTORS
+    if isinstance(node, (ast.ListComp, ast.GeneratorExp)):
+        return _is_lock_ctor(node.elt)
+    if isinstance(node, (ast.List, ast.Tuple)):
+        return any(_is_lock_ctor(e) for e in node.elts)
+    return False
+
+
+def _self_attr(node: ast.AST):
+    """'Y' when node is `self.Y`, else None."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _with_lock_attrs(item: ast.withitem, lock_attrs: Set[str]) -> bool:
+    """True when the with-item acquires one of the class's locks:
+    `with self.X:` or `with self.X[i]:` (per-shard lock lists)."""
+    expr = item.context_expr
+    if isinstance(expr, ast.Subscript):
+        expr = expr.value
+    attr = _self_attr(expr)
+    return attr is not None and attr in lock_attrs
+
+
+class _Mutation:
+    __slots__ = ("attr", "line", "locked", "rmw", "method")
+
+    def __init__(self, attr, line, locked, rmw, method):
+        self.attr = attr
+        self.line = line
+        self.locked = locked
+        self.rmw = rmw
+        self.method = method
+
+
+def _reads_self_attr(expr: ast.AST, attr: str) -> bool:
+    for node in ast.walk(expr):
+        if _self_attr(node) == attr and isinstance(getattr(
+                node, "ctx", None), ast.Load):
+            return True
+    return False
+
+
+def _collect_mutations(fn: ast.AST, method_name: str, lock_attrs: Set[str],
+                       start_locked: bool) -> List[_Mutation]:
+    muts: List[_Mutation] = []
+
+    def visit(node, locked):
+        if isinstance(node, ast.With):
+            inner = locked or any(_with_lock_attrs(i, lock_attrs)
+                                  for i in node.items)
+            for child in node.body:
+                visit(child, inner)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested function: analyzed in the lexical lock context of
+            # its definition site (thread targets defined inside a
+            # locked block are rare; defined unlocked is the norm)
+            for child in node.body:
+                visit(child, locked)
+            return
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                _record_target(tgt, node, locked)
+        elif isinstance(node, ast.AugAssign):
+            attr = _self_attr(node.target)
+            if attr is not None:
+                muts.append(_Mutation(attr, node.lineno, locked, True,
+                                      method_name))
+            elif (isinstance(node.target, ast.Subscript)):
+                base = _self_attr(node.target.value)
+                if base is not None:
+                    muts.append(_Mutation(base, node.lineno, locked, True,
+                                          method_name))
+        elif isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript):
+                    base = _self_attr(tgt.value)
+                    if base is not None:
+                        muts.append(_Mutation(base, tgt.lineno, locked,
+                                              False, method_name))
+        elif isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+            call = node.value
+            if isinstance(call.func, ast.Attribute):
+                base = _self_attr(call.func.value)
+                if base is not None and call.func.attr in _MUTATING_METHODS:
+                    muts.append(_Mutation(base, node.lineno, locked, False,
+                                          method_name))
+        # recurse into every child except lambdas (their bodies run at
+        # call time, under whatever lock the CALLER holds)
+        for child in ast.iter_child_nodes(node):
+            if not isinstance(child, ast.Lambda):
+                visit(child, locked)
+
+    def _record_target(tgt, assign_node, locked):
+        attr = _self_attr(tgt)
+        if attr is not None:
+            rmw = _reads_self_attr(assign_node.value, attr)
+            muts.append(_Mutation(attr, assign_node.lineno, locked, rmw,
+                                  method_name))
+        elif isinstance(tgt, ast.Subscript):
+            base = _self_attr(tgt.value)
+            if base is not None:
+                muts.append(_Mutation(base, assign_node.lineno, locked,
+                                      False, method_name))
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                _record_target(el, assign_node, locked)
+
+    for stmt in fn.body:
+        visit(stmt, start_locked)
+    return muts
+
+
+def _analyze_class(pf: ParsedFile, cls: ast.ClassDef) -> List[Finding]:
+    # 1. find the class's lock attributes
+    lock_attrs: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+            for tgt in node.targets:
+                attr = _self_attr(tgt)
+                if attr is not None:
+                    lock_attrs.add(attr)
+    if not lock_attrs:
+        return []
+
+    # 2. collect mutations per method
+    mutations: List[_Mutation] = []
+    for item in cls.body:
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            start_locked = item.name.endswith("_locked")
+            mutations.extend(
+                _collect_mutations(item, item.name, lock_attrs,
+                                   start_locked))
+
+    guarded: Set[str] = {
+        m.attr for m in mutations
+        if m.locked and m.attr not in lock_attrs
+    }
+
+    findings: List[Finding] = []
+    for m in mutations:
+        if (m.locked or m.method in _EXEMPT_METHODS
+                or m.method.endswith("_locked")
+                or m.attr in lock_attrs):
+            continue
+        symbol = f"{cls.name}.{m.method}"
+        if m.attr in guarded:
+            findings.append(Finding(
+                PASS_ID, pf.relpath, m.line, symbol,
+                f"attribute 'self.{m.attr}' is mutated under a lock "
+                f"elsewhere in {cls.name} but mutated here without one"))
+        elif m.rmw:
+            findings.append(Finding(
+                PASS_ID, pf.relpath, m.line, symbol,
+                f"compound read-modify-write of 'self.{m.attr}' outside "
+                f"any lock in lock-owning class {cls.name} (lost-update "
+                "shape)"))
+    return findings
+
+
+def run(files: List[ParsedFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    for pf in files:
+        for node in ast.walk(pf.tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(_analyze_class(pf, node))
+    return findings

@@ -1,0 +1,152 @@
+"""Pass 2 — thread lifecycle.
+
+Every ``threading.Thread(...)`` (or bare ``Thread(...)``) construction
+must either:
+
+- pass ``daemon=True`` at the constructor, or
+- be stored somewhere that provably joins it: assigned to a name or
+  ``self.X`` on which ``.join(`` is called somewhere in the same
+  module, or have ``.daemon = True`` set on it before ``start()``.
+
+A non-daemon thread nobody joins outlives ``main`` silently, wedges
+interpreter shutdown, and — the ``push_loop`` precedent from the
+observability PR — keeps doing work against torn-down state. The pass
+does not try to prove the join is reached; owning a join site (or a
+stop-Event + join pair) is the contract.
+"""
+
+import ast
+from typing import List, Optional, Set
+
+from tools.persialint.core import Finding, ParsedFile
+
+PASS_ID = "thread-lifecycle"
+
+
+def _is_thread_ctor(call: ast.Call) -> bool:
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr == "Thread"
+    if isinstance(fn, ast.Name):
+        return fn.id == "Thread"
+    return False
+
+
+def _kw_true(call: ast.Call, name: str) -> bool:
+    for kw in call.keywords:
+        if kw.arg == name and isinstance(kw.value, ast.Constant):
+            return kw.value.value is True
+    return False
+
+
+def _assigned_names(parents: List[ast.AST]) -> Optional[str]:
+    """The (last) name a Thread(...) call is assigned to: 'x' for
+    `x = Thread(...)`, 'self.X' for `self._t = Thread(...)`. Handles
+    list element `[Thread(...) for ...]` by returning the list target."""
+    for node in reversed(parents):
+        if isinstance(node, ast.Assign):
+            tgt = node.targets[-1]
+            return _target_name(tgt)
+        if isinstance(node, ast.AnnAssign) and node.value is not None:
+            return _target_name(node.target)
+    return None
+
+
+def _target_name(tgt: ast.AST) -> Optional[str]:
+    if isinstance(tgt, ast.Name):
+        return tgt.id
+    if (isinstance(tgt, ast.Attribute) and isinstance(tgt.value, ast.Name)
+            and tgt.value.id == "self"):
+        return f"self.{tgt.attr}"
+    if isinstance(tgt, ast.Tuple) and tgt.elts:
+        return _target_name(tgt.elts[0])
+    return None
+
+
+def _module_sets_daemon(pf: ParsedFile, name: str) -> bool:
+    """True when `<name>.daemon = True`-style attribute store appears
+    anywhere in the module (join crediting is _any_join_in_module)."""
+    want_self = name.startswith("self.")
+    attr = name[5:] if want_self else name
+    for node in ast.walk(pf.tree):
+        if (isinstance(node, ast.Assign)
+                and isinstance(node.targets[0], ast.Attribute)
+                and node.targets[0].attr == "daemon"):
+            if _matches(node.targets[0].value, want_self, attr):
+                return True
+    return False
+
+
+def _matches(base: ast.AST, want_self: bool, attr: str) -> bool:
+    if want_self:
+        return (isinstance(base, ast.Attribute) and base.attr == attr
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self")
+    return isinstance(base, ast.Name) and base.id == attr
+
+
+def _any_join_in_module(pf: ParsedFile) -> Set[str]:
+    """All X such that `X.join(` or `for t in X: t.join()` appears."""
+    joined: Set[str] = set()
+    for node in ast.walk(pf.tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "join"):
+            base = node.func.value
+            nm = _target_name(base) if not isinstance(base, ast.Subscript) \
+                else _target_name(base.value)
+            if nm:
+                joined.add(nm)
+    # `for t in self._threads: t.join()` — credit the iterable
+    for node in ast.walk(pf.tree):
+        if isinstance(node, ast.For):
+            loop_var = _target_name(node.target)
+            it = node.iter
+            it_name = _target_name(it) if not isinstance(it, ast.Call) \
+                else None
+            if loop_var and it_name:
+                for sub in ast.walk(node):
+                    if (isinstance(sub, ast.Call)
+                            and isinstance(sub.func, ast.Attribute)
+                            and sub.func.attr == "join"
+                            and _target_name(sub.func.value) == loop_var):
+                        joined.add(it_name)
+    return joined
+
+
+def run(files: List[ParsedFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    for pf in files:
+        joined = _any_join_in_module(pf)
+        # walk with parent tracking for assignment context
+        stack: List[ast.AST] = []
+
+        def visit(node):
+            stack.append(node)
+            if isinstance(node, ast.Call) and _is_thread_ctor(node):
+                if not _kw_true(node, "daemon"):
+                    name = _assigned_names(stack)
+                    ok = False
+                    if name:
+                        ok = (name in joined
+                              or _module_sets_daemon(pf, name))
+                    if not ok:
+                        findings.append(Finding(
+                            PASS_ID, pf.relpath, node.lineno,
+                            _enclosing_symbol(stack),
+                            "threading.Thread without daemon=True and "
+                            "without a join/stop owner in this module "
+                            f"(stored as {name or 'an unretained temp'})"))
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            stack.pop()
+
+        visit(pf.tree)
+    return findings
+
+
+def _enclosing_symbol(stack: List[ast.AST]) -> str:
+    names = [n.name for n in stack
+             if isinstance(n, (ast.ClassDef, ast.FunctionDef,
+                               ast.AsyncFunctionDef))]
+    return ".".join(names) if names else "module"

@@ -1,0 +1,196 @@
+"""Pass 3 — wire-protocol extension discipline.
+
+The RPC envelope's opt-in extensions (``__tags__``, ``__trace__``,
+``__deadline__``, ``__codec__``, plus the ``__faults__`` control
+method) all follow one pattern: client probes at dial time, server
+refuses unknown probes with "no such method" so legacy peers negotiate
+down, and the OFF wire stays byte-identical (pinned by
+served-request-count tests). This pass makes the pattern a checked
+rule for every ``__x__`` literal used as an RPC method anywhere in the
+tree:
+
+- ``undeclared-extension``: the name is not a key of
+  ``rpc.ENVELOPE_EXTENSIONS`` (the server refusal table);
+- ``no-negotiate-down``: for ``envelope``-kind extensions, rpc.py has
+  no client path that tolerates refusal (an occurrence inside a
+  function that checks the ``"ok"`` envelope or catches the error);
+- ``no-wire-pin-test``: the name appears in no file under ``tests/``
+  — nothing pins the byte-identical-when-off contract.
+"""
+
+import ast
+import os
+import re
+from typing import Dict, List, Set
+
+from tools.persialint.core import Finding, ParsedFile
+
+PASS_ID = "wire-protocol"
+
+_DUNDER_RE = re.compile(r"^__[a-z0-9_]+__$")
+# dunder strings that are Python machinery, not wire methods
+_PY_DUNDERS = {"__main__", "__name__", "__file__", "__doc__", "__dict__",
+               "__init__", "__all__", "__version__", "__class__",
+               "__module__", "__qualname__", "__slots__", "__path__",
+               "__spec__", "__loader__", "__package__", "__builtins__"}
+
+_RPC_CALL_METHODS = {"call", "call_msg", "call_future", "register"}
+
+
+def _probe_literals(pf: ParsedFile) -> List:
+    """(name, line) for every dunder string used as an RPC method:
+    first arg to .call/.call_msg/.call_future/.register, a _handlers
+    subscript, a `method == "__x__"` compare, or an element of a
+    ["__x__"] envelope list passed to a send function."""
+    out = []
+    for node in ast.walk(pf.tree):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if (isinstance(fn, ast.Attribute)
+                    and fn.attr in _RPC_CALL_METHODS and node.args):
+                name = _const_str(node.args[0])
+                if name:
+                    out.append((name, node.args[0].lineno))
+            # _send_msg(sock, ["__x__"], ...) — envelope-list probes
+            if (isinstance(fn, ast.Name) and fn.id.startswith("_send")
+                    and node.args):
+                for arg in node.args:
+                    if isinstance(arg, ast.List) and arg.elts:
+                        name = _const_str(arg.elts[0])
+                        if name:
+                            out.append((name, arg.lineno))
+        elif isinstance(node, ast.Subscript):
+            base = node.value
+            if (isinstance(base, ast.Attribute)
+                    and base.attr == "_handlers"):
+                name = _const_str(node.slice)
+                if name:
+                    out.append((name, node.lineno))
+        elif isinstance(node, ast.Compare) and node.comparators:
+            name = _const_str(node.comparators[0])
+            if name:
+                out.append((name, node.lineno))
+    return [(n, ln) for n, ln in out
+            if _DUNDER_RE.match(n) and n not in _PY_DUNDERS]
+
+
+def _const_str(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _parse_extension_table(rpc_path: str) -> Dict[str, str]:
+    """name -> kind from the ENVELOPE_EXTENSIONS dict literal in
+    rpc.py. Empty dict when the table is missing entirely (every probe
+    then reports undeclared, which is the right failure mode)."""
+    try:
+        with open(rpc_path, "r", encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        return {}
+    for node in ast.walk(tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+            value = node.value
+        else:
+            continue
+        for tgt in targets:
+            if (isinstance(tgt, ast.Name)
+                    and tgt.id == "ENVELOPE_EXTENSIONS"
+                    and isinstance(value, ast.Dict)):
+                table = {}
+                for k, v in zip(value.keys, value.values):
+                    name = _const_str(k)
+                    kind = "envelope"
+                    if isinstance(v, ast.Dict):
+                        for vk, vv in zip(v.keys, v.values):
+                            if _const_str(vk) == "kind":
+                                kind = _const_str(vv) or "envelope"
+                    if name:
+                        table[name] = kind
+                return table
+    return {}
+
+
+def _negotiate_down_names(rpc_path: str) -> Set[str]:
+    """Extension names that occur inside an rpc.py function which also
+    checks an "ok" envelope or catches an exception — the client's
+    tolerate-refusal path."""
+    try:
+        with open(rpc_path, "r", encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        return set()
+    ok_names: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        tolerant = False
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Try):
+                tolerant = True
+            if (isinstance(sub, ast.Compare) and sub.comparators
+                    and _const_str(sub.comparators[0]) == "ok"):
+                tolerant = True
+        if not tolerant:
+            continue
+        for sub in ast.walk(node):
+            s = _const_str(sub) if isinstance(sub, ast.Constant) else None
+            if s and _DUNDER_RE.match(s) and s not in _PY_DUNDERS:
+                ok_names.add(s)
+    return ok_names
+
+
+def _tests_mentioning(tests_dir: str) -> str:
+    chunks = []
+    if os.path.isdir(tests_dir):
+        for fn in sorted(os.listdir(tests_dir)):
+            if fn.endswith(".py"):
+                try:
+                    with open(os.path.join(tests_dir, fn), "r",
+                              encoding="utf-8") as f:
+                        chunks.append(f.read())
+                except OSError:
+                    pass
+    return "\n".join(chunks)
+
+
+def run(files: List[ParsedFile], rpc_path: str, tests_dir: str,
+        repo_root: str) -> List[Finding]:
+    table = _parse_extension_table(rpc_path)
+    negotiated = _negotiate_down_names(rpc_path)
+    tests_blob = _tests_mentioning(tests_dir)
+
+    findings: List[Finding] = []
+    seen_per_name: Dict[str, List] = {}
+    for pf in files:
+        for name, line in _probe_literals(pf):
+            seen_per_name.setdefault(name, []).append((pf, line))
+
+    for name, sites in sorted(seen_per_name.items()):
+        pf, line = sites[0]
+        if name not in table:
+            for spf, sline in sites:
+                findings.append(Finding(
+                    PASS_ID, spf.relpath, sline, f"<extension {name}>",
+                    f"dunder RPC method {name} is not declared in "
+                    "rpc.ENVELOPE_EXTENSIONS (the server refusal "
+                    "table)"))
+            continue
+        if table[name] == "envelope" and name not in negotiated:
+            findings.append(Finding(
+                PASS_ID, pf.relpath, line, f"<extension {name}>",
+                f"envelope extension {name} has no negotiate-down "
+                "client path in rpc.py (no refusal-tolerant probe)"))
+        if name not in tests_blob:
+            findings.append(Finding(
+                PASS_ID, pf.relpath, line, f"<extension {name}>",
+                f"wire extension {name} appears in no test under "
+                "tests/ — nothing pins its byte-identical-when-off "
+                "contract"))
+    return findings
