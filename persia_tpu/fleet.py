@@ -549,6 +549,41 @@ class FleetMonitor:
                             "n_spans": len(merged)}
         return doc
 
+    def fleet_hotness(self, hbm_bytes: Optional[int] = None) -> Dict:
+        """Cross-shard workload-hotness merge: pull every up target's
+        ``/hotness?full=1`` snapshot (disabled/absent targets
+        contribute nothing), merge them exactly — totals equal the sum
+        of per-shard snapshots, Space-Saving counts add, count-min
+        cells add, HLL registers max — then render per-table zipfian
+        fits, coverage curves ("top p% of rows serve q% of lookups"),
+        and, when an HBM budget is named, the frequency-admission
+        capacity plan for the device-cache tier ladder (ROADMAP item
+        2). Pull-only like every other fleet view: zero requests on
+        the RPC plane."""
+        from persia_tpu import hotness as _hotness
+
+        snaps = []
+        scraped = []
+        for t in self.targets():
+            if not t.up:
+                continue
+            try:
+                doc = json.loads(_http_get(
+                    f"http://{t.http_addr}/hotness?full=1",
+                    self.scrape_timeout).decode())
+            except Exception as e:
+                _logger.debug("fleet hotness scrape of %s failed: %s",
+                              t.service, e)
+                continue
+            if doc.get("enabled"):
+                snaps.append(doc)
+                scraped.append({"service": t.service,
+                                "total": int(doc.get("total", 0))})
+        merged = _hotness.merge_snapshots(snaps)
+        report = _hotness.fleet_report(merged, hbm_bytes=hbm_bytes)
+        report["sources"] = scraped
+        return report
+
     def alerts(self, firing_only: bool = False) -> List[Dict]:
         return self.engine.alerts(firing_only=firing_only)
 
@@ -596,6 +631,13 @@ class FleetHttpServer:
                     elif url.path == "/fleet/breaches":
                         body = json.dumps(
                             mon.engine.breach_events()).encode()
+                    elif url.path == "/fleet/hotness":
+                        # ?hbm_gb= names the device-tier budget the
+                        # capacity planner sizes against
+                        hbm_gb = q.get("hbm_gb", [None])[0]
+                        body = json.dumps(mon.fleet_hotness(
+                            hbm_bytes=(int(float(hbm_gb) * (1 << 30))
+                                       if hbm_gb else None))).encode()
                     elif url.path == "/healthz":
                         doc = mon.fleet_status()["fleet_monitor"]
                         doc.update({"status": "ok", "ready": True,

@@ -141,6 +141,47 @@ class IncrementalUpdateLoader:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.last_delay_sec: float = 0.0
+        self.packets_applied: int = 0
+        # Serving-freshness observables: last_delay_sec existed but was
+        # never exported — it now rides the registry as a gauge, and the
+        # per-packet sign-to-servable age (apply time minus the packet's
+        # dump timestamp) lands in an age-shaped histogram, so "how
+        # stale is serving" is a distribution, not one scan-time point.
+        from persia_tpu.metrics import (AGE_BUCKETS, COUNT_BUCKETS,
+                                        default_registry)
+
+        reg = default_registry()
+        self._g_delay = reg.gauge(
+            "inc_update_last_delay_sec",
+            help_text="age of the newest applied incremental packet at "
+                      "its apply time (train->serve sync delay)")
+        # The STALL signal: last_delay_sec freezes at its last healthy
+        # value when packets stop arriving (it is only written on
+        # apply), so detecting a dead sync loop needs a clock that
+        # keeps running — seconds since the last apply (or since this
+        # loader armed, so a dumper dead from boot also trips it),
+        # refreshed on EVERY scan whether or not anything applied.
+        self._t_last_apply = time.monotonic()
+        self._g_since_apply = reg.gauge(
+            "inc_update_sec_since_last_apply",
+            help_text="seconds since this loader last applied a packet "
+                      "(or since it started) — keeps rising while the "
+                      "train->serve sync loop is stalled")
+        self._h_freshness = reg.histogram(
+            "inc_update_freshness_lag_sec",
+            help_text="per-packet sign-to-servable age: packet dump "
+                      "timestamp to its apply completing",
+            buckets=AGE_BUCKETS)
+        self._h_entries = reg.histogram(
+            "inc_update_packet_entries",
+            help_text="entries loaded per applied incremental packet",
+            buckets=COUNT_BUCKETS)
+        self._c_packets = reg.counter(
+            "inc_update_packets_applied_total",
+            help_text="incremental packets applied by this loader")
+        self._c_entries = reg.counter(
+            "inc_update_entries_applied_total",
+            help_text="entries hot-loaded from incremental packets")
 
     def scan_once(self) -> int:
         """Apply any unapplied complete packets; returns entries loaded."""
@@ -157,6 +198,7 @@ class IncrementalUpdateLoader:
                 continue
             with open(marker) as f:
                 info = json.load(f)
+            pkt_loaded = 0
             for fn in sorted(os.listdir(pkt_dir)):
                 if not fn.endswith(".inc"):
                     continue
@@ -166,10 +208,27 @@ class IncrementalUpdateLoader:
                 for sign, dim, vec in iter_psd_entries(
                         os.path.join(pkt_dir, fn)):
                     self.holder.set_entry(sign, dim, vec)
-                    loaded += 1
+                    pkt_loaded += 1
+            loaded += pkt_loaded
             self._applied.add(name)
+            # freshness lag measured when the packet's rows are
+            # SERVABLE (apply done), against its dump timestamp —
+            # the per-packet distribution; last_delay_sec stays the
+            # scan-time scalar callers already read
             self.last_delay_sec = max(0.0, time.time() - info["time"])
+            self.packets_applied += 1
+            self._h_freshness.observe(self.last_delay_sec)
+            self._h_entries.observe(pkt_loaded)
+            self._c_packets.inc()
+            self._c_entries.inc(pkt_loaded)
+            self._g_delay.set(self.last_delay_sec)
+            self._t_last_apply = time.monotonic()
+        self._g_since_apply.set(self.sec_since_last_apply)
         return loaded
+
+    @property
+    def sec_since_last_apply(self) -> float:
+        return max(0.0, time.monotonic() - self._t_last_apply)
 
     def start(self):
         def run():

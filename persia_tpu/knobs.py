@@ -96,6 +96,24 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
     _k("PERSIA_FORCE_PYTHON_PS", "bool", False,
        "Skip the native embedding store and use the Python holder "
        "(required for fp16/bf16 row storage)."),
+    _k("PERSIA_HOTNESS", "bool", False,
+       "Workload telemetry: arm per-table hotness sketches "
+       "(Space-Saving top-K + count-min + HLL, per internal shard) on "
+       "the PS lookup path, the `hotness` RPC / `/hotness` sidecar "
+       "endpoint, and the negotiated gradient-staleness meta rider on "
+       "the PS wire. Off (the default) keeps the wire byte-identical "
+       "and the lookup path at one pointer test of overhead."),
+    _k("PERSIA_HOTNESS_CM_DEPTH", "int", 4,
+       "Count-min sketch depth (hash rows) per (table, shard) hotness "
+       "cell."),
+    _k("PERSIA_HOTNESS_CM_WIDTH", "int", 8192,
+       "Count-min sketch width (cells per row) per (table, shard) "
+       "hotness cell; the frequency upper-bound error scales as "
+       "~total/width."),
+    _k("PERSIA_HOTNESS_TOPK", "int", 512,
+       "Space-Saving summary size per (table, internal shard); a "
+       "replica's merged per-table top-K holds up to "
+       "num_internal_shards * this many rows."),
     _k("PERSIA_HTTP_PORT", "int", 0,
        "Default observability sidecar port for the service binaries "
        "(0 = ephemeral, -1 = disabled)."),

@@ -9,9 +9,10 @@ interval; scrapers pull ``render()`` through the HTTP sidecar
 in-process.
 """
 
+import bisect
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from persia_tpu.env import get_metrics_gateway_addr
 from persia_tpu.logger import get_default_logger
@@ -61,7 +62,14 @@ class Gauge:
 
 
 class Histogram:
-    """Fixed-bucket histogram (Prometheus cumulative convention)."""
+    """Fixed-bucket histogram (Prometheus cumulative convention).
+
+    ``DEFAULT_BUCKETS`` suit sub-second latencies; pass purpose-shaped
+    boundaries for anything else — ``STEP_BUCKETS`` for staleness
+    measured in steps, ``AGE_BUCKETS`` for freshness lags in seconds,
+    ``COUNT_BUCKETS`` for size/count distributions. Mis-shaped buckets
+    collapse every observation into the overflow cell and make p99
+    read as the top bound forever."""
 
     DEFAULT_BUCKETS = (
         0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
@@ -70,20 +78,23 @@ class Histogram:
 
     def __init__(self, buckets=DEFAULT_BUCKETS):
         self.buckets = tuple(buckets)
+        if list(self.buckets) != sorted(set(self.buckets)):
+            raise ValueError("histogram buckets must be strictly "
+                             "increasing")
         self._counts = [0] * (len(self.buckets) + 1)
         self._sum = 0.0
         self._total = 0
         self._lock = threading.Lock()
 
     def observe(self, v: float):
+        # bisect_left finds the first boundary >= v (the `v <= b`
+        # bucket) in O(log n) — the old linear scan held the lock for
+        # the full boundary walk on every overflow-bucket observation
+        i = bisect.bisect_left(self.buckets, v)
         with self._lock:
             self._sum += v
             self._total += 1
-            for i, b in enumerate(self.buckets):
-                if v <= b:
-                    self._counts[i] += 1
-                    return
-            self._counts[-1] += 1
+            self._counts[i] += 1
 
     def timer(self):
         return _Timer(self)
@@ -137,6 +148,21 @@ class Histogram:
         return self.buckets[-1]
 
 
+# Purpose-shaped bucket sets for the repo's non-latency histograms.
+# STEP_BUCKETS: staleness measured in whole steps/update batches (the
+# async pipeline's bounded-staleness observable — sub-second latency
+# bounds would put every observation in one bucket).
+STEP_BUCKETS = (0, 1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+# AGE_BUCKETS: freshness lags in seconds (train->serve sync runs
+# seconds-to-minutes; DEFAULT_BUCKETS top out at 10s).
+AGE_BUCKETS = (0.5, 1.0, 2.5, 5.0, 15.0, 30.0, 60.0, 120.0, 300.0,
+               600.0, 1800.0, 3600.0)
+# COUNT_BUCKETS: size/count distributions (entries per packet, rows
+# per batch, sketch candidate counts) — log-spaced integers.
+COUNT_BUCKETS = (1, 2, 5, 10, 25, 50, 100, 250, 500, 1000, 2500,
+                 10_000, 50_000, 250_000, 1_000_000)
+
+
 class _Timer:
     def __init__(self, hist: Histogram):
         self.hist = hist
@@ -184,8 +210,16 @@ class MetricsRegistry:
         return self._get("gauge", name, labels, Gauge, help_text)
 
     def histogram(self, name: str, labels: Optional[Dict[str, str]] = None,
-                  help_text: Optional[str] = None) -> Histogram:
-        return self._get("histogram", name, labels, Histogram, help_text)
+                  help_text: Optional[str] = None,
+                  buckets: Optional[Sequence[float]] = None) -> Histogram:
+        """``buckets`` lets a call site shape the boundaries to the
+        quantity it observes (STEP_BUCKETS/AGE_BUCKETS/COUNT_BUCKETS
+        above). Only the first registration of a (name, labels) series
+        sizes it — every family should pass the same boundaries, or
+        the exposition's `le` sets diverge across label values."""
+        factory = (Histogram if buckets is None
+                   else (lambda: Histogram(buckets)))
+        return self._get("histogram", name, labels, factory, help_text)
 
     def render(self) -> str:
         """Prometheus text exposition format, with ``# TYPE`` (and

@@ -74,7 +74,8 @@ class ObservabilityServer:
                  registry=None, collector=None,
                  health_fn: Optional[Callable[[], Dict]] = None,
                  service: str = "persia",
-                 refresh_fn: Optional[Callable[[], None]] = None):
+                 refresh_fn: Optional[Callable[[], None]] = None,
+                 hotness_fn: Optional[Callable[[], Dict]] = None):
         if registry is None:
             from persia_tpu.metrics import default_registry
 
@@ -91,6 +92,10 @@ class ObservabilityServer:
         # scrape always sees current values without paying per-mutation
         # gauge updates on the data path
         self.refresh_fn = refresh_fn
+        # returns the service's workload-hotness snapshot
+        # (persia_tpu.hotness format); None = this service has no
+        # hotness source and /hotness answers the disabled marker
+        self.hotness_fn = hotness_fn
         self.service = service
         self._t0 = time.monotonic()
         sidecar = self
@@ -149,6 +154,12 @@ class ObservabilityServer:
                     elif url.path == "/flight":
                         body = json.dumps(sidecar._flight()).encode()
                         ctype = "application/json"
+                    elif url.path == "/hotness":
+                        q = parse_qs(url.query)
+                        full = q.get("full", ["0"])[0] not in ("", "0")
+                        body = json.dumps(
+                            sidecar._hotness(full)).encode()
+                        ctype = "application/json"
                     else:
                         self.send_error(404, "unknown path")
                         return
@@ -196,6 +207,20 @@ class ObservabilityServer:
         doc["otherData"] = {"spans_dropped_total": dropped}
         return json.dumps(doc)
 
+    def _hotness(self, full: bool) -> Dict:
+        """``GET /hotness``: the workload-hotness view. Default is the
+        human-sized summary (per-table totals, fitted zipf alpha,
+        coverage curve, hottest rows); ``?full=1`` returns the raw
+        mergeable snapshot (top-K + b64 count-min + HLL) the fleet
+        monitor's /fleet/hotness cross-shard merge consumes. Sketches
+        unarmed (or no hotness source) answers the disabled marker, so
+        a scraper needs no negotiation."""
+        from persia_tpu import hotness as _hotness
+
+        snap = (self.hotness_fn() if self.hotness_fn is not None
+                else _hotness.disabled_snapshot())
+        return snap if full else _hotness.summary_view(snap)
+
     FLIGHT_SPANS = 2048
     _FLIGHT_ENV_PREFIXES = ("PERSIA_", "REPLICA_", "JAX_")
 
@@ -240,7 +265,8 @@ class ObservabilityServer:
 
 
 def maybe_start(host: str, http_port: Optional[int], health_fn,
-                service: Optional[str] = None, refresh_fn=None):
+                service: Optional[str] = None, refresh_fn=None,
+                hotness_fn=None):
     """The one sidecar-construction convention every service shares:
     ``None`` keeps the sidecar off (in-process test instances), any port
     number starts one (0 = ephemeral). Returns the started server or
@@ -253,7 +279,8 @@ def maybe_start(host: str, http_port: Optional[int], health_fn,
         service = service_name()
     return ObservabilityServer(host, http_port, health_fn=health_fn,
                                service=service,
-                               refresh_fn=refresh_fn).start()
+                               refresh_fn=refresh_fn,
+                               hotness_fn=hotness_fn).start()
 
 
 def add_http_args(parser):

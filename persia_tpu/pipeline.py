@@ -174,12 +174,24 @@ class BackwardEngine:
         self._pending_cv = threading.Condition()
         self._errors: List[BaseException] = []
         self._timer_hist = StageTimer("backward_client_time_cost_sec").hist
-        from persia_tpu.metrics import default_registry
+        from persia_tpu.metrics import STEP_BUCKETS, default_registry
 
         # pending-update depth (queued + executing): the backward lag
         # observable next to the staleness gauge
         self._g_pending = default_registry().gauge(
             "pipeline_backward_pending_updates")
+        # gradient staleness in STEPS, trainer-side: how many batches
+        # were submitted after this one before its update applied (the
+        # staleness semaphore bounds it; this histogram shows where
+        # inside the bound the pipeline actually runs). Step-shaped
+        # buckets — the default sub-second latency boundaries would
+        # put every observation in one bucket.
+        self._h_staleness = default_registry().histogram(
+            "pipeline_gradient_staleness_steps",
+            help_text="training steps submitted between a batch's "
+                      "gradient submit and its PS apply",
+            buckets=STEP_BUCKETS)
+        self._submit_seq = 0  # guarded by _pending_cv
         # updates whose ship exhausted every transport retry: bounded-
         # staleness async SGD tolerates a dropped sparse update, so a
         # PERMANENT ship failure releases its permit and counts here
@@ -214,11 +226,14 @@ class BackwardEngine:
             raise self._errors[0]
         with self._pending_cv:
             self._pending += 1
+            self._submit_seq += 1
+            seq = self._submit_seq
         self._g_pending.add(1)
         work_started()
         # carry the submitting thread's trace context (the trainer's
-        # step span) into the backward worker thread
-        self._q.put((ref_id, grads, tracing.current_context()))
+        # step span) into the backward worker thread, and the submit
+        # sequence number the staleness histogram diffs at apply time
+        self._q.put((ref_id, grads, tracing.current_context(), seq))
 
     def submit_packed(self, ref_id: int, flat_grads,
                       shapes: Sequence[Tuple[int, ...]],
@@ -250,7 +265,7 @@ class BackwardEngine:
             item = self._q.get()
             if item is _SENTINEL:
                 return
-            ref_id, grads, tctx = item
+            ref_id, grads, tctx, seq = item
             try:
                 with self._timer_hist.timer(), \
                         tracing.span("pipeline/backward_update", ctx=tctx,
@@ -269,6 +284,9 @@ class BackwardEngine:
                                 np.asarray(grads.flat), grads.shapes)
                         grads = dict(zip(grads.names, per_slot))
                     self._update_with_recovery(ref_id, grads)
+                with self._pending_cv:
+                    now_seq = self._submit_seq
+                self._h_staleness.observe(now_seq - seq)
                 heartbeat()
             except BaseException as e:
                 from persia_tpu.rpc import RpcDeadlineExceeded

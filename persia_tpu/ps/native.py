@@ -168,7 +168,8 @@ class NativeEmbeddingHolder:
     # rejects any other policy while this backend is active)
     row_dtype = "fp32"
 
-    def __init__(self, capacity: int = 1_000_000_000, num_internal_shards: int = 8):
+    def __init__(self, capacity: int = 1_000_000_000, num_internal_shards: int = 8,
+                 hotness=None):
         lib = load_native_lib()
         if lib is None:
             raise RuntimeError(
@@ -183,6 +184,13 @@ class NativeEmbeddingHolder:
         # readiness checks (PS _ready -> worker recovery re-arm) must see
         # an unarmed native holder as NOT ready for training.
         self.optimizer = None
+        # workload hotness sketches live in this Python wrapper (the
+        # C++ store never sees them): the tracker owns its own leaf
+        # locks, so observing before the ctypes call races nothing
+        from persia_tpu import hotness as _hotness
+
+        self.hotness = _hotness.make_tracker(num_internal_shards,
+                                             enabled=hotness)
 
     def __del__(self):
         h = getattr(self, "_h", None)
@@ -209,6 +217,8 @@ class NativeEmbeddingHolder:
         out = np.empty((len(signs), dim), dtype=np.float32)
         if len(signs) == 0:
             return out
+        if self.hotness is not None:
+            self.hotness.observe(dim, signs)
         rc = self._lib.ptps_lookup(self._h, _u64_ptr(signs), len(signs), dim,
                                    1 if training else 0, _f32_ptr(out))
         if rc != 0:
@@ -284,6 +294,13 @@ class NativeEmbeddingHolder:
     def gradient_id_miss_count(self) -> int:
         return int(self._lib.ptps_gradient_id_miss_count(self._h))
 
+    def hotness_snapshot(self) -> dict:
+        from persia_tpu import hotness as _hotness
+
+        if self.hotness is None:
+            return _hotness.disabled_snapshot()
+        return self.hotness.snapshot()
+
     def dump_file(self, path: str):
         if self._lib.ptps_dump(self._h, path.encode()) != 0:
             raise IOError(f"native dump to {path} failed")
@@ -342,12 +359,14 @@ def lint_row_dtype(row_dtype: str = "fp32", prefer_native: bool = True,
 
 def make_holder(capacity: int, num_internal_shards: int,
                 prefer_native: bool = True, row_dtype: str = "fp32",
-                capacity_bytes=None):
+                capacity_bytes=None, hotness=None):
     """Fastest available holder honoring the storage policy: native C++
     store for plain fp32, else the numpy one. Non-fp32 ``row_dtype`` (or
     byte-accounted capacity) is Python-holder-only; asking for it while
     the native backend is active fails loudly (:func:`lint_row_dtype`)
-    rather than silently downgrading the policy."""
+    rather than silently downgrading the policy. ``hotness`` arms the
+    workload sketches on either backend (None = the PERSIA_HOTNESS
+    knob)."""
     capacity_bytes = capacity_bytes or None  # 0 (config default) = off
     lint_row_dtype(row_dtype, prefer_native, capacity_bytes)
     want_python = (row_dtype not in (None, "fp32")
@@ -355,11 +374,12 @@ def make_holder(capacity: int, num_internal_shards: int,
     if (prefer_native and not want_python
             and not knobs.get("PERSIA_FORCE_PYTHON_PS")):
         try:
-            return NativeEmbeddingHolder(capacity, num_internal_shards)
+            return NativeEmbeddingHolder(capacity, num_internal_shards,
+                                         hotness=hotness)
         except RuntimeError:
             _logger.warning("native store unavailable; using numpy holder")
     from persia_tpu.ps.store import EmbeddingHolder
 
     return EmbeddingHolder(capacity, num_internal_shards,
                            row_dtype=row_dtype or "fp32",
-                           capacity_bytes=capacity_bytes)
+                           capacity_bytes=capacity_bytes, hotness=hotness)

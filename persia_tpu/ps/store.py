@@ -151,7 +151,8 @@ class EmbeddingHolder:
 
     def __init__(self, capacity: int = 1_000_000_000,
                  num_internal_shards: int = 8, row_dtype: str = "fp32",
-                 capacity_bytes: Optional[int] = None):
+                 capacity_bytes: Optional[int] = None,
+                 hotness: Optional[bool] = None):
         if num_internal_shards <= 0:
             raise ValueError("num_internal_shards must be positive")
         # 0/falsy means "row-count capacity only" (the config default),
@@ -187,6 +188,19 @@ class EmbeddingHolder:
         # shard locks — concurrent increments lost updates); readers sum
         self._index_miss = [0] * num_internal_shards
         self._gradient_id_miss = [0] * num_internal_shards
+        # per-table (dim-labeled) registry twins of the counters above:
+        # the health RPC keeps the aggregate ints, /metrics and the
+        # fleet federation get attribution. Cached per dim — the
+        # registry's own lookup locks on every call otherwise.
+        self._miss_counters: Dict[Tuple[str, int], object] = {}
+        # workload hotness sketches (persia_tpu.hotness): None (the
+        # default) is the zero-overhead disabled path — one `is not
+        # None` test per lookup call. `hotness=None` consults the
+        # PERSIA_HOTNESS knob at construction time.
+        from persia_tpu import hotness as _hotness
+
+        self.hotness = _hotness.make_tracker(num_internal_shards,
+                                             enabled=hotness)
 
     @property
     def row_dtype(self) -> str:
@@ -221,6 +235,35 @@ class EmbeddingHolder:
     @property
     def gradient_id_miss_count(self) -> int:
         return sum(self._gradient_id_miss)
+
+    def _bump_miss(self, kind: str, dim: int, n: int):
+        """Batched increment of the table-labeled registry counter
+        (`ps_index_miss_total` / `ps_gradient_id_miss_total`): one
+        locked inc per (call, shard) instead of one per miss. A racing
+        first-use builds the cell twice; the registry dedups by
+        (name, labels), so both writers land on the same Counter."""
+        key = (kind, dim)
+        c = self._miss_counters.get(key)
+        if c is None:
+            from persia_tpu.metrics import default_registry
+
+            c = self._miss_counters[key] = default_registry().counter(
+                f"ps_{kind}_total", {"table": str(dim)},
+                help_text=(
+                    "eval/unadmitted/cold lookups that read zeros, per "
+                    "embedding table (dim)" if kind == "index_miss" else
+                    "gradient updates whose sign was absent or "
+                    "re-laid-out, per embedding table (dim)"))
+        c.inc(n)
+
+    def hotness_snapshot(self) -> dict:
+        """Serialized workload-hotness snapshot (persia_tpu.hotness
+        format); the disabled marker when sketches are unarmed."""
+        from persia_tpu import hotness as _hotness
+
+        if self.hotness is None:
+            return _hotness.disabled_snapshot()
+        return self.hotness.snapshot()
 
     # --- control plane -------------------------------------------------
 
@@ -266,6 +309,11 @@ class EmbeddingHolder:
             if not self.configured:
                 raise RuntimeError("parameter server not configured")
         shard_ids = internal_shard_of(signs, self.num_internal_shards)
+        if self.hotness is not None:
+            # outside the shard locks: the tracker owns its own
+            # per-shard (leaf) locks, so lookup's hold times and lock
+            # order are untouched by telemetry
+            self.hotness.observe(dim, signs)
         # Precompute admission + the full init matrix for ALL signs
         # (vectorized, deterministic per sign — hits just ignore their
         # row); insertion then happens sequentially per sign so
@@ -286,6 +334,7 @@ class EmbeddingHolder:
         for shard_idx in np.unique(shard_ids):
             sel = np.nonzero(shard_ids == shard_idx)[0]
             shard = self._shards[shard_idx]
+            n_miss = 0
             with self._locks[shard_idx]:
                 for pos in sel:
                     sign = int(signs[pos])
@@ -296,8 +345,10 @@ class EmbeddingHolder:
                         out[pos] = entry[1][:dim]
                     elif not training:
                         self._index_miss[shard_idx] += 1
+                        n_miss += 1
                     elif entry is None and not admitted[pos]:
                         self._index_miss[shard_idx] += 1
+                        n_miss += 1
                     else:
                         # admitted miss, or dim mismatch (reinitialized
                         # unconditionally, reference mod.rs:213-228)
@@ -305,6 +356,9 @@ class EmbeddingHolder:
                         out[pos] = vec[:dim]
                         shard.insert(sign, dim, vec)
                         self._index_miss[shard_idx] += 1
+                        n_miss += 1
+            if n_miss:
+                self._bump_miss("index_miss", dim, n_miss)
         return out
 
     def _lookup_half(self, signs, dim, training, shard_ids, init_vecs,
@@ -340,6 +394,7 @@ class EmbeddingHolder:
             shard = self._shards[shard_idx]
             hit_pos: List[int] = []
             hit_vecs: List[np.ndarray] = []
+            n_miss = 0
             with self._locks[shard_idx]:
                 for pos in sel:
                     sign = int(signs[pos])
@@ -351,13 +406,16 @@ class EmbeddingHolder:
                         hit_vecs.append(entry[1])
                     elif not training:
                         self._index_miss[shard_idx] += 1
+                        n_miss += 1
                     elif entry is None and not admitted[pos]:
                         self._index_miss[shard_idx] += 1
+                        n_miss += 1
                     else:
                         stored_rows, widened = narrow_inits()
                         out[pos] = widened[pos]
                         shard.insert(sign, dim, stored_rows[pos].copy())
                         self._index_miss[shard_idx] += 1
+                        n_miss += 1
                 if hit_pos:
                     # entries of the right dim may still differ in state
                     # width (older optimizer layouts) — copy just the
@@ -367,6 +425,8 @@ class EmbeddingHolder:
                         raw[i] = v[:esz]
                     out[np.asarray(hit_pos)] = (
                         raw.view(rp.np_dtype).astype(np.float32))
+            if n_miss:
+                self._bump_miss("index_miss", dim, n_miss)
         return out
 
     def update_gradients(self, signs: np.ndarray, grads: np.ndarray, dim: int):
@@ -396,6 +456,7 @@ class EmbeddingHolder:
             # the whole gather/update/write-back runs under this shard's
             # lock — mutating stored buffers after releasing it races with
             # concurrent eviction + re-admission of the same sign
+            n_miss = 0
             with self._locks[shard_idx]:
                 found_pos: List[int] = []
                 found_entries: List[np.ndarray] = []
@@ -423,21 +484,24 @@ class EmbeddingHolder:
                             found_entries.append(entry[1])
                     else:
                         self._gradient_id_miss[shard_idx] += 1
-                if not found_pos:
-                    continue
-                # fast path (no duplicates): one batched optimizer call
-                # on the widened fp32 matrix, narrowed back row-wise
-                mat = rp.unpack_matrix(found_entries, dim, width)
-                assert mat.shape[1] == width
-                sub_state = (
-                    batch_state[np.array(found_pos)]
-                    if batch_state is not None else None
-                )
-                self.optimizer.update(mat, grads[np.array(found_pos)], dim,
-                                      sub_state)
-                if self.enable_weight_bound:
-                    apply_weight_bound(mat[:, :dim], self.weight_bound)
-                rp.pack_matrix_into(mat, found_entries, dim)
+                        n_miss += 1
+                if found_pos:
+                    # fast path (no duplicates): one batched optimizer
+                    # call on the widened fp32 matrix, narrowed back
+                    # row-wise
+                    mat = rp.unpack_matrix(found_entries, dim, width)
+                    assert mat.shape[1] == width
+                    sub_state = (
+                        batch_state[np.array(found_pos)]
+                        if batch_state is not None else None
+                    )
+                    self.optimizer.update(mat, grads[np.array(found_pos)],
+                                          dim, sub_state)
+                    if self.enable_weight_bound:
+                        apply_weight_bound(mat[:, :dim], self.weight_bound)
+                    rp.pack_matrix_into(mat, found_entries, dim)
+            if n_miss:
+                self._bump_miss("gradient_id_miss", dim, n_miss)
 
     # --- debug / checkpoint --------------------------------------------
 

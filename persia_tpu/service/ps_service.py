@@ -179,9 +179,13 @@ class PsService:
     def __init__(self, holder, host: str = "127.0.0.1", port: int = 0,
                  inc_dumper=None, shard_parallel: Optional[bool] = None,
                  concurrent_streams: int = 8, legacy_frames: bool = False,
-                 http_port: Optional[int] = None):
+                 http_port: Optional[int] = None, inc_loader=None):
         self.holder = holder
         self.inc_dumper = inc_dumper
+        # infer-side incremental loader (when this replica hot-loads
+        # train-tier packets): referenced so /healthz and the health
+        # RPC can report serving freshness alongside resident bytes
+        self.inc_loader = inc_loader
         # concurrent_streams opts into the per-connection dispatch pool:
         # a multiplexing worker (tagged framing) gets out-of-order
         # completion, so one slow lookup never convoys the connection;
@@ -214,20 +218,40 @@ class PsService:
         # RPC twin of the sidecar's /healthz (the bench and capacity
         # tooling read resident bytes without scraping HTTP)
         s.register("health", self._health_rpc)
+        # workload-telemetry snapshot (persia_tpu.hotness): answers the
+        # disabled marker when sketches are unarmed, so callers need no
+        # negotiation — and nobody calls it with telemetry off, keeping
+        # the disabled wire byte-identical
+        s.register("hotness", self._hotness_rpc)
+        # gradient-staleness accounting: one update-batch version
+        # counter bumped per update RPC (two uncontended lock ops — the
+        # same cost class as the server's stats lock). A telemetry-armed
+        # client echoes the version its lookup saw back on its update
+        # meta; the difference is the update's staleness in apply steps.
+        self._ver_lock = threading.Lock()
+        self._update_ver = 0
         # per-internal-shard resident-bytes gauges (Python holder only;
         # the native store has no byte accounting) — refreshed on every
         # health read and before each /metrics render
         from persia_tpu.metrics import default_registry
 
         self._mem_gauges: List = []
+        reg = default_registry()
+        port_label = self.server.addr.rsplit(":", 1)[1]
         if hasattr(holder, "resident_bytes_per_shard"):
-            reg = default_registry()
-            port_label = self.server.addr.rsplit(":", 1)[1]
             self._mem_gauges = [
                 reg.gauge("ps_resident_bytes",
                           {"server": port_label, "shard": str(i)})
                 for i in range(holder.num_internal_shards)
             ]
+        from persia_tpu.metrics import STEP_BUCKETS
+
+        self._h_staleness = reg.histogram(
+            "ps_gradient_staleness_steps", {"server": port_label},
+            help_text="update batches applied between a telemetry-"
+                      "armed client's lookup and its gradient's "
+                      "apply (async-pipeline staleness, in steps)",
+            buckets=STEP_BUCKETS)
         # observability sidecar: /metrics + /healthz + /trace next to
         # the RPC socket (http_port=0 binds an ephemeral port; None
         # keeps the sidecar off — in-process test holders don't want a
@@ -235,7 +259,8 @@ class PsService:
         from persia_tpu import obs_http
 
         self.http = obs_http.maybe_start(host, http_port, self._health,
-                                         refresh_fn=self._refresh_mem_gauges)
+                                         refresh_fn=self._refresh_mem_gauges,
+                                         hotness_fn=self._hotness_snapshot)
 
     def _refresh_mem_gauges(self):
         if self._mem_gauges:
@@ -245,6 +270,25 @@ class PsService:
 
     def _health_rpc(self, payload: bytes) -> bytes:
         return msgpack.packb(self._health())
+
+    def _hotness_snapshot(self) -> dict:
+        from persia_tpu import hotness as _hotness
+
+        snap_fn = getattr(self.holder, "hotness_snapshot", None)
+        return snap_fn() if snap_fn is not None else (
+            _hotness.disabled_snapshot())
+
+    def _hotness_rpc(self, payload: bytes) -> bytes:
+        return msgpack.packb(self._hotness_snapshot())
+
+    def _bump_update_ver(self) -> int:
+        with self._ver_lock:
+            self._update_ver += 1
+            return self._update_ver
+
+    def _current_update_ver(self) -> int:
+        with self._ver_lock:
+            return self._update_ver
 
     def _health(self) -> dict:
         doc = self.server.health()
@@ -260,6 +304,23 @@ class PsService:
         doc["resident_bytes"] = getattr(self.holder, "resident_bytes", -1)
         doc["resident_emb_bytes"] = getattr(
             self.holder, "resident_emb_bytes", -1)
+        # workload telemetry: armed or not (the /hotness endpoint and
+        # the hotness RPC carry the data itself), and the staleness
+        # version counter for operators correlating update progress
+        doc["hotness_enabled"] = getattr(self.holder, "hotness",
+                                         None) is not None
+        doc["update_version"] = self._current_update_ver()
+        if self.inc_loader is not None:
+            # serving freshness: how far behind the train tier this
+            # replica's hot-loaded rows run (scan-time delay; the
+            # per-packet sign-to-servable distribution rides /metrics
+            # as inc_update_freshness_lag_sec)
+            doc["inc_update_last_delay_sec"] = round(
+                self.inc_loader.last_delay_sec, 3)
+            doc["inc_update_sec_since_last_apply"] = round(
+                self.inc_loader.sec_since_last_apply, 3)
+            doc["inc_update_packets_applied"] = (
+                self.inc_loader.packets_applied)
         self._refresh_mem_gauges()
         # readiness (distinct from liveness): the sidecar's
         # /healthz?ready=1 returns 503 on False, so supervisors and k8s
@@ -313,6 +374,12 @@ class PsService:
                           n=len(signs), dim=meta["dim"]):
             out = self._dispatch.lookup(signs, meta["dim"],
                                         meta["training"])
+        # telemetry-armed client asked ("hv" in the request meta) for
+        # the holder's update version: it rides the response meta and
+        # comes back on the client's update as "hver". Reply-only-when-
+        # asked keeps every non-telemetry client's wire byte-identical.
+        resp_extra = ({"hver": self._current_update_ver()}
+                      if meta.get("hv") else {})
         if meta.get("resp") == "fp16" and self.server._enable_codec:
             # codec-negotiated client asked for half-precision rows:
             # the response meta names the encoding, so the client
@@ -322,11 +389,11 @@ class PsService:
             # negotiated ones.
             from persia_tpu import wire_codec
 
-            return self._pack({"codec": "fp16"},
+            return self._pack({"codec": "fp16", **resp_extra},
                               [wire_codec.encode_fp16_rows(out)])
         # scatter-gather response (default): the (n, dim) result goes
         # to the socket without a tobytes() concatenation copy
-        return self._pack({}, [out])
+        return self._pack(resp_extra, [out])
 
     def _update_gradients(self, payload: bytes) -> bytes:
         meta, arrays = unpack_arrays(payload)
@@ -344,6 +411,13 @@ class PsService:
         with tracing.span("ps/update", ctx=tracing.current_context(),
                           n=len(signs), dim=meta["dim"]):
             self._dispatch.update_gradients(signs, grads, meta["dim"])
+        ver = self._bump_update_ver()
+        hver = meta.get("hver")
+        if hver is not None:
+            # updates applied since the client's lookup saw the holder
+            # (this one excluded) — the per-replica gradient-staleness
+            # distribution in steps
+            self._h_staleness.observe(max(ver - 1 - int(hver), 0))
         if self.inc_dumper is not None:
             self.inc_dumper.commit(signs)
         return b""
@@ -521,8 +595,20 @@ class PsClient:
     def __init__(self, addr: str, enable_tags: bool = True,
                  legacy_frames: bool = False,
                  circuit_breaker=None, deadline: Optional[float] = None,
-                 wire_codec: Optional[str] = None):
+                 wire_codec: Optional[str] = None,
+                 hotness: Optional[bool] = None):
         self.addr = addr
+        # workload telemetry (None -> PERSIA_HOTNESS env): armed, every
+        # lookup asks for the replica's update version ("hv" request
+        # meta) and every update echoes the last seen one back
+        # ("hver"), giving the server its gradient-staleness histogram.
+        # Off (the default), neither key exists and the wire stays
+        # byte-identical to the legacy protocol. A legacy/unarmed
+        # server simply never answers "hver" — negotiate-down for free.
+        if hotness is None:
+            hotness = knobs.get("PERSIA_HOTNESS")
+        self.telemetry = bool(hotness)
+        self._last_hver: Optional[int] = None
         # wire codec policy (None -> PERSIA_PS_WIRE_CODEC env): "fp16"
         # ships lookup responses as fp16 rows, "fp16+int8" additionally
         # ships update gradients as int8 + per-row scales with the fp32
@@ -612,7 +698,18 @@ class PsClient:
         meta = {"dim": int(dim), "training": bool(training)}
         if self.wire_fp16 and self.client.codec_active():
             meta["resp"] = "fp16"
+        if self.telemetry:
+            meta["hv"] = 1
         return meta
+
+    def _note_hver(self, meta: dict):
+        """Remember the update version a lookup response reported (a
+        plain attribute store — atomic under the GIL; concurrent
+        lookups may interleave, and any recently-seen version is an
+        equally valid staleness anchor)."""
+        hv = meta.get("hver")
+        if hv is not None:
+            self._last_hver = int(hv)
 
     @staticmethod
     def _decode_rows(meta: dict, out: np.ndarray, n: int,
@@ -625,6 +722,12 @@ class PsClient:
 
             out = wire_codec.decode_fp16_rows(out)
         return out.reshape(n, dim)
+
+    def _update_meta(self, dim: int) -> dict:
+        meta = {"dim": int(dim)}
+        if self.telemetry and self._last_hver is not None:
+            meta["hver"] = self._last_hver
+        return meta
 
     def _update_payload(self, signs: np.ndarray, grads: np.ndarray,
                         dim: int):
@@ -641,9 +744,9 @@ class PsClient:
             self._ef.apply(signs, g, dim)
             q, scales, residual = wire_codec.quantize_int8_rows(g)
             self._ef.store(signs, residual, dim)
-            return self._pack({"dim": int(dim), "codec": "int8"},
+            return self._pack({**self._update_meta(dim), "codec": "int8"},
                               [signs, q, scales])
-        return self._pack({"dim": int(dim)}, [signs, grads])
+        return self._pack(self._update_meta(dim), [signs, grads])
 
     def lookup(self, signs: np.ndarray, dim: int, training: bool) -> np.ndarray:
         self._check_open()
@@ -651,6 +754,7 @@ class PsClient:
                                  [np.ascontiguousarray(signs, np.uint64)])
         meta, (out,) = unpack_arrays(
             self._settle(lambda: self.client.call("lookup", payload)))
+        self._note_hver(meta)
         return self._decode_rows(meta, out, len(signs), dim)
 
     def lookup_future(self, signs: np.ndarray, dim: int, training: bool):
@@ -669,6 +773,7 @@ class PsClient:
 
         def resolve() -> np.ndarray:
             meta, (out,) = unpack_arrays(self._settle(fut.result))
+            self._note_hver(meta)
             return self._decode_rows(meta, out, n, dim)
 
         return resolve
@@ -703,6 +808,13 @@ class PsClient:
         read without scraping the HTTP sidecar."""
         return msgpack.unpackb(
             self._guarded(lambda: self.client.call("health")), raw=False)
+
+    def hotness(self) -> dict:
+        """The replica's workload-hotness snapshot (persia_tpu.hotness
+        format; the disabled marker when sketches are unarmed)."""
+        return msgpack.unpackb(
+            self._guarded(lambda: self.client.call("hotness")),
+            raw=False)
 
     def wire_stats(self) -> dict:
         """Cumulative payload bytes this client sent/received (rpc.py
@@ -839,6 +951,7 @@ def main():
                          capacity_bytes=gc.parameter_server.capacity_bytes
                          or None)
     inc_dumper = None
+    inc_loader = None
     if gc.parameter_server.enable_incremental_update:
         from persia_tpu.config import JobType
         from persia_tpu.inc_update import (
@@ -847,8 +960,9 @@ def main():
         )
 
         if gc.common.job_type == JobType.INFER:
-            IncrementalUpdateLoader(
-                holder, gc.parameter_server.incremental_dir).start()
+            inc_loader = IncrementalUpdateLoader(
+                holder, gc.parameter_server.incremental_dir)
+            inc_loader.start()
         else:
             inc_dumper = IncrementalUpdateDumper(
                 holder, gc.parameter_server.incremental_dir,
@@ -857,6 +971,7 @@ def main():
             )
     service = PsService(
         holder, args.host, args.port, inc_dumper=inc_dumper,
+        inc_loader=inc_loader,
         concurrent_streams=args.concurrent_streams,
         # A/B lever for the worker-cycle bench's serialized baseline
         legacy_frames=knobs.get("PERSIA_PS_LEGACY_FRAMES"),

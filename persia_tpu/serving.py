@@ -524,6 +524,12 @@ class InferenceServer:
         if self.cache is not None:
             doc["cache_rows_resident"] = len(self.cache)
             doc["cache_hit_rate"] = round(self.cache.hit_rate, 4)
+            # the serving tier's freshness BOUND: a cached row can lag
+            # the PS (and the inc_update stream feeding it) by at most
+            # this long — read it next to the infer-PS loader's
+            # inc_update_last_delay_sec gauge for end-to-end
+            # sign-to-servable age
+            doc["cache_ttl_sec"] = self.cache.ttl_sec
         doc["requests_total"] = self._m_requests.value
         doc["degraded_lookups_total"] = self._m_degraded.value
         # the serving tier stays READY while degrading (zero-vector

@@ -155,6 +155,26 @@ def default_rules() -> List[SloRule]:
                 ">", 100.0, window_sec=60.0, severity="ticket",
                 description="trace ring evicting >100 spans/s — "
                             "captures are incomplete"),
+        # workload-telemetry objectives (both evaluate to no-data until
+        # the staleness/freshness series exist, so unarmed fleets never
+        # page on them)
+        SloRule("gradient_staleness_high",
+                "p99(ps_gradient_staleness_steps)",
+                ">", 256.0, window_sec=120.0, severity="ticket",
+                description="PS-observed gradient staleness p99 above "
+                            "256 update steps — async updates are "
+                            "applying far behind their lookups"),
+        # sec_since_last_apply, not last_delay_sec: the delay gauge is
+        # only written when a packet APPLIES, so it freezes at its last
+        # healthy value during an actual stall — the since-apply clock
+        # keeps rising on every scan, which is what stall detection
+        # needs
+        SloRule("serving_freshness_stale",
+                "inc_update_sec_since_last_apply",
+                ">", 600.0, window_sec=60.0,
+                description="no incremental packet applied for over "
+                            "10 minutes — the train->serve sync loop "
+                            "is stalled"),
     ]
 
 

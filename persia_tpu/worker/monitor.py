@@ -37,6 +37,11 @@ class HyperLogLog:
 
     def add_hashed(self, hashes: np.ndarray):
         """Vectorized insert of pre-hashed uint64 values."""
+        if len(hashes) == 0:
+            # reduceat on an empty segment raises; the old
+            # np.maximum.at path was a no-op here (an all-empty sparse
+            # slot reaches this via dedup_feature's distinct_signs)
+            return
         h = hashes.astype(np.uint64, copy=False)
         idx = (h >> np.uint64(64 - self.p)).astype(np.int64)
         rest = h << np.uint64(self.p)  # top p bits consumed
@@ -48,7 +53,17 @@ class HyperLogLog:
             bitpos = np.floor(np.log2(rest[nz].astype(np.float64))).astype(np.int64)
             ranks_nz = (63 - bitpos + 1).astype(np.uint8)
             ranks[nz] = ranks_nz
-        np.maximum.at(self.registers, idx, ranks)
+        # segment-max via sort + reduceat instead of np.maximum.at:
+        # ufunc.at runs a per-element interpreter loop (it dominated
+        # the hotness tracker's lookup-path cost); the sort pass is one
+        # C loop and the registers see one gather/scatter
+        order = np.argsort(idx, kind="stable")
+        si = idx[order]
+        sr = ranks[order]
+        starts = np.nonzero(np.r_[True, si[1:] != si[:-1]])[0]
+        seg_max = np.maximum.reduceat(sr, starts)
+        u = si[starts]
+        self.registers[u] = np.maximum(self.registers[u], seg_max)
 
     def add_signs(self, signs: np.ndarray):
         self.add_hashed(farmhash64_np(signs))

@@ -63,6 +63,14 @@ while [ "$(date +%s)" -lt "$END" ]; do
       #     matters (the 2-core dev box exaggerates scraper GIL cost)
       step "bench fleet (control plane)" python bench.py --mode fleet \
         --max-seconds 900
+      # 4f. workload telemetry (PR 8): sketch accuracy vs exact counts
+      #     under zipfian traffic, armed-vs-off cycle inflation
+      #     (<= 3% gate), wire-neutrality pins, cross-shard
+      #     /fleet/hotness merge + HBM planner — host-only, but the
+      #     inflation number on production-class cores is the gate that
+      #     matters; BENCH_telemetry.json lands next to this log
+      step "bench telemetry (workload)" python bench.py \
+        --mode telemetry --max-seconds 900
       # 5. re-capture the headline near the end of the window
       step "re-capture: python bench.py" python bench.py
       echo "$(date -u +%FT%TZ) chip sequence complete — see BENCH_CAPTURE_r05.log" >> "$LOG"
