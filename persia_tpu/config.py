@@ -255,6 +255,12 @@ class EmbeddingParameterServerConfig:
     # optional BYTE budget for eviction (0 = row-count capacity only):
     # with it, an fp16 table genuinely admits ~2x the rows of fp32
     capacity_bytes: int = 0
+    # disk spill tier (the cold rung of the storage ladder): unset (the
+    # default) keeps drop-on-evict; a directory arms spill-instead-of-
+    # drop with transparent fault-in (Python holder only, like
+    # row_dtype). spill_bytes 0 = unbounded disk budget.
+    spill_dir: str = ""
+    spill_bytes: int = 0
     # accepted for config-file compatibility with the reference; the
     # full-amount streaming manager is not implemented (full dumps go
     # through checkpoint.dump_sharded instead)
@@ -340,6 +346,8 @@ class GlobalConfig:
                 ),
                 row_dtype=str(ps_raw.get("row_dtype", "fp32")),
                 capacity_bytes=int(ps_raw.get("capacity_bytes", 0)),
+                spill_dir=str(ps_raw.get("spill_dir", "") or ""),
+                spill_bytes=int(ps_raw.get("spill_bytes", 0)),
                 full_amount_manager_buffer_size=int(
                     ps_raw.get("full_amount_manager_buffer_size", 1000)
                 ),

@@ -202,6 +202,7 @@ class TrainCtx(EmbeddingCtx):
         seed: int = 0,
         grad_reduce_dtype: Optional[str] = None,
         device_cache_capacity: int = 0,
+        device_cache_admission: Optional[str] = None,
         profiler=None,
     ):
         super().__init__(model=model, schema=schema, worker=worker,
@@ -228,8 +229,11 @@ class TrainCtx(EmbeddingCtx):
         self._ef_state = None
         # device-resident hot-row cache (TPU-first, beyond the reference:
         # hits never cross the host<->device wire; see
-        # persia_tpu/parallel/cached_engine.py for the consistency model)
+        # persia_tpu/parallel/cached_engine.py for the consistency model).
+        # admission: None -> the PERSIA_TIER_ADMIT knob; "hotness"
+        # selects the frequency-gated tier-ladder mapper
         self.device_cache_capacity = int(device_cache_capacity)
+        self.device_cache_admission = device_cache_admission
         self._cache_engine = None
         self._cached_step = None
         self._cache_multi_id = False
@@ -567,7 +571,8 @@ class TrainCtx(EmbeddingCtx):
             acc_init=opt.initial_accumulator_value, mesh=self.mesh,
             sqrt_scaling=[
                 self.schema.get_slot(f.name).sqrt_scaling
-                for f in batch.id_type_features])
+                for f in batch.id_type_features],
+            admission=self.device_cache_admission)
         self._cache_multi_id = multi_id
         maker = make_cached_bag_train_step if multi_id \
             else make_cached_train_step

@@ -105,6 +105,11 @@ class ScrapeTarget:
             "uptime_sec": h.get("uptime_sec"),
             "pid": h.get("pid"),
             "health_status": h.get("status"),
+            # tier-ladder observables (PS replicas only; None elsewhere):
+            # which rung rows occupy and how the write-back/update
+            # version stream is advancing
+            "update_version": h.get("update_version"),
+            "spill": h.get("spill"),
             "last_scrape_age_sec": (
                 round(now - self.last_scrape_t, 3)
                 if self.last_scrape_t is not None else None),

@@ -108,6 +108,42 @@ class SpaceSaving:
         self.offer_many(np.array([sign], dtype=np.uint64),
                         np.array([inc], dtype=np.float64))
 
+    def count_of(self, sign: int) -> int:
+        """Tracked count of one sign (0 when untracked) — the point
+        query the device-cache admission ladder gates on."""
+        n = len(self._signs)
+        if n == 0:
+            return 0
+        pos = min(int(np.searchsorted(self._signs, np.uint64(sign))),
+                  n - 1)
+        if int(self._signs[pos]) != int(sign):
+            return 0
+        return int(self._counts[pos])
+
+    def counts_of(self, signs: np.ndarray) -> np.ndarray:
+        """Vectorized :meth:`count_of` (0 for untracked signs) — the
+        admission mapper bulk-queries its whole victim queue once per
+        batch instead of point-probing the summary per miss."""
+        signs = np.ascontiguousarray(signs, dtype=np.uint64)
+        out = np.zeros(len(signs), dtype=np.int64)
+        if len(signs) == 0:
+            return out
+        mask, pos = self.member_mask(signs)
+        if mask.any():
+            out[mask] = self._counts[pos[mask]].astype(np.int64)
+        return out
+
+    def decay(self, factor: float = 0.5):
+        """Age every tracked count (and its error bound) by ``factor``
+        — W-TinyLFU-style periodic halving. Without aging, a
+        formerly-hot row's lifetime count blocks admission of newly
+        hot rows forever after a hot-set shift; halving preserves the
+        relative order of counts while letting recent traffic win in
+        bounded time. Admission-side use only (the telemetry trackers
+        never decay — their merge algebra needs raw additive counts)."""
+        np.floor(self._counts * factor, out=self._counts)
+        np.floor(self._errs * factor, out=self._errs)
+
     def member_mask(self, signs: np.ndarray) -> np.ndarray:
         """Vectorized membership test against the sorted sign array.
         Returns (mask, positions-into-the-summary)."""
@@ -491,8 +527,17 @@ def merge_snapshots(snaps: Sequence[Dict]) -> Dict:
                     "cm": t["cm"],
                     "hll": t["hll"],
                 }
+                if t.get("row_bytes"):
+                    merged["tables"][table]["row_bytes"] = int(
+                        t["row_bytes"])
                 continue
             m["total"] += int(t["total"])
+            if t.get("row_bytes"):
+                # replicas of one fleet share one storage policy; a
+                # mid-rollout mix keeps the WIDER row so budget math
+                # stays conservative
+                m["row_bytes"] = max(int(m.get("row_bytes") or 0),
+                                     int(t["row_bytes"]))
             by_sign = {s: [c, e] for s, c, e in m["topk"]}
             for s, c, e in t["topk"]:
                 cur = by_sign.get(s)
@@ -692,6 +737,7 @@ def table_report(table_snap: Dict,
     counts = _stable_counts(rows) if rows else []
     return {
         "total": int(table_snap.get("total") or 0),
+        "row_bytes": int(table_snap.get("row_bytes") or 0) or None,
         "unique_est": round(float(table_snap.get("unique_est") or 0.0), 1),
         "tracked_topk": len(rows),
         "zipf_alpha": fit_zipf_alpha(counts),
@@ -706,10 +752,16 @@ def planner_report(snapshot: Dict, hbm_bytes: int,
     """HBM-capacity plan for the frequency-admitted device cache
     (ROADMAP item 2): split ``hbm_bytes`` across tables in proportion
     to their lookup traffic, size each table's hot set, and read the
-    expected hit rate off its coverage curve. ``row_bytes`` maps table
-    -> resident bytes/row in HBM; the default assumes fp32 embedding
-    rows (``dim * 4`` — the device cache stores values, not optimizer
-    state)."""
+    expected hit rate off its coverage curve. Bytes/row resolve in
+    order: the caller's ``row_bytes`` map (table -> resident bytes/row
+    in HBM) wins outright; otherwise the snapshot's per-table
+    ``row_bytes`` (the LIVE holder's storage precision, stamped by
+    ``hotness_snapshot`` and carried by the merge) FLOORED at the fp32
+    width ``dim * 4`` — the device cache imports rows as f32 values
+    whatever the PS stores (cached_train.init_cache_arrays), so an
+    fp16 PS tier must not seduce the plan into budgeting 2x the rows
+    that actually fit in HBM. A wider-than-f32 stamp (future) is
+    honored; optimizer state is excluded by convention."""
     tables = snapshot.get("tables", {})
     total = float(snapshot.get("total") or 0) or float(
         sum(t.get("total", 0) for t in tables.values())) or 1.0
@@ -717,7 +769,8 @@ def planner_report(snapshot: Dict, hbm_bytes: int,
     overall = 0.0
     for table, t in sorted(tables.items(), key=lambda kv: kv[0]):
         share = float(t.get("total", 0)) / total
-        rb = int((row_bytes or {}).get(table, 0)) or int(table) * 4
+        rb = (int((row_bytes or {}).get(table, 0))
+              or max(int(t.get("row_bytes") or 0), int(table) * 4))
         budget = int(share * hbm_bytes)
         uniq = max(float(t.get("unique_est") or 0.0), 1.0)
         hot_rows = min(int(budget // rb) if rb else 0, int(uniq))

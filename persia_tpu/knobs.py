@@ -182,6 +182,34 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
     _k("PERSIA_TEST_TPU", "bool", False,
        "Run the TPU-gated hardware-validation tests (pytest conftest "
        "arms a per-test watchdog instead of skipping them)."),
+    _k("PERSIA_TIER_ADMIT", "str", "lru",
+       "Device-cache admission policy for the HBM tier of the embedding "
+       "ladder: `lru` (the legacy recency-only mapper) or `hotness` "
+       "(frequency-gated admission — a Space-Saving sketch over the "
+       "training id stream keeps one-touch cold traffic in a small "
+       "probationary window so it cannot thrash the resident hot set). "
+       "The default keeps the wire and the mapper behavior identical "
+       "to the pre-ladder stack."),
+    _k("PERSIA_TIER_SKETCH_TOPK", "int", 0,
+       "Space-Saving summary size of the hotness-admitted device-cache "
+       "mapper (0 = auto: 4x the cache capacity, capped at 1Mi). Only "
+       "read when PERSIA_TIER_ADMIT=hotness."),
+    _k("PERSIA_TIER_SPILL_BYTES", "int", 0,
+       "Disk budget for the PS cold-row spill tier (0 = unbounded). "
+       "When the budget overflows, whole oldest spill packets are "
+       "dropped (cold-cold rows die last-tier)."),
+    _k("PERSIA_TIER_SPILL_DIR", "str", None,
+       "Arm the PS disk spill tier: byte/row-budget evictions write "
+       "cold rows to spill packets under this directory "
+       "(storage.PersiaPath — local or hdfs://) instead of dropping "
+       "them, and lookups fault spilled rows back in transparently. "
+       "Python holder only (loud config lint on the native store, like "
+       "row_dtype)."),
+    _k("PERSIA_TIER_WINDOW_FRAC", "float", 0.125,
+       "Fraction of the device-cache capacity reserved as the "
+       "probationary admission window under PERSIA_TIER_ADMIT=hotness "
+       "(cold newcomers churn there; rows earn protected residency by "
+       "out-counting the protected LRU victim)."),
     _k("PERSIA_TRACING", "bool", False,
        "Cross-tier span capture. Frozen at import ON PURPOSE: the "
        "disabled path must cost nothing, so the gate is a module "

@@ -27,6 +27,7 @@ this needs per-process row ownership (mapper sharded by
 ``jax.process_index``), not just GSPMD on the arrays.
 """
 
+import itertools
 import queue
 import threading
 from typing import List, Tuple
@@ -41,10 +42,16 @@ logger = get_logger(__name__)
 
 _BUCKETS = (64, 256, 1024, 4096, 16384, 65536)
 
+# monotone id per DeviceCacheEngine in this process (metric label)
+_ENGINE_SEQ = itertools.count()
+
 
 class DeviceCacheEngine:
     def __init__(self, worker, capacity: int, num_slots: int, dim: int,
-                 acc_init: float, mesh=None, sqrt_scaling=None):
+                 acc_init: float, mesh=None, sqrt_scaling=None,
+                 admission: str = None):
+        from persia_tpu import knobs
+
         self.worker = worker
         self.capacity = int(capacity)
         self.num_slots = int(num_slots)
@@ -53,7 +60,10 @@ class DeviceCacheEngine:
         self.mesh = mesh
         # per-slot sqrt-scaling flags (bag mode only; see prepare_bags)
         self.sqrt_scaling = list(sqrt_scaling or [])
-        self.mapper = make_sign_slot_map(capacity)
+        # admission policy of the HBM tier: "lru" (legacy) or "hotness"
+        # (frequency-gated TieredSignSlotMap; PERSIA_TIER_ADMIT)
+        self.admission = admission or knobs.get("PERSIA_TIER_ADMIT")
+        self.mapper = make_sign_slot_map(capacity, self.admission)
         self.victims = VictimBuffer()
         from persia_tpu.parallel.cached_train import init_cache_arrays
 
@@ -67,6 +77,66 @@ class DeviceCacheEngine:
             name="device-cache-flush")
         self._flush_thread.start()
         self.wire_bytes_saved = 0  # vs the packed upload+download path
+        # registry twins of the mapper/write-back counters, so the
+        # trainer sidecar (and the fleet federation scraping it) can
+        # watch tier-ladder health; bumped by deltas once per batch —
+        # the per-sign hot path never touches a locked counter
+        from persia_tpu.metrics import default_registry
+
+        reg = default_registry()
+        # engine-identity label: two live engines in one process (A/B
+        # benches, multi-ctx tests) must not share series — a blended
+        # hit ratio and a last-writer-wins resident gauge would lie to
+        # the hit-collapse SLO
+        lbl = {"dim": str(dim), "engine": str(next(_ENGINE_SEQ))}
+        self._m_probes = reg.counter(
+            "device_cache_probes_total", lbl,
+            help_text="sign positions probed against the device cache "
+                      "(hits + misses) — the hit-rate denominator")
+        self._m_hits = reg.counter(
+            "device_cache_hits_total", lbl,
+            help_text="device-cache hits (rows served from HBM, no "
+                      "host<->device or PS traffic)")
+        self._m_misses = reg.counter(
+            "device_cache_misses_total", lbl,
+            help_text="device-cache misses (rows imported from the PS "
+                      "tier / victim buffer)")
+        self._m_evictions = reg.counter(
+            "device_cache_evictions_total", lbl,
+            help_text="rows evicted from the device cache (each queues "
+                      "one PS write-back)")
+        self._m_promotions = reg.counter(
+            "device_cache_promotions_total", lbl,
+            help_text="window->protected promotions of the "
+                      "hotness-admitted mapper (0 under LRU admission)")
+        self._m_writebacks = reg.counter(
+            "device_cache_writeback_rows_total", lbl,
+            help_text="rows written back to the PS tier (eviction "
+                      "flushes + flush_all)")
+        self._m_resident = reg.gauge(
+            "device_cache_resident_rows", lbl,
+            help_text="signs currently resident in the device cache")
+        self._counted = (0, 0, 0, 0)  # hits/misses/evictions/promotions
+
+    def _publish_counters(self):
+        """Delta the mapper's plain-int counters into their registry
+        twins (once per batch, after assign)."""
+        m = self.mapper
+        h, mi, ev, pr = (m.hits, m.misses, m.evictions,
+                         getattr(m, "promotions", 0))
+        ph, pm, pe, pp = self._counted
+        self._counted = (h, mi, ev, pr)
+        if h - ph:
+            self._m_hits.inc(h - ph)
+        if mi - pm:
+            self._m_misses.inc(mi - pm)
+        if (h - ph) + (mi - pm):
+            self._m_probes.inc((h - ph) + (mi - pm))
+        if ev - pe:
+            self._m_evictions.inc(ev - pe)
+        if pr - pp:
+            self._m_promotions.inc(pr - pp)
+        self._m_resident.set(len(m))
 
     # --- per-batch host work --------------------------------------------
 
@@ -87,6 +157,7 @@ class DeviceCacheEngine:
         batch, num_slots = signs.shape
         flat_signs = signs.reshape(-1)
         res = self.mapper.assign(flat_signs)
+        self._publish_counters()
         # tail past the distinct count is uninitialized: point it at the
         # dummy slot so the device update's pad rows are inert
         unique_slots = res.unique_slots
@@ -128,6 +199,7 @@ class DeviceCacheEngine:
         seg = np.concatenate(seg_parts)
         n = len(flat_signs)
         res = self.mapper.assign(flat_signs)
+        self._publish_counters()
         lpad = pad_to_bucket(max(n, 1), _BUCKETS)
         flat_slot_idx = np.full(lpad, self.capacity, np.int32)
         flat_slot_idx[:n] = res.slots
@@ -259,6 +331,7 @@ class DeviceCacheEngine:
             self.worker.set_rows(
                 np.asarray(todo_signs, np.uint64),
                 np.stack(todo_vecs), self.dim)
+            self._m_writebacks.inc(len(todo_signs))
         # remove only AFTER the PS write landed: a miss racing the write
         # must keep finding the pending entry, otherwise it would read
         # the stale pre-write PS row. A miss that took the entry mid-
@@ -279,6 +352,7 @@ class DeviceCacheEngine:
             acc = np.asarray(self.cache_acc)[slots]
             vecs = np.concatenate([vals, acc], axis=1)
             self.worker.set_rows(signs, vecs, self.dim)
+            self._m_writebacks.inc(n)
         while True:
             item = self.victims.pop_any()
             if item is None:
@@ -291,6 +365,7 @@ class DeviceCacheEngine:
                 [np.asarray(vvals)[row], np.asarray(vacc)[row]])
             self.worker.set_rows(
                 np.asarray([sign], np.uint64), vec[None, :], self.dim)
+            self._m_writebacks.inc()
             n += 1
         return n
 
@@ -304,7 +379,9 @@ class DeviceCacheEngine:
         self._drain_flush_queue()
         while self.victims.pop_any() is not None:
             pass
-        self.mapper = make_sign_slot_map(self.capacity)
+        self.mapper = make_sign_slot_map(self.capacity, self.admission)
+        self._counted = (0, 0, 0, 0)
+        self._m_resident.set(0)
         from persia_tpu.parallel.cached_train import init_cache_arrays
 
         self.cache_vals, self.cache_acc = init_cache_arrays(

@@ -299,7 +299,13 @@ class NativeEmbeddingHolder:
 
         if self.hotness is None:
             return _hotness.disabled_snapshot()
-        return self.hotness.snapshot()
+        snap = self.hotness.snapshot()
+        # the native store is fp32-only; stamp the live bytes/row so
+        # planner_report budgets against the real layout (same contract
+        # as the Python holder's row_dtype-aware stamp)
+        for table, t in snap.get("tables", {}).items():
+            t["row_bytes"] = int(table) * 4
+        return snap
 
     def dump_file(self, path: str):
         if self._lib.ptps_dump(self._h, path.encode()) != 0:
@@ -330,47 +336,57 @@ class NativeEmbeddingHolder:
 
 
 def lint_row_dtype(row_dtype: str = "fp32", prefer_native: bool = True,
-                   capacity_bytes=None):
-    """Config lint for the mixed-precision store policy: the native C++
+                   capacity_bytes=None, spill_dir=None):
+    """Config lint for the Python-only store policies: the native C++
     store (store.h/capi.cc) is **fp32-only** with row-count eviction —
-    it implements neither ``row_dtype`` narrowing nor byte-accounted
-    capacity. Selecting either policy while the native backend would be
-    the active one is a silent-downgrade hazard (rows would quietly stay
-    fp32-wide), so it is rejected LOUDLY here instead. Raises
-    ``ValueError``; a no-op when the policy is plain fp32, the native
-    backend is not preferred/forced off, or the library simply is not
-    built (the numpy holder serves then). ``capacity_bytes`` falsy —
-    including the config-default 0 — means the byte policy is OFF."""
-    if (row_dtype in (None, "fp32")) and not capacity_bytes:
+    it implements neither ``row_dtype`` narrowing, byte-accounted
+    capacity, nor the disk spill tier. Selecting any of them while the
+    native backend would be the active one is a silent-downgrade hazard
+    (rows would quietly stay fp32-wide / evictions would quietly DROP
+    instead of spill), so it is rejected LOUDLY here instead. Raises
+    ``ValueError``; a no-op when the policy is plain fp32 with no spill,
+    the native backend is not preferred/forced off, or the library
+    simply is not built (the numpy holder serves then).
+    ``capacity_bytes`` falsy — including the config-default 0 — means
+    the byte policy is OFF."""
+    if (row_dtype in (None, "fp32")) and not capacity_bytes \
+            and not spill_dir:
         return
     if not prefer_native or knobs.get("PERSIA_FORCE_PYTHON_PS"):
         return
     if load_native_lib(build_if_missing=False) is None:
         return
-    policy = (f"row_dtype={row_dtype!r}" if row_dtype not in (None, "fp32")
-              else f"capacity_bytes={capacity_bytes}")
+    if row_dtype not in (None, "fp32"):
+        policy = f"row_dtype={row_dtype!r}"
+    elif capacity_bytes:
+        policy = f"capacity_bytes={capacity_bytes}"
+    else:
+        policy = f"spill_dir={spill_dir!r}"
     raise ValueError(
         f"{policy} is not supported by the native C++ store (fp32 rows, "
-        f"row-count eviction only) and the native backend is active on "
-        f"this host. Either keep row_dtype=fp32 for native parity, or "
-        f"set PERSIA_FORCE_PYTHON_PS=1 to run this replica on the numpy "
-        f"holder, which implements the mixed-precision policy.")
+        f"row-count eviction, no spill tier) and the native backend is "
+        f"active on this host. Either drop the policy for native parity, "
+        f"or set PERSIA_FORCE_PYTHON_PS=1 to run this replica on the "
+        f"numpy holder, which implements it.")
 
 
 def make_holder(capacity: int, num_internal_shards: int,
                 prefer_native: bool = True, row_dtype: str = "fp32",
-                capacity_bytes=None, hotness=None):
+                capacity_bytes=None, hotness=None, spill_dir=None,
+                spill_bytes=None):
     """Fastest available holder honoring the storage policy: native C++
-    store for plain fp32, else the numpy one. Non-fp32 ``row_dtype`` (or
-    byte-accounted capacity) is Python-holder-only; asking for it while
-    the native backend is active fails loudly (:func:`lint_row_dtype`)
-    rather than silently downgrading the policy. ``hotness`` arms the
-    workload sketches on either backend (None = the PERSIA_HOTNESS
-    knob)."""
+    store for plain fp32, else the numpy one. Non-fp32 ``row_dtype``,
+    byte-accounted capacity, and the disk spill tier are
+    Python-holder-only; asking for any while the native backend is
+    active fails loudly (:func:`lint_row_dtype`) rather than silently
+    downgrading the policy. ``hotness`` arms the workload sketches on
+    either backend (None = the PERSIA_HOTNESS knob)."""
     capacity_bytes = capacity_bytes or None  # 0 (config default) = off
-    lint_row_dtype(row_dtype, prefer_native, capacity_bytes)
+    spill_dir = spill_dir or None
+    lint_row_dtype(row_dtype, prefer_native, capacity_bytes, spill_dir)
     want_python = (row_dtype not in (None, "fp32")
-                   or capacity_bytes is not None)
+                   or capacity_bytes is not None
+                   or spill_dir is not None)
     if (prefer_native and not want_python
             and not knobs.get("PERSIA_FORCE_PYTHON_PS")):
         try:
@@ -382,4 +398,6 @@ def make_holder(capacity: int, num_internal_shards: int,
 
     return EmbeddingHolder(capacity, num_internal_shards,
                            row_dtype=row_dtype or "fp32",
-                           capacity_bytes=capacity_bytes, hotness=hotness)
+                           capacity_bytes=capacity_bytes, hotness=hotness,
+                           spill_dir=spill_dir,
+                           spill_bytes=spill_bytes or None)

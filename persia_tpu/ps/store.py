@@ -39,6 +39,14 @@ table genuinely admits ~2x the rows of an fp32 one before evicting.
 Half-precision holders dump the PSD **v2** record layout (per-record
 dtype tag, emb bytes + f32 state bytes); v1 files still load into any
 holder, and v2 files load into fp32 holders (widen on read).
+
+Disk spill tier (``spill_dir``, Python backend only, like row_dtype):
+capacity evictions demote rows to :class:`~persia_tpu.ps.spill.
+SpillStore` packets instead of dropping them, and any later access
+faults them back in transparently (training accesses promote the row
+resident; read-only accesses peek). ``len``, ``get_entry``/
+``get_entries``, gradient updates, and ``dump_bytes`` all see ONE
+logical table regardless of which rung a row occupies.
 """
 
 import struct
@@ -101,16 +109,19 @@ class EvictionMap:
         self.resident_bytes += sign_mult * vec.nbytes
         self.emb_bytes += sign_mult * min(dim * self.emb_itemsize, vec.nbytes)
 
-    def insert(self, sign: int, dim: int, vec: np.ndarray) -> List[int]:
-        """Insert/replace; returns the signs evicted to restore the
-        row/byte budget (empty when nothing overflowed)."""
+    def insert(self, sign: int, dim: int,
+               vec: np.ndarray) -> List[Tuple[int, Tuple[int, np.ndarray]]]:
+        """Insert/replace; returns the ``(sign, (dim, vec))`` entries
+        evicted to restore the row/byte budget (empty when nothing
+        overflowed) — a spill-armed holder demotes them to the disk
+        tier instead of letting them die."""
         old = self._map.pop(sign, None)
         if old is not None:
             self._account(old, -1)
         entry = (dim, vec)
         self._map[sign] = entry
         self._account(entry, +1)
-        evicted: List[int] = []
+        evicted: List[Tuple[int, Tuple[int, np.ndarray]]] = []
         while len(self._map) > self.capacity or (
             self.byte_capacity is not None
             and self.resident_bytes > self.byte_capacity
@@ -118,7 +129,7 @@ class EvictionMap:
         ):
             evicted_sign, old = self._map.popitem(last=False)
             self._account(old, -1)
-            evicted.append(evicted_sign)
+            evicted.append((evicted_sign, old))
         return evicted
 
     def items_in_lru_order(self):
@@ -152,7 +163,9 @@ class EmbeddingHolder:
     def __init__(self, capacity: int = 1_000_000_000,
                  num_internal_shards: int = 8, row_dtype: str = "fp32",
                  capacity_bytes: Optional[int] = None,
-                 hotness: Optional[bool] = None):
+                 hotness: Optional[bool] = None,
+                 spill_dir: Optional[str] = None,
+                 spill_bytes: Optional[int] = None):
         if num_internal_shards <= 0:
             raise ValueError("num_internal_shards must be positive")
         # 0/falsy means "row-count capacity only" (the config default),
@@ -201,6 +214,19 @@ class EmbeddingHolder:
 
         self.hotness = _hotness.make_tracker(num_internal_shards,
                                              enabled=hotness)
+        # disk spill tier (the cold rung of the storage ladder): armed,
+        # budget evictions demote rows to spill packets instead of
+        # dropping them, and any later access faults them back in. The
+        # spill lock is a leaf under the shard locks (spill never calls
+        # back into the holder). None (the default) keeps every path
+        # at one `is not None` test of overhead.
+        if spill_dir:
+            from persia_tpu.ps.spill import SpillStore
+
+            self.spill: Optional[SpillStore] = SpillStore(
+                spill_dir, max_bytes=spill_bytes or None)
+        else:
+            self.spill = None
 
     @property
     def row_dtype(self) -> str:
@@ -258,12 +284,62 @@ class EmbeddingHolder:
 
     def hotness_snapshot(self) -> dict:
         """Serialized workload-hotness snapshot (persia_tpu.hotness
-        format); the disabled marker when sketches are unarmed."""
+        format); the disabled marker when sketches are unarmed. Each
+        table carries this holder's LIVE bytes/row (``row_bytes`` =
+        dim x the storage precision's itemsize) so downstream budget
+        math sees the real storage width instead of assuming fp32 —
+        note hotness.planner_report floors it at ``dim * 4`` for HBM
+        plans, because the device cache imports rows as f32 values
+        regardless of what the PS tier stores."""
         from persia_tpu import hotness as _hotness
 
         if self.hotness is None:
             return _hotness.disabled_snapshot()
-        return self.hotness.snapshot()
+        snap = self.hotness.snapshot()
+        for table, t in snap.get("tables", {}).items():
+            t["row_bytes"] = int(table) * self._rp.itemsize
+        return snap
+
+    # --- disk spill tier -------------------------------------------------
+
+    def _spill_evicted(self, evicted):
+        """Demote entries a shard insert evicted (runs under that
+        shard's lock; the spill lock is a leaf below it)."""
+        for sign, (dim, vec) in evicted:
+            self.spill.put(sign, dim, vec)
+
+    def _insert_locked(self, shard, sign: int, dim: int, vec: np.ndarray):
+        """Shard insert that keeps the ladder invariant — a resident
+        sign never also has a (stale) spill copy — and demotes whatever
+        the insert evicted instead of dropping it."""
+        if self.spill is None:
+            shard.insert(sign, dim, vec)
+            return
+        self.spill.discard(sign)
+        self._spill_evicted(shard.insert(sign, dim, vec))
+
+    def _fault_in_locked(self, shard, sign: int, training: bool):
+        """Transparent fault-in of a spilled row on a shard miss (under
+        the shard's lock). Training accesses TAKE the row and re-insert
+        it resident — promotion back up the ladder, which may demote
+        other rows in turn; read-only accesses PEEK, so eval/serving
+        lookups never mutate tier residency. Returns the ``(dim, vec)``
+        entry, or None when the sign is not spilled either. A missing/
+        truncated packet raises :class:`~persia_tpu.ps.spill.
+        SpillReadError` — loud, with the holder untouched."""
+        got = (self.spill.take(sign) if training
+               else self.spill.peek(sign))
+        if got is None:
+            return None
+        dim0, raw = got
+        vec = raw.view(np.float32) if self._rp.is_fp32 else raw
+        if training:
+            self._spill_evicted(shard.insert(sign, dim0, vec))
+        return (dim0, vec)
+
+    def spill_stats(self) -> dict:
+        """The disk tier's health counters (empty when unarmed)."""
+        return self.spill.stats() if self.spill is not None else {}
 
     # --- control plane -------------------------------------------------
 
@@ -331,6 +407,7 @@ class EmbeddingHolder:
             return self._lookup_half(signs, dim, training, shard_ids,
                                      init_vecs if training else None,
                                      admitted if training else None, out)
+        spill = self.spill
         for shard_idx in np.unique(shard_ids):
             sel = np.nonzero(shard_ids == shard_idx)[0]
             shard = self._shards[shard_idx]
@@ -341,6 +418,9 @@ class EmbeddingHolder:
                     entry = (
                         shard.get_refresh(sign) if training else shard.get(sign)
                     )
+                    if entry is None and spill is not None:
+                        entry = self._fault_in_locked(shard, sign,
+                                                      training)
                     if entry is not None and entry[0] == dim:
                         out[pos] = entry[1][:dim]
                     elif not training:
@@ -354,7 +434,7 @@ class EmbeddingHolder:
                         # unconditionally, reference mod.rs:213-228)
                         vec = init_vecs[pos].copy()
                         out[pos] = vec[:dim]
-                        shard.insert(sign, dim, vec)
+                        self._insert_locked(shard, sign, dim, vec)
                         self._index_miss[shard_idx] += 1
                         n_miss += 1
             if n_miss:
@@ -389,6 +469,7 @@ class EmbeddingHolder:
                 narrowed[0] = (stored_rows, widened)
             return narrowed[0]
 
+        spill = self.spill
         for shard_idx in np.unique(shard_ids):
             sel = np.nonzero(shard_ids == shard_idx)[0]
             shard = self._shards[shard_idx]
@@ -401,6 +482,9 @@ class EmbeddingHolder:
                     entry = (
                         shard.get_refresh(sign) if training else shard.get(sign)
                     )
+                    if entry is None and spill is not None:
+                        entry = self._fault_in_locked(shard, sign,
+                                                      training)
                     if entry is not None and entry[0] == dim:
                         hit_pos.append(pos)
                         hit_vecs.append(entry[1])
@@ -413,7 +497,8 @@ class EmbeddingHolder:
                     else:
                         stored_rows, widened = narrow_inits()
                         out[pos] = widened[pos]
-                        shard.insert(sign, dim, stored_rows[pos].copy())
+                        self._insert_locked(shard, sign, dim,
+                                            stored_rows[pos].copy())
                         self._index_miss[shard_idx] += 1
                         n_miss += 1
                 if hit_pos:
@@ -462,6 +547,12 @@ class EmbeddingHolder:
                 found_entries: List[np.ndarray] = []
                 for pos in sel:
                     entry = shard.get(int(signs[pos]))
+                    if entry is None and self.spill is not None:
+                        # gradient for a spilled row: fault it in and
+                        # apply — the ladder is one logical table, a
+                        # demotion must not turn updates into misses
+                        entry = self._fault_in_locked(
+                            shard, int(signs[pos]), True)
                     if entry is not None and entry[0] == dim and \
                             len(entry[1]) == stored_len:
                         if has_dups:
@@ -508,11 +599,16 @@ class EmbeddingHolder:
     def get_entry(self, sign: int) -> Optional[Tuple[int, np.ndarray]]:
         """(dim, f32 [emb|state]) or None. fp32 holders hand out the
         live stored buffer (legacy semantics); half holders widen into a
-        fresh copy."""
+        fresh copy. A spilled row reads through (peek — inc-update and
+        checkpoint readers must see one logical table without churning
+        tier residency)."""
         shard_idx = int(internal_shard_of(np.array([sign], dtype=np.uint64),
                                           self.num_internal_shards)[0])
         with self._locks[shard_idx]:
             entry = self._shards[shard_idx].get(sign)
+            if entry is None and self.spill is not None:
+                entry = self._fault_in_locked(
+                    self._shards[shard_idx], int(sign), False)
             if entry is None or self._rp.is_fp32:
                 return entry
             return entry[0], self._rp.unpack(entry[1], entry[0])
@@ -523,7 +619,7 @@ class EmbeddingHolder:
         stored = self._rp.pack(
             np.ascontiguousarray(vec, dtype=np.float32), dim)
         with self._locks[shard_idx]:
-            self._shards[shard_idx].insert(sign, dim, stored)
+            self._insert_locked(self._shards[shard_idx], sign, dim, stored)
 
     def get_entries(self, signs: np.ndarray, width: int):
         """Batched ``get_entry`` for uniform-width entries (value + opt
@@ -542,6 +638,13 @@ class EmbeddingHolder:
                 shard = self._shards[shard_idx]
                 for pos in sel:
                     entry = shard.get(int(signs[pos]))
+                    if entry is None and self.spill is not None:
+                        # read-only reach into the disk tier (the
+                        # device cache's miss import follows a training
+                        # lookup, so the row is usually resident by
+                        # now; direct readers still see one table)
+                        entry = self._fault_in_locked(
+                            shard, int(signs[pos]), False)
                     if entry is None:
                         continue
                     if rp.is_fp32:
@@ -572,15 +675,24 @@ class EmbeddingHolder:
                 for pos in sel:
                     stored = (vecs[pos].copy() if rp.is_fp32
                               else rp.pack(vecs[pos], dim))
-                    shard.insert(int(signs[pos]), dim, stored)
+                    self._insert_locked(shard, int(signs[pos]), dim,
+                                        stored)
 
     def clear(self):
         for lock, shard in zip(self._locks, self._shards):
             with lock:
                 shard.clear()
+        if self.spill is not None:
+            # persialint: ok[lock-discipline] SpillStore guards its own state with its leaf lock; shard locks never guard the spill binding
+            self.spill.clear()
 
     def __len__(self) -> int:
-        return sum(len(s) for s in self._shards)
+        """Rows in the LOGICAL table: resident plus spilled (the ladder
+        demotes, it does not delete)."""
+        n = sum(len(s) for s in self._shards)
+        if self.spill is not None:
+            n += len(self.spill)
+        return n
 
     # --- serialization (PSD1, shared with native/src/store.h) -----------
 
@@ -599,30 +711,76 @@ class EmbeddingHolder:
         The header count is derived from the records actually serialized
         (each shard under its own lock) — never from an unlocked size
         snapshot, which concurrent inserts/evictions could invalidate and
-        leave the checkpoint unloadable."""
+        leave the checkpoint unloadable.
+
+        A spill-armed holder serializes the disk tier too — a checkpoint
+        is the LOGICAL table, regardless of which rung a row occupies
+        (spilled rows were serialized in their stored byte form, so the
+        round trip is exact). Shards serialize first and the spill
+        index last, so a row DEMOTED mid-dump is always caught by one
+        of the two passes; the reverse migration (fault-in/discard
+        removing a spilled row after its destination shard was already
+        serialized) is covered by the spill store's dump capture,
+        whose records are prepended so any newer shard/spill record of
+        the same sign wins on load."""
         rp = self._rp
         chunks = []
         count = 0
-        if rp.is_fp32:
+        if self.spill is not None:
+            self.spill.start_dump_capture()
+        try:
+            if rp.is_fp32:
+                for lock, shard in zip(self._locks, self._shards):
+                    with lock:
+                        for sign, (dim, vec) in shard.items_in_lru_order():
+                            chunks.append(
+                                struct.pack("<QII", sign, dim, len(vec)))
+                            chunks.append(np.ascontiguousarray(
+                                vec, dtype=np.float32).tobytes())
+                            count += 1
+                front = []
+                if self.spill is not None:
+                    for sign, dim, raw in self.spill.items():
+                        chunks.append(struct.pack("<QII", sign, dim,
+                                                  len(raw) // 4))
+                        chunks.append(raw.tobytes())
+                        count += 1
+                    for sign, (dim, raw) in \
+                            self.spill.stop_dump_capture().items():
+                        front.append(struct.pack("<QII", sign, dim,
+                                                 len(raw) // 4))
+                        front.append(raw.tobytes())
+                        count += 1
+                return b"".join(
+                    [DUMP_MAGIC, struct.pack("<IQ", 1, count)]
+                    + front + chunks)
+            code = _DTYPE_CODES[rp.name]
             for lock, shard in zip(self._locks, self._shards):
                 with lock:
                     for sign, (dim, vec) in shard.items_in_lru_order():
-                        chunks.append(struct.pack("<QII", sign, dim, len(vec)))
-                        chunks.append(np.ascontiguousarray(
-                            vec, dtype=np.float32).tobytes())
+                        state_len = rp.state_len_of(vec, dim)
+                        chunks.append(struct.pack("<QIBI", sign, dim, code,
+                                                  state_len))
+                        chunks.append(vec.tobytes())
                         count += 1
-            return b"".join(
-                [DUMP_MAGIC, struct.pack("<IQ", 1, count)] + chunks)
-        code = _DTYPE_CODES[rp.name]
-        for lock, shard in zip(self._locks, self._shards):
-            with lock:
-                for sign, (dim, vec) in shard.items_in_lru_order():
-                    state_len = rp.state_len_of(vec, dim)
+            front = []
+            if self.spill is not None:
+                for sign, dim, raw in self.spill.items():
                     chunks.append(struct.pack("<QIBI", sign, dim, code,
-                                              state_len))
-                    chunks.append(vec.tobytes())
+                                              rp.state_len_of(raw, dim)))
+                    chunks.append(raw.tobytes())
                     count += 1
-        return b"".join([DUMP_MAGIC, struct.pack("<IQ", 2, count)] + chunks)
+                for sign, (dim, raw) in \
+                        self.spill.stop_dump_capture().items():
+                    front.append(struct.pack("<QIBI", sign, dim, code,
+                                             rp.state_len_of(raw, dim)))
+                    front.append(raw.tobytes())
+                    count += 1
+            return b"".join(
+                [DUMP_MAGIC, struct.pack("<IQ", 2, count)] + front + chunks)
+        finally:
+            if self.spill is not None:
+                self.spill.stop_dump_capture()
 
     def load_bytes(self, buf: bytes, clear: bool = True):
         import io

@@ -244,6 +244,28 @@ class PsService:
                           {"server": port_label, "shard": str(i)})
                 for i in range(holder.num_internal_shards)
             ]
+        # disk-tier gauges (spill-armed Python holder only)
+        self._spill_gauges = None
+        if getattr(holder, "spill", None) is not None:
+            self._spill_gauges = {
+                "spilled_rows": reg.gauge(
+                    "ps_spill_resident_rows", {"server": port_label},
+                    help_text="rows currently demoted to the disk "
+                              "spill tier"),
+                "spill_disk_bytes": reg.gauge(
+                    "ps_spill_disk_bytes", {"server": port_label},
+                    help_text="bytes of live spill packets on disk"),
+                "spilled_rows_total": reg.gauge(
+                    "ps_spill_demotions_total", {"server": port_label},
+                    help_text="rows ever demoted RAM->disk (monotone)"),
+                "spill_fault_ins_total": reg.gauge(
+                    "ps_spill_fault_ins_total", {"server": port_label},
+                    help_text="rows ever faulted disk->RAM (monotone)"),
+                "spill_dropped_rows": reg.gauge(
+                    "ps_spill_dropped_rows_total", {"server": port_label},
+                    help_text="rows dropped with their packet when the "
+                              "disk budget overflowed (monotone)"),
+            }
         from persia_tpu.metrics import STEP_BUCKETS
 
         self._h_staleness = reg.histogram(
@@ -267,6 +289,10 @@ class PsService:
             for g, b in zip(self._mem_gauges,
                             self.holder.resident_bytes_per_shard()):
                 g.set(b)
+        if self._spill_gauges is not None:
+            stats = self.holder.spill_stats()
+            for key, g in self._spill_gauges.items():
+                g.set(stats.get(key, 0))
 
     def _health_rpc(self, payload: bytes) -> bytes:
         return msgpack.packb(self._health())
@@ -310,6 +336,14 @@ class PsService:
         doc["hotness_enabled"] = getattr(self.holder, "hotness",
                                          None) is not None
         doc["update_version"] = self._current_update_ver()
+        # disk spill tier (the cold rung of the storage ladder): row/
+        # byte/fault-in accounting for capacity planning and the tier
+        # bench's per-level hit breakdown; absent when unarmed
+        spill_stats = getattr(self.holder, "spill_stats", None)
+        if spill_stats is not None:
+            stats = spill_stats()
+            if stats:
+                doc["spill"] = stats
         if self.inc_loader is not None:
             # serving freshness: how far behind the train tier this
             # replica's hot-loaded rows run (scan-time delay; the
@@ -436,6 +470,14 @@ class PsService:
     def _set_entry(self, payload: bytes) -> bytes:
         meta, (vec,) = unpack_arrays(payload)
         self.holder.set_entry(meta["sign"], meta["dim"], vec)
+        # a full-row write is an update: it joins the version stream
+        # and the incremental-update log exactly like a gradient apply,
+        # so checkpoint replay and train->serve sync see one logical
+        # table whether a row trained PS-side or device-side
+        self._bump_update_ver()
+        if self.inc_dumper is not None:
+            self.inc_dumper.commit(
+                np.asarray([meta["sign"]], dtype=np.uint64))
         return b""
 
     def _get_entries(self, payload: bytes) -> bytes:
@@ -451,6 +493,21 @@ class PsService:
         self.holder.set_entries(
             signs, meta["dim"],
             vecs.reshape(len(signs), -1))
+        # the device cache's eviction/flush write-back: versioned like
+        # update_gradients (write-backs are ordered with gradient
+        # applies in one stream) and committed to the inc-update log —
+        # before this, rows that trained on device never reached
+        # incremental packets, so crash replay and serving hot-load
+        # silently missed them
+        ver = self._bump_update_ver()
+        if self.inc_dumper is not None:
+            self.inc_dumper.commit(signs)
+        if meta.get("wv"):
+            # versioned write-back rider (reply-only-when-asked, like
+            # hv/hver): the client learns which version its write-back
+            # became, so flush completion can be ordered against
+            # concurrent gradient traffic. Off = empty legacy reply.
+            return msgpack.packb({"ver": ver})
         return b""
 
     def _clear(self, payload: bytes) -> bytes:
@@ -609,6 +666,9 @@ class PsClient:
             hotness = knobs.get("PERSIA_HOTNESS")
         self.telemetry = bool(hotness)
         self._last_hver: Optional[int] = None
+        # last update version a versioned set_entries write-back became
+        # (None until the first armed write-back answers)
+        self.last_writeback_ver: Optional[int] = None
         # wire codec policy (None -> PERSIA_PS_WIRE_CODEC env): "fp16"
         # ships lookup responses as fp16 rows, "fp16+int8" additionally
         # ships update gradients as int8 + per-row scales with the fp32
@@ -850,11 +910,23 @@ class PsClient:
                 vecs.reshape(len(signs), width).astype(np.float32))
 
     def set_entries(self, signs: np.ndarray, dim: int, vecs: np.ndarray):
-        self._guarded(lambda: self.client.call(
-            "set_entries", self._pack({"dim": int(dim)}, [
+        meta = {"dim": int(dim)}
+        if self.telemetry:
+            # versioned write-back (tier-ladder coherence): ask the
+            # replica which update version this write became; off, the
+            # request and the empty reply are byte-identical to legacy
+            meta["wv"] = 1
+        resp = self._guarded(lambda: self.client.call(
+            "set_entries", self._pack(meta, [
                 np.ascontiguousarray(signs, np.uint64),
                 np.ascontiguousarray(vecs, np.float32),
             ]), dedup=True))
+        if meta.get("wv") and resp:
+            ver = msgpack.unpackb(resp, raw=False).get("ver")
+            if ver is not None:
+                # GIL-atomic store like _note_hver; any recent version
+                # is a valid ordering anchor
+                self.last_writeback_ver = int(ver)
 
     def clear(self):
         self._guarded(lambda: self.client.call("clear"))
@@ -913,6 +985,20 @@ def main():
                         "parameter_server.row_dtype. Python holder only "
                         "— rejected loudly when the native backend is "
                         "active (set PERSIA_FORCE_PYTHON_PS=1)")
+    p.add_argument("--spill-dir",
+                   default=knobs.get("PERSIA_TIER_SPILL_DIR"),
+                   help="arm the disk spill tier: budget evictions "
+                        "demote rows to spill packets under "
+                        "<dir>/r<replica-index> (PersiaPath — local or "
+                        "hdfs://) instead of dropping them; lookups "
+                        "fault them back transparently. Python holder "
+                        "only (loud lint on the native store). "
+                        "Overrides parameter_server.spill_dir")
+    p.add_argument("--spill-bytes", type=int,
+                   default=knobs.get("PERSIA_TIER_SPILL_BYTES"),
+                   help="disk budget for the spill tier (0 = "
+                        "unbounded); oldest packets are dropped whole "
+                        "on overflow")
     from persia_tpu import obs_http
 
     obs_http.add_http_args(p)
@@ -944,12 +1030,20 @@ def main():
         _gcmod.set_threshold(50_000, 25, 100)
 
     gc = GlobalConfig.load(args.global_config) if args.global_config else GlobalConfig()
+    # replicas share one spill_dir config; each keeps its packets in
+    # its own subdirectory (the inc_update packet-name convention)
+    spill_dir = args.spill_dir or gc.parameter_server.spill_dir or None
+    if spill_dir:
+        spill_dir = os.path.join(spill_dir, f"r{args.replica_index}")
     holder = make_holder(gc.parameter_server.capacity,
                          gc.parameter_server.num_hashmap_internal_shards,
                          row_dtype=args.row_dtype
                          or gc.parameter_server.row_dtype,
                          capacity_bytes=gc.parameter_server.capacity_bytes
-                         or None)
+                         or None,
+                         spill_dir=spill_dir,
+                         spill_bytes=args.spill_bytes
+                         or gc.parameter_server.spill_bytes or None)
     inc_dumper = None
     inc_loader = None
     if gc.parameter_server.enable_incremental_update:

@@ -175,6 +175,21 @@ def default_rules() -> List[SloRule]:
                 description="no incremental packet applied for over "
                             "10 minutes — the train->serve sync loop "
                             "is stalled"),
+        # tier-ladder health: the device cache's whole value is hits
+        # never paying the PS cycle; a collapsed hit rate means every
+        # step silently degrades to flat-PS speed. ratio() is 0 while
+        # the probes counter does not move, so uncached trainers never
+        # page on this.
+        SloRule("device_cache_hit_collapse",
+                "ratio(device_cache_misses_total,"
+                " device_cache_probes_total)",
+                ">", 0.5, window_sec=120.0, for_sec=60.0,
+                severity="ticket",
+                description="device-cache hit rate below 50% over 2 "
+                            "minutes — the HBM tier is thrashing (hot "
+                            "set outgrew capacity, or cold traffic is "
+                            "flooding admission); training pays the "
+                            "PS cycle on most rows"),
     ]
 
 

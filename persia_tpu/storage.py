@@ -37,6 +37,24 @@ class PersiaPath:
         with open(self.path, "rb") as f:
             return f.read()
 
+    def read_range(self, offset: int, length: int) -> bytes:
+        """``length`` bytes starting at ``offset`` — the spill tier's
+        single-row fault-in. Local paths seek; HDFS has no cheap random
+        read through the CLI, so it degrades to a full read + slice
+        (spill packets are bounded, see ps/spill.py). Short reads raise
+        (a truncated packet must fail loudly, not hand back garbage)."""
+        if self.is_hdfs:
+            data = self.read_bytes()[offset:offset + length]
+        else:
+            with open(self.path, "rb") as f:
+                f.seek(offset)
+                data = f.read(length)
+        if len(data) != length:
+            raise IOError(
+                f"{self.path}: short read ({len(data)} of {length} bytes "
+                f"at offset {offset})")
+        return data
+
     def write_bytes(self, data: bytes):
         if self.is_hdfs:
             proc = subprocess.Popen(
@@ -52,6 +70,19 @@ class PersiaPath:
             os.makedirs(parent, exist_ok=True)
         with open(self.path, "wb") as f:
             f.write(data)
+
+    def write_bytes_atomic(self, data: bytes):
+        """All-or-nothing write: the destination either keeps its old
+        content (or stays absent) or holds ``data`` in full — never a
+        torn prefix. Local paths write ``<name>.tmp`` then rename (POSIX
+        atomic within a filesystem); HDFS ``-put -f -`` already replaces
+        whole files, so plain write_bytes is the same guarantee."""
+        if self.is_hdfs:
+            self.write_bytes(data)
+            return
+        tmp = PersiaPath(self.path + ".tmp")
+        tmp.write_bytes(data)
+        os.replace(tmp.path, self.path)
 
     def exists(self) -> bool:
         if self.is_hdfs:

@@ -141,9 +141,19 @@ class NativeSignSlotMap:
         return self.hits / total if total else 0.0
 
 
-def make_sign_slot_map(capacity: int):
-    """Native mapper when the lib is built, python fallback otherwise
-    (same contract either way; parity-tested)."""
+def make_sign_slot_map(capacity: int, admission: str = "lru"):
+    """Mapper for the device cache's admission policy. ``lru`` (the
+    default) keeps the legacy recency-only mapper — native when the lib
+    is built, python fallback otherwise (same contract either way;
+    parity-tested). ``hotness`` selects the frequency-admitted
+    :class:`TieredSignSlotMap` (python; the admission sketch and the
+    two-region bookkeeping have no native twin yet)."""
+    if admission == "hotness":
+        return TieredSignSlotMap(capacity)
+    if admission != "lru":
+        raise ValueError(
+            f"unknown device-cache admission policy {admission!r} "
+            "(expected 'lru' or 'hotness')")
     try:
         return NativeSignSlotMap(capacity)
     except (RuntimeError, OSError):
@@ -260,6 +270,384 @@ class SignSlotMap:
             return (np.empty(0, np.uint64), np.empty(0, np.int32))
         return (np.fromiter(self._map.keys(), np.uint64, len(self._map)),
                 np.fromiter(self._map.values(), np.int32, len(self._map)))
+
+    @property
+    def hit_rate(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
+
+class TieredSignSlotMap:
+    """Frequency-admitted sign->slot map: the HBM rung of the embedding
+    tier ladder (same ``assign`` contract as :class:`SignSlotMap`).
+
+    Pure LRU lets one-touch cold traffic thrash the cache: every cold
+    miss evicts SOME resident row, and under zipfian id streams a large
+    share of those victims are rows hot enough to return — each bounce
+    costs a PS miss import plus an eviction write-back. This mapper
+    splits residency (W-TinyLFU-style) into a small probationary
+    **window** (plain LRU — cold churn stays here) and a **protected**
+    region whose membership is gated by frequency: a Space-Saving
+    sketch (:class:`persia_tpu.hotness.SpaceSaving` — the same summary
+    the PS-side telemetry runs) counts the id stream, and a window row
+    is promoted only when its count beats the protected LRU victim's.
+    Promotion is a pure membership move — the sign keeps its slot, so
+    no device row ever has to be copied; evictions therefore stay
+    exactly 1:1 with miss imports (the fused step reads an evicted row
+    out of precisely the slot the miss overwrites).
+
+    Policy, per distinct batch sign in first-occurrence order (batch
+    order defines LRU order at first-occurrence granularity, and
+    current-batch signs are pinned, exactly as the LRU mapper):
+
+    - protected hit / window hit: refresh; a window hit additionally
+      promotes when the protected region has room (it only has room
+      during warm-up or after ``drop``).
+    - miss with a free slot: protected while it is warming up, the
+      window afterwards.
+    - miss at capacity: let the window's LRU candidate ``w`` and the
+      protected LRU candidate ``h`` compete on sketch counts. If
+      ``count(w) > count(h)``, ``w`` has earned residency: promote it
+      (keeping its slot), evict ``h``, and the newcomer takes ``h``'s
+      slot in the window. Otherwise evict ``w`` — the one-touch cold
+      row dies in the window and the protected set never notices.
+
+    Implementation: membership lives in a flat open-addressing hash
+    (sign -> slot, linear probing, tombstone deletes), so a whole
+    batch is probed in a handful of vectorized passes; region,
+    recency, and the reverse sign map are slot-indexed arrays. Recency
+    is a per-batch stamp per slot (LRU = smallest stamp, ties broken
+    by slot id) — one fancy assignment refreshes 100k positions where
+    an ordered dict pays 100k moves. Within-batch recency order is
+    deliberately not tracked: current-batch signs are pinned, so it
+    could only ever break ties between rows touched by the same batch.
+    ``inverse``/``unique_slots`` fall out of the sign<->slot bijection
+    (slot numbers ARE distinct ids) without a second sort. Only the
+    miss path (rare once the hot set is resident) loops in python,
+    over missing DISTINCT signs.
+    """
+
+    _H_MULT = 0x9E3779B97F4A7C15  # fibonacci multiplier, splits u64 keys
+
+    def __init__(self, capacity: int, window_frac: Optional[float] = None,
+                 sketch_k: Optional[int] = None):
+        if capacity < 2:
+            raise ValueError(
+                "tiered cache capacity must be >= 2 (one window slot "
+                "plus one protected slot)")
+        from persia_tpu import knobs
+        from persia_tpu.hotness import SpaceSaving
+
+        if window_frac is None:
+            window_frac = knobs.get("PERSIA_TIER_WINDOW_FRAC")
+        if not 0.0 < window_frac < 1.0:
+            raise ValueError(
+                f"window_frac must be in (0, 1), got {window_frac}")
+        if sketch_k is None:
+            sketch_k = knobs.get("PERSIA_TIER_SKETCH_TOPK")
+        if not sketch_k:
+            sketch_k = min(4 * int(capacity), 1 << 20)
+        self.capacity = int(capacity)
+        self.window_cap = max(1, int(self.capacity * window_frac))
+        self.hot_cap = self.capacity - self.window_cap
+        # slot-indexed: 0 = free, 1 = window, 2 = protected
+        self._state = np.zeros(self.capacity, dtype=np.int8)
+        self._sign = np.zeros(self.capacity, dtype=np.uint64)
+        self._stamp = np.zeros(self.capacity, dtype=np.int64)
+        self._free: List[int] = list(range(self.capacity - 1, -1, -1))
+        self._hot_n = 0
+        self._win_n = 0
+        self._clock = 0
+        self._sketch = SpaceSaving(int(sketch_k))
+        # W-TinyLFU-style aging: halve the sketch once per this many
+        # observed positions, so a hot-set shift can't leave stale
+        # giants blocking admission forever (a newly hot row only has
+        # to out-count the old guard's DECAYED counts)
+        self._decay_window = 16 * self.capacity
+        self._decay_left = self._decay_window
+        # open-addressing sign -> slot index, load factor <= 0.5 at
+        # full residency (emptiness lives in the slot value: -1 empty,
+        # -2 tombstone; sign 0 is a legal key)
+        size = 8
+        while size < 2 * self.capacity:
+            size <<= 1
+        self._h_size = size
+        self._h_mask = size - 1
+        self._h_shift = 65 - size.bit_length()
+        self._h_sign = np.zeros(size, dtype=np.uint64)
+        self._h_slot = np.full(size, -1, dtype=np.int32)
+        self._h_fill = 0  # occupied + tombstones (what bounds probes)
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.promotions = 0
+
+    def __len__(self) -> int:
+        return self._hot_n + self._win_n
+
+    # --- sign -> slot hash (membership) ---------------------------------
+
+    def _h_probe(self, keys: np.ndarray) -> np.ndarray:
+        """Bulk lookup: int32 slot per key, -1 for absent. Each round
+        resolves every key whose current probe cell is a hit (slot
+        found) or a virgin empty (definitely absent); mismatched
+        occupied cells and tombstones advance to the next cell."""
+        mask = self._h_mask
+        out = np.full(len(keys), -1, dtype=np.int32)
+        idx = ((keys * np.uint64(self._H_MULT))
+               >> np.uint64(self._h_shift)).astype(np.int64)
+        pend = np.arange(len(keys))
+        kp = keys
+        while len(pend):
+            sl = self._h_slot[idx]
+            found = (sl >= 0) & (self._h_sign[idx] == kp)
+            if found.any():
+                out[pend[found]] = sl[found]
+            cont = ~found & (sl != -1)
+            pend = pend[cont]
+            kp = kp[cont]
+            idx = (idx[cont] + 1) & mask
+        return out
+
+    def _h_find_pos(self, sign: int) -> int:
+        """Scalar probe: table cell holding ``sign``, or -1."""
+        mask = self._h_mask
+        h_sign, h_slot = self._h_sign, self._h_slot
+        i = ((sign * self._H_MULT) & 0xFFFFFFFFFFFFFFFF) >> self._h_shift
+        while True:
+            sl = h_slot[i]
+            if sl == -1:
+                return -1
+            if sl >= 0 and h_sign[i] == sign:
+                return i
+            i = (i + 1) & mask
+
+    def _h_insert(self, sign: int, slot: int) -> None:
+        """Scalar insert (caller guarantees ``sign`` is absent).
+        Tombstones are reclaimed; virgin empties grow the fill, and
+        when fill passes 3/4 the table is rebuilt tombstone-free
+        (amortized over >= size/4 deletes — residency itself can never
+        pass 1/2)."""
+        mask = self._h_mask
+        h_slot = self._h_slot
+        i = ((sign * self._H_MULT) & 0xFFFFFFFFFFFFFFFF) >> self._h_shift
+        while h_slot[i] >= 0:
+            i = (i + 1) & mask
+        if h_slot[i] == -1:
+            self._h_fill += 1
+        self._h_sign[i] = sign
+        h_slot[i] = slot
+        if 4 * self._h_fill > 3 * self._h_size:
+            self._h_rebuild()
+
+    def _h_rebuild(self) -> None:
+        mask = self._h_mask
+        self._h_sign = np.zeros(self._h_size, dtype=np.uint64)
+        self._h_slot = np.full(self._h_size, -1, dtype=np.int32)
+        h_sign, h_slot = self._h_sign, self._h_slot
+        res = np.nonzero(self._state > 0)[0]
+        for slot, sign in zip(res.tolist(),
+                              self._sign[res].tolist()):
+            i = ((sign * self._H_MULT) & 0xFFFFFFFFFFFFFFFF) \
+                >> self._h_shift
+            while h_slot[i] != -1:
+                i = (i + 1) & mask
+            h_sign[i] = sign
+            h_slot[i] = slot
+        self._h_fill = len(res)
+
+    def _victim_queues(self, uniq: np.ndarray):
+        """Per-assign eviction cursors: each region's unpinned slots in
+        LRU (stamp) order plus their sketch counts, all frozen for the
+        whole batch (the batch is folded into the sketch before any
+        eviction decision). One sort + one bulk count query replaces
+        the per-miss pinned-prefix rescan and per-victim point probe,
+        which went quadratic once the map reached capacity. Entries
+        that leave their region mid-batch (promotion) or whose slot
+        was reused (eviction) are skipped at the cursor."""
+        res = np.nonzero(self._state > 0)[0]
+        res = res[np.argsort(self._stamp[res], kind="stable")]
+        sgs = self._sign[res]
+        unpinned = ~np.isin(sgs, uniq)
+        st = self._state[res]
+        wm = (st == 1) & unpinned
+        hm = (st == 2) & unpinned
+        wcnts = self._sketch.counts_of(sgs[wm])
+        hcnts = self._sketch.counts_of(sgs[hm])
+        return [res[wm].tolist(), sgs[wm].tolist(), wcnts.tolist(), 0,
+                res[hm].tolist(), sgs[hm].tolist(), hcnts.tolist(), 0]
+
+    def _admit(self, uniq, mu, order, mslots):
+        """Slot allocation for this batch's missing distinct signs
+        ``mu`` (sign-sorted; visited in batch first-occurrence order
+        via ``order``): free slots while they last, then the
+        window-vs-protected victim competition of the class docstring.
+        Fills ``mslots`` (aligned with ``mu``) and returns the
+        per-miss (evicted sign, real-eviction mask) in visit order."""
+        state, sgn = self._state, self._sign
+        evicted = np.zeros(len(mu), dtype=np.uint64)
+        emask = np.zeros(len(mu), dtype=bool)
+        vq = None  # victim queues, built on the first at-capacity miss
+        for k, j in enumerate(order.tolist()):
+            s = int(mu[j])
+            if self._free:
+                slot = self._free.pop()
+                if self._hot_n < self.hot_cap:
+                    state[slot] = 2  # warm-up: no signal to gate on yet
+                    self._hot_n += 1
+                else:
+                    state[slot] = 1
+                    self._win_n += 1
+            else:
+                while True:
+                    if vq is None:
+                        vq = self._victim_queues(uniq)
+                    (wslots, wsigns, wcnts, wi,
+                     hslots, hsigns, hcnts, hi) = vq
+                    while wi < len(wslots) and not (
+                            state[wslots[wi]] == 1
+                            and sgn[wslots[wi]] == wsigns[wi]):
+                        wi += 1
+                    while hi < len(hslots) and not (
+                            state[hslots[hi]] == 2
+                            and sgn[hslots[hi]] == hsigns[hi]):
+                        hi += 1
+                    w_ok, h_ok = wi < len(wslots), hi < len(hslots)
+                    if w_ok or h_ok:
+                        break
+                    # both cursors dry: each competition consumed TWO
+                    # entries (promoted w + evicted h), so the frozen
+                    # queues can exhaust while unpinned residents
+                    # remain (capacity >= batch distinct guarantees
+                    # one per remaining miss) — rebuild and continue
+                    vq = None
+                if w_ok and h_ok and wcnts[wi] > hcnts[hi]:
+                    # the window candidate out-counts the protected
+                    # victim: it earned residency — promote it (its
+                    # slot moves with it), evict the protected LRU,
+                    # and the newcomer takes the freed slot. Region
+                    # counts net out: one in, one out of each.
+                    state[wslots[wi]] = 2
+                    wi += 1
+                    victim, slot = hsigns[hi], hslots[hi]
+                    hi += 1
+                    self.promotions += 1
+                elif w_ok:
+                    victim, slot = wsigns[wi], wslots[wi]
+                    wi += 1
+                else:
+                    victim, slot = hsigns[hi], hslots[hi]
+                    hi += 1
+                    self._hot_n -= 1
+                    self._win_n += 1
+                vq[3], vq[7] = wi, hi
+                pos = self._h_find_pos(victim)
+                self._h_slot[pos] = -2  # tombstone keeps chains intact
+                state[slot] = 1  # newcomers enter through the window
+                evicted[k] = victim
+                emask[k] = True
+                self.evictions += 1
+            # reverse map first: _h_insert may trigger _h_rebuild, which
+            # re-derives the hash from _state/_sign — a stale sgn[slot]
+            # would resurrect the previous occupant as a live alias
+            sgn[slot] = s
+            self._h_insert(s, slot)
+            mslots[j] = slot
+        return evicted, emask
+
+    def assign(self, signs: np.ndarray) -> AssignResult:
+        signs = np.ascontiguousarray(signs, dtype=np.uint64)
+        n = len(signs)
+        if n == 0:
+            return AssignResult(
+                np.empty(0, np.int32), np.empty(0, np.int64),
+                np.empty(0, np.uint64), np.empty(0, bool),
+                np.empty(0, np.int32), np.empty(0, np.int32), 0)
+        uniq, ucounts = np.unique(signs, return_counts=True)
+        nu = len(uniq)
+        if nu > self.capacity:
+            raise ValueError(
+                f"batch has {nu} distinct signs but cache "
+                f"capacity is {self.capacity}; eviction pinning needs "
+                "capacity >= distinct signs per batch")
+        # fold the batch into the admission sketch first (vectorized),
+        # so this batch's own touches count toward its admissions
+        self._decay_left -= n
+        if self._decay_left <= 0:
+            self._sketch.decay()
+            self._decay_left = self._decay_window
+        self._sketch.offer_many(uniq, ucounts.astype(np.float64))
+        pslots = self._h_probe(signs)  # per-position; -1 = miss
+        n_miss = 0
+        miss_pos = np.empty(0, dtype=np.int64)
+        evicted = np.empty(0, dtype=np.uint64)
+        emask = np.empty(0, dtype=bool)
+        hit_any = int(pslots.max(initial=-1)) >= 0
+        if hit_any and self._hot_n < self.hot_cap:
+            # window hits promote while the protected region has room
+            # (warm-up / post-drop) — membership moves, slots never do
+            hflag = np.zeros(self.capacity, dtype=bool)
+            hflag[pslots[pslots >= 0]] = True
+            wh = np.nonzero(hflag & (self._state == 1))[0]
+            room = self.hot_cap - self._hot_n
+            if len(wh):
+                wh = wh[:room]
+                self._state[wh] = 2
+                self._hot_n += len(wh)
+                self._win_n -= len(wh)
+                self.promotions += len(wh)
+        mpos_all = np.nonzero(pslots < 0)[0]
+        if len(mpos_all):
+            msigns = signs[mpos_all]
+            mu, m_first = np.unique(msigns, return_index=True)
+            n_miss = len(mu)
+            # visit misses in batch (first-occurrence) order; m_first
+            # indexes the ascending mpos_all, so it orders positions
+            order = np.argsort(m_first, kind="stable")
+            miss_pos = mpos_all[m_first[order]].astype(np.int64)
+            mslots = np.empty(n_miss, dtype=np.int32)
+            evicted, emask = self._admit(uniq, mu, order, mslots)
+            pslots[mpos_all] = mslots[np.searchsorted(mu, msigns)]
+        self.hits += n - n_miss
+        self.misses += n_miss
+        # one batch = one recency tick for every touched slot (ties
+        # break by slot id; within-batch order can't matter — pinning)
+        self._stamp[pslots] = self._clock
+        self._clock += 1
+        # resident sign <-> slot is a bijection, so slot numbers ARE
+        # distinct ids: dense-rank them for inverse/unique_slots
+        flag = np.zeros(self.capacity, dtype=bool)
+        flag[pslots] = True
+        us = np.nonzero(flag)[0]
+        remap = np.zeros(self.capacity, dtype=np.int32)
+        remap[us] = np.arange(nu, dtype=np.int32)
+        unique_slots = np.empty(n, dtype=np.int32)
+        unique_slots[:nu] = us
+        return AssignResult(
+            pslots, miss_pos, evicted, emask,
+            remap[pslots], unique_slots, nu)
+
+    def drop(self, sign: int) -> Optional[int]:
+        """Remove a sign; returns its freed slot."""
+        pos = self._h_find_pos(int(sign))
+        if pos < 0:
+            return None
+        slot = int(self._h_slot[pos])
+        self._h_slot[pos] = -2
+        if self._state[slot] == 2:
+            self._hot_n -= 1
+        else:
+            self._win_n -= 1
+        self._state[slot] = 0
+        self._free.append(slot)
+        return slot
+
+    def signs_and_slots(self) -> Tuple[np.ndarray, np.ndarray]:
+        """All cached (signs, slots) across both regions."""
+        res = np.nonzero(self._state > 0)[0]
+        if len(res) == 0:
+            return (np.empty(0, np.uint64), np.empty(0, np.int32))
+        return (self._sign[res].copy(), res.astype(np.int32))
 
     @property
     def hit_rate(self) -> float:

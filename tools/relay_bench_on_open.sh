@@ -71,6 +71,15 @@ while [ "$(date +%s)" -lt "$END" ]; do
       #     matters; BENCH_telemetry.json lands next to this log
       step "bench telemetry (workload)" python bench.py \
         --mode telemetry --max-seconds 900
+      # 4g. hierarchical embedding tier (PR 9): spill parity, flat-vs-
+      #     ladder coherence + bit-consistent flush, off-wire pins, and
+      #     the flat PS vs LRU-cache vs hotness-ladder samples/s A/B —
+      #     on the TPU host the device cache's fused step runs on real
+      #     HBM, so the ladder speedup here is the production number
+      #     (the 2-core dev box's CPU-mesh scatter understates it);
+      #     BENCH_tier.json lands next to this log
+      step "bench tier (embedding ladder)" python bench.py \
+        --mode tier --max-seconds 1100
       # 5. re-capture the headline near the end of the window
       step "re-capture: python bench.py" python bench.py
       echo "$(date -u +%FT%TZ) chip sequence complete — see BENCH_CAPTURE_r05.log" >> "$LOG"
