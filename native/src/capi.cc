@@ -17,7 +17,57 @@ void* ptps_new(uint64_t capacity, uint32_t num_shards) {
   return new Store(capacity, num_shards);
 }
 
+// Arena-era constructor: storage dtype (0 fp32 | 1 fp16 | 2 bf16) and an
+// optional byte budget for eviction (0 = row-count capacity only).
+// Python probes for this symbol to learn whether the loaded .so speaks
+// the arena capabilities (persia_tpu/ps/native.py native_capabilities).
+void* ptps_new2(uint64_t capacity, uint32_t num_shards, int dtype_code,
+                uint64_t capacity_bytes) {
+  if (dtype_code < 0 || dtype_code > persia::kRowBF16) return nullptr;
+  return new Store(capacity, num_shards,
+                   static_cast<persia::RowDtype>(dtype_code), capacity_bytes);
+}
+
 void ptps_free(void* h) { delete static_cast<Store*>(h); }
+
+int ptps_row_dtype(void* h) {
+  return static_cast<int>(static_cast<Store*>(h)->row_dtype());
+}
+
+uint64_t ptps_resident_bytes(void* h) {
+  return static_cast<Store*>(h)->resident_bytes();
+}
+
+uint64_t ptps_resident_emb_bytes(void* h) {
+  return static_cast<Store*>(h)->resident_emb_bytes();
+}
+
+void ptps_shard_resident_bytes(void* h, uint64_t* out) {
+  static_cast<Store*>(h)->shard_resident_bytes(out);
+}
+
+// out[4] = {slab_bytes, free_slots, live_rows, logical_resident_bytes}
+void ptps_arena_stats(void* h, uint64_t* out) {
+  static_cast<Store*>(h)->arena_stats(out);
+}
+
+void ptps_set_retain_evicted(void* h, int on) {
+  static_cast<Store*>(h)->set_retain_evicted(on != 0);
+}
+
+uint64_t ptps_evicted_bytes(void* h) {
+  return static_cast<Store*>(h)->evicted_bytes();
+}
+
+uint64_t ptps_drain_evicted(void* h, uint8_t* buf, uint64_t cap) {
+  return static_cast<Store*>(h)->drain_evicted(buf, cap);
+}
+
+void ptps_contains(void* h, const uint64_t* signs, uint64_t n, uint8_t* out) {
+  Store* s = static_cast<Store*>(h);
+  for (uint64_t i = 0; i < n; ++i)
+    out[i] = static_cast<uint8_t>(s->contains(signs[i]));
+}
 
 // params: [lower, upper, mean, stddev, shape, scale, lambda]
 void ptps_configure(void* h, int method, const double* params,

@@ -9,6 +9,7 @@
 //
 // Usage: persia-embedding-ps --port 0 --capacity 1000000000
 //        --num-shards 100 --replica-index 0 [--coordinator host:port]
+//        [--row-dtype fp32|fp16|bf16] [--capacity-bytes N]
 #include <getopt.h>
 
 #include <atomic>
@@ -25,6 +26,10 @@
 #include "store.h"
 
 using persia::InitParams;
+using persia::kRowBF16;
+using persia::kRowF16;
+using persia::kRowF32;
+using persia::RowDtype;
 using persia::Store;
 namespace mp = persia::msgpack;
 namespace net = persia::net;
@@ -71,8 +76,9 @@ std::string optimizer_wire(const mp::Value& cfg, uint32_t prefix_bit) {
 
 class PsServer {
  public:
-  PsServer(uint64_t capacity, uint32_t num_shards)
-      : store_(capacity, num_shards) {}
+  PsServer(uint64_t capacity, uint32_t num_shards,
+           RowDtype row_dtype = kRowF32, uint64_t capacity_bytes = 0)
+      : store_(capacity, num_shards, row_dtype, capacity_bytes) {}
 
   std::string dispatch(const std::string& method, const std::string& payload) {
     if (method == "configure") return do_configure(payload);
@@ -418,6 +424,11 @@ int main(int argc, char** argv) {
   int port = 0;
   uint64_t capacity = 1000000000ULL;
   uint32_t num_shards = 100;
+  // arena storage policy (PR 10): fp16/bf16 narrow the stored
+  // embedding slice, capacity_bytes arms byte-accounted eviction —
+  // the same record layout/semantics as the Python backends
+  RowDtype row_dtype = kRowF32;
+  uint64_t capacity_bytes = 0;
   int replica_index = 0;
   std::string coordinator;
   if (const char* env = std::getenv("REPLICA_INDEX"))
@@ -432,6 +443,8 @@ int main(int argc, char** argv) {
       {"num-shards", required_argument, nullptr, 's'},
       {"replica-index", required_argument, nullptr, 'r'},
       {"coordinator", required_argument, nullptr, 'o'},
+      {"row-dtype", required_argument, nullptr, 'd'},
+      {"capacity-bytes", required_argument, nullptr, 'b'},
       {nullptr, 0, nullptr, 0},
   };
   int opt;
@@ -454,6 +467,21 @@ int main(int argc, char** argv) {
         break;
       case 'o':
         coordinator = optarg;
+        break;
+      case 'd':
+        if (std::strcmp(optarg, "fp32") == 0) {
+          row_dtype = kRowF32;
+        } else if (std::strcmp(optarg, "fp16") == 0) {
+          row_dtype = kRowF16;
+        } else if (std::strcmp(optarg, "bf16") == 0) {
+          row_dtype = kRowBF16;
+        } else {
+          std::fprintf(stderr, "unknown --row-dtype %s\n", optarg);
+          return 2;
+        }
+        break;
+      case 'b':
+        capacity_bytes = std::strtoull(optarg, nullptr, 10);
         break;
       default:
         std::fprintf(stderr, "unknown option\n");
@@ -480,7 +508,7 @@ int main(int argc, char** argv) {
   std::fprintf(stderr, "persia-embedding-ps %d listening on %s\n",
                replica_index, my_addr.c_str());
 
-  PsServer server(capacity, num_shards);
+  PsServer server(capacity, num_shards, row_dtype, capacity_bytes);
   if (!coordinator.empty()) {
     try {
       register_with_coordinator(coordinator, my_addr, replica_index);

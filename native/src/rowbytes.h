@@ -1,0 +1,120 @@
+// Row-precision byte layout shared with persia_tpu/ps/optim.py
+// (RowPrecision) and persia_tpu/ps/arena.py: the embedding slice of a
+// stored row is narrowed to the store's row_dtype, the optimizer state
+// stays f32, and the LOGICAL record is `[emb bytes | state f32 bytes]`
+// with no padding (what PSD v2, the spill tier, and the eviction drain
+// serialize). The in-arena record pads the state offset to 4 bytes and
+// the stride to 8 so strided f32 views stay aligned in both backends.
+//
+// The narrow conversions are round-to-nearest-even, bit-compatible with
+// numpy's float32->float16 cast and ml_dtypes' float32->bfloat16 cast:
+// cross-backend parity compares STORED bytes, so one ulp of rounding
+// disagreement here would fail the fp16/bf16 parity suite.
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+
+namespace persia {
+
+enum RowDtype : int { kRowF32 = 0, kRowF16 = 1, kRowBF16 = 2 };
+
+inline uint32_t row_itemsize(RowDtype dt) { return dt == kRowF32 ? 4u : 2u; }
+
+inline uint16_t f32_to_f16(float f) {
+  uint32_t x;
+  std::memcpy(&x, &f, 4);
+  uint16_t sign = static_cast<uint16_t>((x >> 16) & 0x8000u);
+  uint32_t exp = (x >> 23) & 0xFFu;
+  uint32_t man = x & 0x7FFFFFu;
+  if (exp == 0xFFu)  // inf / nan (nan keeps a payload bit set)
+    return sign | 0x7C00u | (man ? (0x200u | (man >> 13)) : 0u);
+  int e = static_cast<int>(exp) - 127 + 15;
+  if (e >= 0x1F) return sign | 0x7C00u;  // overflow -> inf
+  if (e <= 0) {
+    if (e < -11) return sign;  // too small for the largest subnormal round
+    man |= 0x800000u;
+    uint32_t shift = static_cast<uint32_t>(14 - e);
+    uint32_t half_man = man >> shift;
+    uint32_t rem = man & ((1u << shift) - 1u);
+    uint32_t halfway = 1u << (shift - 1);
+    if (rem > halfway || (rem == halfway && (half_man & 1u))) ++half_man;
+    return sign | static_cast<uint16_t>(half_man);  // carry may hit exp=1: ok
+  }
+  uint16_t h = sign | static_cast<uint16_t>(e << 10) |
+               static_cast<uint16_t>(man >> 13);
+  uint32_t rem = man & 0x1FFFu;
+  if (rem > 0x1000u || (rem == 0x1000u && (h & 1u))) ++h;
+  return h;
+}
+
+inline float f16_to_f32(uint16_t h) {
+  uint32_t sign = static_cast<uint32_t>(h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1Fu;
+  uint32_t man = h & 0x3FFu;
+  uint32_t x;
+  if (exp == 0) {
+    if (man == 0) {
+      x = sign;
+    } else {  // subnormal: normalize
+      int e = -1;
+      do {
+        man <<= 1;
+        ++e;
+      } while (!(man & 0x400u));
+      x = sign | ((127 - 15 - e) << 23) | ((man & 0x3FFu) << 13);
+    }
+  } else if (exp == 0x1F) {
+    x = sign | 0x7F800000u | (man << 13);
+  } else {
+    x = sign | ((exp - 15 + 127) << 23) | (man << 13);
+  }
+  float f;
+  std::memcpy(&f, &x, 4);
+  return f;
+}
+
+inline uint16_t f32_to_bf16(float f) {
+  uint32_t x;
+  std::memcpy(&x, &f, 4);
+  if ((x & 0x7FFFFFFFu) > 0x7F800000u)  // nan: truncate, force quiet bit
+    return static_cast<uint16_t>((x >> 16) | 0x40u);
+  uint32_t lsb = (x >> 16) & 1u;
+  x += 0x7FFFu + lsb;  // round to nearest, ties to even
+  return static_cast<uint16_t>(x >> 16);
+}
+
+inline float bf16_to_f32(uint16_t h) {
+  uint32_t x = static_cast<uint32_t>(h) << 16;
+  float f;
+  std::memcpy(&f, &x, 4);
+  return f;
+}
+
+inline void narrow_row(RowDtype dt, const float* src, uint32_t n,
+                       uint8_t* dst) {
+  if (dt == kRowF32) {
+    std::memcpy(dst, src, 4ull * n);
+  } else if (dt == kRowF16) {
+    uint16_t* d = reinterpret_cast<uint16_t*>(dst);
+    for (uint32_t i = 0; i < n; ++i) d[i] = f32_to_f16(src[i]);
+  } else {
+    uint16_t* d = reinterpret_cast<uint16_t*>(dst);
+    for (uint32_t i = 0; i < n; ++i) d[i] = f32_to_bf16(src[i]);
+  }
+}
+
+inline void widen_row(RowDtype dt, const uint8_t* src, uint32_t n,
+                      float* dst) {
+  if (dt == kRowF32) {
+    std::memcpy(dst, src, 4ull * n);
+  } else if (dt == kRowF16) {
+    const uint16_t* s = reinterpret_cast<const uint16_t*>(src);
+    for (uint32_t i = 0; i < n; ++i) dst[i] = f16_to_f32(s[i]);
+  } else {
+    const uint16_t* s = reinterpret_cast<const uint16_t*>(src);
+    for (uint32_t i = 0; i < n; ++i) dst[i] = bf16_to_f32(s[i]);
+  }
+}
+
+}  // namespace persia

@@ -1,68 +1,190 @@
-// Sharded LRU embedding store — the C++ twin of persia_tpu/ps/store.py.
+// Sharded LRU embedding store over a slab row arena — the C++ twin of
+// persia_tpu/ps/arena.py (and of the legacy per-entry
+// persia_tpu/ps/store.py semantics).
 //
 // Architecture follows the reference's persia-embedding-holder:
 // num_internal_shards independently-locked shards
 // (persia-embedding-holder/src/lib.rs:28-101), each an LRU map
-// (eviction_map.rs) of sign -> [emb | optimizer state] float vectors
-// (emb_entry.rs). Lookup/update semantics match
+// (eviction_map.rs) of sign -> row. Lookup/update semantics match
 // embedding_parameter_service/mod.rs:162-262 and :359-427.
 //
-// Serialization: PSD1 layout, byte-identical with EmbeddingHolder.dump_bytes.
+// Row storage (the PR-10 arena): instead of one heap std::vector<float>
+// per entry, every shard owns a SlabPool — per (dim, state_space)
+// record class, fixed-stride rows carved out of 4096-row slabs with a
+// free list for reuse. A row is `[emb bytes (row_dtype) | pad to 4 |
+// f32 optimizer state | pad to 8]`; the LOGICAL record (what PSD v2,
+// the spill tier, and the eviction drain see) is the unpadded
+// `[emb | state]`, byte-identical with the Python backends'
+// RowPrecision layout. row_dtype fp16/bf16 narrows the embedding slice
+// with numpy-bit-compatible round-to-nearest-even (rowbytes.h); all
+// optimizer math runs on widened f32 rows, so update arithmetic is
+// fp32-exact and only the final narrow rounds.
+//
+// Eviction accounts rows AND (optionally) logical data bytes,
+// byte-compatible with store.py's EvictionMap: with capacity_bytes set,
+// an fp16 table genuinely admits ~2x the rows of an fp32 one.
+// Evicted rows can be RETAINED in a per-shard drain buffer
+// (set_retain_evicted) so the Python wrapper can demote them to the
+// shared SpillStore disk tier instead of letting them die — the spill
+// rung is implemented once, in Python, over the identical record bytes.
+//
+// Serialization: fp32 stores write PSD v1 bit-identically with every
+// pre-existing reader; half-precision stores write PSD v2 (per-record
+// dtype tag). Either version loads into any store (widen on read,
+// re-narrow per local policy), matching store.py's iter_psd_records.
 #pragma once
 
 #include <atomic>
 #include <cstdint>
 #include <cstdio>
-#include <list>
 #include <memory>
 #include <mutex>
+#include <string>
 #include <thread>
-#include <unordered_map>
 #include <vector>
 
 #include "hashrng.h"
 #include "optim.h"
+#include "rowbytes.h"
 
 namespace persia {
 
-struct Entry {
-  uint64_t sign;
-  uint32_t dim;
-  std::vector<float> vec;  // [emb | opt state]
+// ---------------------------------------------------------------------------
+// SlabPool: per-shard arena of fixed-stride rows, one class per
+// (dim, state_space). Slot ids are dense per class; freed slots are
+// reused LIFO before fresh slab rows are carved.
+// ---------------------------------------------------------------------------
+class SlabPool {
+ public:
+  static constexpr uint32_t kSlabRowsLog = 12;  // 4096 rows per slab
+  static constexpr uint32_t kSlabRows = 1u << kSlabRowsLog;
+
+  struct ClassInfo {
+    uint32_t dim;
+    uint32_t space;     // f32 optimizer-state slots
+    uint32_t emb_bytes; // dim * itemsize (logical)
+    uint32_t emb_pad;   // state offset within the record (4-aligned)
+    uint32_t stride;    // 8-aligned record size in the slab
+    uint64_t logical_bytes;  // emb_bytes + 4 * space
+  };
+
+  explicit SlabPool(RowDtype dtype) : dtype_(dtype) {}
+
+  RowDtype dtype() const { return dtype_; }
+
+  uint32_t class_of(uint32_t dim, uint32_t space) {
+    for (uint32_t c = 0; c < classes_.size(); ++c)
+      if (classes_[c].info.dim == dim && classes_[c].info.space == space)
+        return c;
+    Class cls;
+    uint32_t emb = dim * row_itemsize(dtype_);
+    cls.info.dim = dim;
+    cls.info.space = space;
+    cls.info.emb_bytes = emb;
+    cls.info.emb_pad = (emb + 3u) & ~3u;
+    cls.info.stride = (cls.info.emb_pad + 4u * space + 7u) & ~7u;
+    cls.info.logical_bytes = emb + 4ull * space;
+    classes_.push_back(std::move(cls));
+    return static_cast<uint32_t>(classes_.size() - 1);
+  }
+
+  const ClassInfo& info(uint32_t cls) const { return classes_[cls].info; }
+
+  uint32_t alloc(uint32_t cls) {
+    Class& c = classes_[cls];
+    if (!c.free_.empty()) {
+      uint32_t s = c.free_.back();
+      c.free_.pop_back();
+      return s;
+    }
+    uint32_t s = static_cast<uint32_t>(c.next_fresh++);
+    if ((s >> kSlabRowsLog) >= c.slabs.size())
+      c.slabs.emplace_back(new uint8_t[size_t(kSlabRows) * c.info.stride]);
+    return s;
+  }
+
+  void free_slot(uint32_t cls, uint32_t slot) {
+    classes_[cls].free_.push_back(slot);
+  }
+
+  uint8_t* ptr(uint32_t cls, uint32_t slot) {
+    Class& c = classes_[cls];
+    return c.slabs[slot >> kSlabRowsLog].get() +
+           size_t(slot & (kSlabRows - 1)) * c.info.stride;
+  }
+
+  const uint8_t* ptr(uint32_t cls, uint32_t slot) const {
+    const Class& c = classes_[cls];
+    return c.slabs[slot >> kSlabRowsLog].get() +
+           size_t(slot & (kSlabRows - 1)) * c.info.stride;
+  }
+
+  void clear() {
+    for (Class& c : classes_) {
+      c.slabs.clear();
+      c.free_.clear();
+      c.next_fresh = 0;
+    }
+  }
+
+  uint64_t slab_bytes() const {
+    uint64_t total = 0;
+    for (const Class& c : classes_)
+      total += uint64_t(c.slabs.size()) * kSlabRows * c.info.stride;
+    return total;
+  }
+
+  uint64_t free_slots() const {
+    uint64_t total = 0;
+    for (const Class& c : classes_) total += c.free_.size();
+    return total;
+  }
+
+ private:
+  struct Class {
+    ClassInfo info;
+    std::vector<std::unique_ptr<uint8_t[]>> slabs;
+    std::vector<uint32_t> free_;
+    uint64_t next_fresh = 0;
+  };
+  RowDtype dtype_;
+  std::vector<Class> classes_;
 };
 
 // LRU map: open-addressing flat hash table + array-backed doubly-linked
-// recency list (least-recent at head). The reference reached the same
-// conclusion (persia-embedding-holder's hashmap + ArrayLinkedList):
-// node-based std::list/unordered_map cost ~4 dependent cache misses per
-// lookup; a flat table + index links cost ~2.
+// recency list (least-recent at head), over arena row references
+// instead of owned vectors. The reference reached the same flat-table
+// conclusion (persia-embedding-holder's hashmap + ArrayLinkedList).
 //
-// POINTER STABILITY: Entry* returned by get()/get_refresh() is
-// invalidated by ANY subsequent insert() (the node arena may reallocate,
-// and eviction recycles node slots). Use the pointer immediately; never
-// hold it across an insert.
+// POINTER STABILITY: Node* returned by get()/get_refresh() is
+// invalidated by ANY subsequent insert() (the node arena may
+// reallocate, and eviction recycles node slots). Use it immediately.
 //
 // CAPACITY: node indices are uint32 with 0xFFFFFFFF reserved, so one
-// map holds at most ~4.29e9 entries; the Store clamps per-shard capacity
-// accordingly (raise num_internal_shards to go past ~4e9 per shard).
+// map holds at most ~4.29e9 entries; the Store clamps per-shard
+// capacity accordingly (raise num_internal_shards to go past that).
 class EvictionMap {
   static constexpr uint32_t kNil = 0xFFFFFFFFu;
 
+ public:
   struct Node {
     uint64_t sign;
     uint32_t prev;
     uint32_t next;
-    Entry entry;
+    uint32_t cls;   // SlabPool record class
+    uint32_t slot;  // row slot within the class
+    uint32_t dim;
   };
 
- public:
   explicit EvictionMap(uint64_t capacity) : capacity_(capacity) {
     rehash(1024);
   }
 
-  Entry* get(uint64_t sign) {
+  uint64_t capacity() const { return capacity_; }
+
+  Node* get(uint64_t sign) {
     uint32_t node = find(sign);
-    return node == kNil ? nullptr : &nodes_[node].entry;
+    return node == kNil ? nullptr : &nodes_[node];
   }
 
   // Pull the sign's probe-chain head into cache ahead of time: at
@@ -72,43 +194,59 @@ class EvictionMap {
     __builtin_prefetch(&table_[ideal(sign)]);
   }
 
-  Entry* get_refresh(uint64_t sign) {
+  Node* get_refresh(uint64_t sign) {
     uint32_t node = find(sign);
     if (node == kNil) return nullptr;
     detach(node);
     push_back(node);
-    return &nodes_[node].entry;
+    return &nodes_[node];
   }
 
-  // Returns true if an older entry was evicted.
-  bool insert(uint64_t sign, uint32_t dim, std::vector<float> vec) {
-    uint32_t node = find(sign);
-    if (node != kNil) {
-      nodes_[node].entry.dim = dim;
-      nodes_[node].entry.vec = std::move(vec);
-      detach(node);
-      push_back(node);
-      return false;
-    }
-    node = alloc_node();
+  // Insert a NEW sign (caller guarantees absence; an existing sign is
+  // updated in place through get()/get_refresh() + reassign()).
+  void insert(uint64_t sign, uint32_t cls, uint32_t slot, uint32_t dim) {
+    uint32_t node = alloc_node();
     Node& nd = nodes_[node];
     nd.sign = sign;
-    nd.entry.sign = sign;
-    nd.entry.dim = dim;
-    nd.entry.vec = std::move(vec);
+    nd.cls = cls;
+    nd.slot = slot;
+    nd.dim = dim;
     push_back(node);
     table_insert(sign, node);
     ++size_;
-    if (size_ > capacity_) {
-      uint32_t victim = head_;
-      table_erase(nodes_[victim].sign);
-      detach(victim);
-      nodes_[victim].entry.vec = std::vector<float>();
-      free_.push_back(victim);
-      --size_;
-      return true;
-    }
-    return false;
+  }
+
+  // Pop the least-recently-used entry; false when empty. The caller
+  // owns freeing the row slot (and draining/accounting it).
+  bool evict_head(uint64_t* sign, uint32_t* cls, uint32_t* slot,
+                  uint32_t* dim) {
+    if (head_ == kNil) return false;
+    uint32_t victim = head_;
+    Node& nd = nodes_[victim];
+    *sign = nd.sign;
+    *cls = nd.cls;
+    *slot = nd.slot;
+    *dim = nd.dim;
+    table_erase(nd.sign);
+    detach(victim);
+    free_.push_back(victim);
+    --size_;
+    return true;
+  }
+
+  // Remove one specific sign (dim-mismatch reinit path); false when
+  // absent. Caller frees the row slot.
+  bool erase(uint64_t sign, uint32_t* cls, uint32_t* slot) {
+    uint32_t node = find(sign);
+    if (node == kNil) return false;
+    Node& nd = nodes_[node];
+    *cls = nd.cls;
+    *slot = nd.slot;
+    table_erase(sign);
+    detach(node);
+    free_.push_back(node);
+    --size_;
+    return true;
   }
 
   void clear() {
@@ -123,8 +261,7 @@ class EvictionMap {
 
   template <typename F>
   void for_each_lru(F&& f) const {
-    for (uint32_t n = head_; n != kNil; n = nodes_[n].next)
-      f(nodes_[n].entry);
+    for (uint32_t n = head_; n != kNil; n = nodes_[n].next) f(nodes_[n]);
   }
 
  private:
@@ -226,9 +363,22 @@ class EvictionMap {
 };
 
 class Store {
+  // One shard: its LRU map, its row arena, its byte accounting, and
+  // its retained-eviction drain — all guarded by the shard's mutex.
+  struct Shard {
+    std::unique_ptr<EvictionMap> map;
+    std::unique_ptr<SlabPool> pool;
+    uint64_t resident_bytes = 0;  // logical data bytes (emb + state)
+    uint64_t emb_bytes = 0;       // embedding share of the above
+    // retained evictions, framed `sign u64 | dim u32 | nbytes u32 |
+    // logical row bytes` (the spill tier's _REC framing)
+    std::vector<uint8_t> drain;
+  };
+
  public:
-  Store(uint64_t capacity, uint32_t num_shards)
-      : num_shards_(num_shards == 0 ? 1 : num_shards) {
+  Store(uint64_t capacity, uint32_t num_shards, RowDtype dtype = kRowF32,
+        uint64_t capacity_bytes = 0)
+      : num_shards_(num_shards == 0 ? 1 : num_shards), dtype_(dtype) {
     uint64_t per_shard = capacity / num_shards_;
     if (per_shard == 0) per_shard = 1;
     // uint32 node indices (0xFFFFFFFF = nil sentinel) bound one map
@@ -239,11 +389,19 @@ class Store {
                    static_cast<unsigned long long>(per_shard));
       per_shard = 0xFFFFFFFEull;
     }
+    if (capacity_bytes) {
+      bytes_per_shard_ = capacity_bytes / num_shards_;
+      if (bytes_per_shard_ == 0) bytes_per_shard_ = 1;
+    }
     for (uint32_t i = 0; i < num_shards_; ++i) {
-      shards_.emplace_back(new EvictionMap(per_shard));
+      shards_.emplace_back(new Shard());
+      shards_[i]->map.reset(new EvictionMap(per_shard));
+      shards_[i]->pool.reset(new SlabPool(dtype_));
       locks_.emplace_back(new std::mutex());
     }
   }
+
+  RowDtype row_dtype() const { return dtype_; }
 
   void configure(int method, const InitParams& params, float admit_probability,
                  float weight_bound, bool enable_weight_bound) {
@@ -263,6 +421,11 @@ class Store {
   }
 
   bool has_optimizer() const { return optimizer_ != nullptr; }
+
+  // Retain evicted rows in per-shard drain buffers instead of dropping
+  // them (the Python wrapper demotes the drained records to the shared
+  // SpillStore disk tier).
+  void set_retain_evicted(bool on) { retain_evicted_ = on; }
 
   // Group request indices by internal shard so each shard's mutex is
   // taken ONCE per batch instead of once per sign (counting sort; the
@@ -318,38 +481,52 @@ class Store {
     std::vector<uint32_t> order, starts;
     group_by_shard(signs, n, &order, &starts);
     std::atomic<uint64_t> misses{0};
+    const uint32_t space = training ? optimizer_->require_space(dim) : 0;
     parallel_shards(starts, n, [&](uint32_t s) {
       uint64_t local_misses = 0;
       std::lock_guard<std::mutex> lk(*locks_[s]);
-      EvictionMap* shard = shards_[s].get();
+      Shard& sh = *shards_[s];
+      std::vector<float> init_vec(dim + space);
       constexpr uint32_t kAhead = 8;
       for (uint32_t k = starts[s]; k < starts[s + 1]; ++k) {
         if (k + kAhead < starts[s + 1])
-          shard->prefetch(signs[order[k + kAhead]]);
+          sh.map->prefetch(signs[order[k + kAhead]]);
         uint32_t i = order[k];
         uint64_t sign = signs[i];
         float* dst = out + static_cast<size_t>(i) * dim;
         if (training) {
-          Entry* e = shard->get_refresh(sign);
+          EvictionMap::Node* e = sh.map->get_refresh(sign);
+          if (e == nullptr && retain_evicted_ &&
+              drain_reinsert_locked(sh, sign, dim)) {
+            // evicted earlier in this very call (or since the last
+            // drain): fault the evicted value back in, like the
+            // Python holders' spill fault-in — a demotion must not
+            // reinitialize a row the same batch re-reads
+            e = sh.map->get_refresh(sign);
+          }
           if (e != nullptr && e->dim == dim) {
-            std::memcpy(dst, e->vec.data(), sizeof(float) * dim);
+            widen_row(dtype_, sh.pool->ptr(e->cls, e->slot), dim, dst);
           } else if (e == nullptr && !admit(sign, admit_probability_)) {
             std::memset(dst, 0, sizeof(float) * dim);
             ++local_misses;
           } else {
-            // miss (admitted) or dim mismatch: (re-)initialize
-            uint32_t space = optimizer_->require_space(dim);
-            std::vector<float> vec(dim + space);
-            init_entry(sign, dim, init_method_, init_params_, vec.data());
-            optimizer_->state_initialization(vec.data(), dim);
-            std::memcpy(dst, vec.data(), sizeof(float) * dim);
-            shard->insert(sign, dim, std::move(vec));
+            // miss (admitted) or dim mismatch: (re-)initialize. The
+            // caller reads the STORED value (narrow-then-widen), so a
+            // lookup right after the miss reads exactly what later
+            // lookups will.
+            init_entry(sign, dim, init_method_, init_params_,
+                       init_vec.data());
+            optimizer_->state_initialization(init_vec.data(), dim);
+            insert_locked(sh, sign, dim, init_vec.data(),
+                          static_cast<uint32_t>(init_vec.size()));
+            EvictionMap::Node* ne = sh.map->get(sign);
+            widen_row(dtype_, sh.pool->ptr(ne->cls, ne->slot), dim, dst);
             ++local_misses;
           }
         } else {
-          Entry* e = shard->get(sign);
+          EvictionMap::Node* e = sh.map->get(sign);
           if (e != nullptr && e->dim == dim) {
-            std::memcpy(dst, e->vec.data(), sizeof(float) * dim);
+            widen_row(dtype_, sh.pool->ptr(e->cls, e->slot), dim, dst);
           } else {
             std::memset(dst, 0, sizeof(float) * dim);
             ++local_misses;
@@ -371,30 +548,55 @@ class Store {
     std::vector<uint32_t> order, starts;
     group_by_shard(signs, n, &order, &starts);
     std::atomic<uint64_t> misses{0};
-    const uint32_t width = dim + optimizer_->require_space(dim);
+    const uint32_t space = optimizer_->require_space(dim);
+    const uint32_t width = dim + space;
     parallel_shards(starts, n, [&](uint32_t s) {
       uint64_t local_misses = 0;
       std::lock_guard<std::mutex> lk(*locks_[s]);
-      EvictionMap* shard = shards_[s].get();
+      Shard& sh = *shards_[s];
+      std::vector<float> row(width);
       constexpr uint32_t kAhead = 8;
       for (uint32_t k = starts[s]; k < starts[s + 1]; ++k) {
         if (k + kAhead < starts[s + 1])
-          shard->prefetch(signs[order[k + kAhead]]);
+          sh.map->prefetch(signs[order[k + kAhead]]);
         uint32_t i = order[k];
-        Entry* e = shard->get(signs[i]);
-        // width check also skips entries created under a different
-        // optimizer's state layout (would read past the vector otherwise)
-        if (e == nullptr || e->dim != dim || e->vec.size() != width) {
+        EvictionMap::Node* e = sh.map->get(signs[i]);
+        if (e == nullptr && retain_evicted_ &&
+            drain_reinsert_locked(sh, signs[i], dim)) {
+          e = sh.map->get(signs[i]);  // demoted row: fault in and apply
+        }
+        // class check also skips entries created under a different
+        // optimizer's state layout (would read past the record else)
+        if (e == nullptr || e->dim != dim ||
+            sh.pool->info(e->cls).space != space) {
           ++local_misses;
           continue;
         }
         float bp1 = b1p.empty() ? 0.0f : b1p[i];
         float bp2 = b2p.empty() ? 0.0f : b2p[i];
-        optimizer_->update(e->vec.data(),
-                           grads + static_cast<size_t>(i) * dim, dim, bp1,
-                           bp2);
-        if (enable_weight_bound_)
-          weight_bound_clamp(e->vec.data(), dim, weight_bound_);
+        uint8_t* p = sh.pool->ptr(e->cls, e->slot);
+        const SlabPool::ClassInfo& ci = sh.pool->info(e->cls);
+        if (dtype_ == kRowF32) {
+          // fp32: emb and state are contiguous f32 in the record, so
+          // the optimizer mutates the slab in place (bit-identical
+          // with the pre-arena per-entry path)
+          float* vec = reinterpret_cast<float*>(p);
+          optimizer_->update(vec, grads + static_cast<size_t>(i) * dim, dim,
+                             bp1, bp2);
+          if (enable_weight_bound_)
+            weight_bound_clamp(vec, dim, weight_bound_);
+        } else {
+          // widen-on-read, fp32-exact update, narrow-on-write
+          widen_row(dtype_, p, dim, row.data());
+          std::memcpy(row.data() + dim, p + ci.emb_pad, 4ull * space);
+          optimizer_->update(row.data(),
+                             grads + static_cast<size_t>(i) * dim, dim, bp1,
+                             bp2);
+          if (enable_weight_bound_)
+            weight_bound_clamp(row.data(), dim, weight_bound_);
+          narrow_row(dtype_, row.data(), dim, p);
+          std::memcpy(p + ci.emb_pad, row.data() + dim, 4ull * space);
+        }
       }
       misses += local_misses;
     });
@@ -408,33 +610,116 @@ class Store {
                     uint32_t* dim_out) {
     uint32_t s = internal_shard_of(sign, num_shards_);
     std::lock_guard<std::mutex> lk(*locks_[s]);
-    Entry* e = shards_[s]->get(sign);
+    Shard& sh = *shards_[s];
+    EvictionMap::Node* e = sh.map->get(sign);
     if (e == nullptr) return -1;
+    const SlabPool::ClassInfo& ci = sh.pool->info(e->cls);
     if (dim_out) *dim_out = e->dim;
-    uint32_t len = static_cast<uint32_t>(e->vec.size());
-    if (out != nullptr && maxlen >= len)
-      std::memcpy(out, e->vec.data(), sizeof(float) * len);
+    uint32_t len = ci.dim + ci.space;
+    if (out != nullptr && maxlen >= len) {
+      const uint8_t* p = sh.pool->ptr(e->cls, e->slot);
+      widen_row(dtype_, p, ci.dim, out);
+      std::memcpy(out + ci.dim, p + ci.emb_pad, 4ull * ci.space);
+    }
     return len;
   }
 
   int set_entry(uint64_t sign, uint32_t dim, const float* vec, uint32_t len) {
     uint32_t s = internal_shard_of(sign, num_shards_);
     std::lock_guard<std::mutex> lk(*locks_[s]);
-    shards_[s]->insert(sign, dim, std::vector<float>(vec, vec + len));
+    insert_locked(*shards_[s], sign, dim, vec, len);
     return 0;
+  }
+
+  int contains(uint64_t sign) {
+    uint32_t s = internal_shard_of(sign, num_shards_);
+    std::lock_guard<std::mutex> lk(*locks_[s]);
+    return shards_[s]->map->get(sign) != nullptr ? 1 : 0;
   }
 
   void clear() {
     for (uint32_t i = 0; i < num_shards_; ++i) {
       std::lock_guard<std::mutex> lk(*locks_[i]);
-      shards_[i]->clear();
+      Shard& sh = *shards_[i];
+      sh.map->clear();
+      sh.pool->clear();
+      sh.resident_bytes = 0;
+      sh.emb_bytes = 0;
     }
   }
 
   uint64_t size() const {
     uint64_t total = 0;
-    for (const auto& s : shards_) total += s->size();
+    for (const auto& s : shards_) total += s->map->size();
     return total;
+  }
+
+  uint64_t resident_bytes() const {
+    uint64_t total = 0;
+    for (uint32_t i = 0; i < num_shards_; ++i) {
+      std::lock_guard<std::mutex> lk(*locks_[i]);
+      total += shards_[i]->resident_bytes;
+    }
+    return total;
+  }
+
+  uint64_t resident_emb_bytes() const {
+    uint64_t total = 0;
+    for (uint32_t i = 0; i < num_shards_; ++i) {
+      std::lock_guard<std::mutex> lk(*locks_[i]);
+      total += shards_[i]->emb_bytes;
+    }
+    return total;
+  }
+
+  void shard_resident_bytes(uint64_t* out) const {
+    for (uint32_t i = 0; i < num_shards_; ++i) {
+      std::lock_guard<std::mutex> lk(*locks_[i]);
+      out[i] = shards_[i]->resident_bytes;
+    }
+  }
+
+  // out[4] = {slab_bytes, free_slots, live_rows, logical resident}
+  void arena_stats(uint64_t* out) const {
+    uint64_t slab = 0, free_slots = 0, live = 0, logical = 0;
+    for (uint32_t i = 0; i < num_shards_; ++i) {
+      std::lock_guard<std::mutex> lk(*locks_[i]);
+      slab += shards_[i]->pool->slab_bytes();
+      free_slots += shards_[i]->pool->free_slots();
+      live += shards_[i]->map->size();
+      logical += shards_[i]->resident_bytes;
+    }
+    out[0] = slab;
+    out[1] = free_slots;
+    out[2] = live;
+    out[3] = logical;
+  }
+
+  uint64_t evicted_bytes() const {
+    uint64_t total = 0;
+    for (uint32_t i = 0; i < num_shards_; ++i) {
+      std::lock_guard<std::mutex> lk(*locks_[i]);
+      total += shards_[i]->drain.size();
+    }
+    return total;
+  }
+
+  // Move retained-eviction records into buf (whole-shard granularity,
+  // records never split). Returns the bytes written; shards whose
+  // buffer no longer fits stay queued for the next call.
+  uint64_t drain_evicted(uint8_t* buf, uint64_t cap) {
+    uint64_t written = 0;
+    for (uint32_t i = 0; i < num_shards_; ++i) {
+      std::lock_guard<std::mutex> lk(*locks_[i]);
+      std::vector<uint8_t>& d = shards_[i]->drain;
+      if (d.empty()) continue;
+      if (written + d.size() > cap) continue;
+      std::memcpy(buf + written, d.data(), d.size());
+      written += d.size();
+      d.clear();
+      d.shrink_to_fit();
+    }
+    return written;
   }
 
   uint64_t index_miss_count() const { return index_miss_count_.load(); }
@@ -442,13 +727,17 @@ class Store {
     return gradient_id_miss_count_.load();
   }
 
-  // PSD1 serialization ---------------------------------------------------
+  // PSD serialization ----------------------------------------------------
+  // fp32 stores write v1 bit-identically with every pre-existing
+  // reader; half stores write v2 (dtype-tagged records). Either loads
+  // into any store (widen on read, re-narrow per local policy) —
+  // the same contract as store.py's iter_psd_records.
 
   bool dump_file(const char* path) {
     FILE* f = std::fopen(path, "wb");
     if (!f) return false;
     bool ok = std::fwrite("PSD1", 1, 4, f) == 4;
-    uint32_t version = 1;
+    uint32_t version = dtype_ == kRowF32 ? 1 : 2;
     // Placeholder count now, real count after the locked iteration: an
     // unlocked size() snapshot can disagree with the records actually
     // written when lookups/updates insert or evict mid-dump, making the
@@ -456,14 +745,27 @@ class Store {
     uint64_t count = 0;
     ok = ok && std::fwrite(&version, 4, 1, f) == 1;
     ok = ok && std::fwrite(&count, 8, 1, f) == 1;
+    uint8_t code = static_cast<uint8_t>(dtype_);
     for (uint32_t i = 0; ok && i < num_shards_; ++i) {
       std::lock_guard<std::mutex> lk(*locks_[i]);
-      shards_[i]->for_each_lru([&](const Entry& e) {
-        uint32_t len = static_cast<uint32_t>(e.vec.size());
+      Shard& sh = *shards_[i];
+      sh.map->for_each_lru([&](const EvictionMap::Node& e) {
+        const SlabPool::ClassInfo& ci = sh.pool->info(e.cls);
+        const uint8_t* p = sh.pool->ptr(e.cls, e.slot);
         ok = ok && std::fwrite(&e.sign, 8, 1, f) == 1;
-        ok = ok && std::fwrite(&e.dim, 4, 1, f) == 1;
-        ok = ok && std::fwrite(&len, 4, 1, f) == 1;
-        ok = ok && std::fwrite(e.vec.data(), sizeof(float), len, f) == len;
+        ok = ok && std::fwrite(&ci.dim, 4, 1, f) == 1;
+        if (version == 1) {
+          uint32_t len = ci.dim + ci.space;
+          ok = ok && std::fwrite(&len, 4, 1, f) == 1;
+          // fp32 records are contiguous f32 [emb | state] in the slab
+          ok = ok && std::fwrite(p, 4, len, f) == len;
+        } else {
+          ok = ok && std::fwrite(&code, 1, 1, f) == 1;
+          ok = ok && std::fwrite(&ci.space, 4, 1, f) == 1;
+          ok = ok && std::fwrite(p, 1, ci.emb_bytes, f) == ci.emb_bytes;
+          ok = ok &&
+               std::fwrite(p + ci.emb_pad, 4, ci.space, f) == ci.space;
+        }
         if (ok) ++count;
       });
     }
@@ -481,27 +783,154 @@ class Store {
     uint64_t count = 0;
     bool ok = std::fread(magic, 1, 4, f) == 4 &&
               std::memcmp(magic, "PSD1", 4) == 0 &&
-              std::fread(&version, 4, 1, f) == 1 && version == 1 &&
+              std::fread(&version, 4, 1, f) == 1 &&
+              (version == 1 || version == 2) &&
               std::fread(&count, 8, 1, f) == 1;
     if (ok && clear_first) clear();
+    std::vector<float> vec;
+    std::vector<uint8_t> raw;
     for (uint64_t i = 0; ok && i < count; ++i) {
       uint64_t sign;
-      uint32_t dim, len;
-      ok = std::fread(&sign, 8, 1, f) == 1 && std::fread(&dim, 4, 1, f) == 1 &&
-           std::fread(&len, 4, 1, f) == 1;
+      uint32_t dim;
+      ok = std::fread(&sign, 8, 1, f) == 1 && std::fread(&dim, 4, 1, f) == 1;
       if (!ok) break;
-      std::vector<float> vec(len);
-      ok = std::fread(vec.data(), sizeof(float), len, f) == len;
-      if (ok) set_entry(sign, dim, vec.data(), len);
+      if (version == 1) {
+        uint32_t len;
+        ok = std::fread(&len, 4, 1, f) == 1;
+        if (!ok) break;
+        vec.resize(len);
+        ok = std::fread(vec.data(), 4, len, f) == len;
+      } else {
+        uint8_t code;
+        uint32_t state_len;
+        ok = std::fread(&code, 1, 1, f) == 1 &&
+             std::fread(&state_len, 4, 1, f) == 1 && code <= kRowBF16;
+        if (!ok) break;
+        RowDtype rec_dt = static_cast<RowDtype>(code);
+        uint32_t emb_bytes = dim * row_itemsize(rec_dt);
+        raw.resize(emb_bytes + 4ull * state_len);
+        ok = std::fread(raw.data(), 1, raw.size(), f) == raw.size();
+        if (!ok) break;
+        vec.resize(dim + state_len);
+        widen_row(rec_dt, raw.data(), dim, vec.data());
+        std::memcpy(vec.data() + dim, raw.data() + emb_bytes,
+                    4ull * state_len);
+      }
+      if (ok)
+        set_entry(sign, dim, vec.data(), static_cast<uint32_t>(vec.size()));
     }
     std::fclose(f);
     return ok;
   }
 
  private:
+  // Re-admit the LATEST drained (evicted-but-undrained) copy of sign,
+  // widened through insert_locked; false when the drain has no copy of
+  // that sign at that dim. Caller holds the shard lock. Linear scan —
+  // the drain holds at most a few batches' evictions between the
+  // wrapper's drain calls.
+  bool drain_reinsert_locked(Shard& sh, uint64_t sign, uint32_t dim) {
+    size_t off = 0, found = SIZE_MAX;
+    uint32_t found_nbytes = 0;
+    while (off + 16 <= sh.drain.size()) {
+      uint64_t s;
+      uint32_t d, nb;
+      std::memcpy(&s, sh.drain.data() + off, 8);
+      std::memcpy(&d, sh.drain.data() + off + 8, 4);
+      std::memcpy(&nb, sh.drain.data() + off + 12, 4);
+      if (s == sign && d == dim) {
+        found = off + 16;
+        found_nbytes = nb;
+      }
+      off += 16 + nb;
+    }
+    if (found == SIZE_MAX) return false;
+    uint32_t emb_bytes = dim * row_itemsize(dtype_);
+    if (found_nbytes < emb_bytes) return false;
+    uint32_t state_len = (found_nbytes - emb_bytes) / 4;
+    std::vector<float> vec(dim + state_len);
+    widen_row(dtype_, sh.drain.data() + found, dim, vec.data());
+    std::memcpy(vec.data() + dim, sh.drain.data() + found + emb_bytes,
+                4ull * state_len);
+    insert_locked(sh, sign, dim, vec.data(),
+                  static_cast<uint32_t>(vec.size()));
+    return true;
+  }
+
+  // Narrow-store `vec` (f32 [emb | state], len = dim + space) into the
+  // shard, replacing any existing entry for sign, then restore the
+  // row/byte budget. Caller holds the shard lock.
+  void insert_locked(Shard& sh, uint64_t sign, uint32_t dim, const float* vec,
+                     uint32_t len) {
+    // a record shorter than its own dim (corrupt file / bad RPC
+    // payload) would make write_row read past the caller's buffer;
+    // refuse it instead of storing garbage
+    if (len < dim) return;
+    uint32_t space = len - dim;
+    uint32_t cls = sh.pool->class_of(dim, space);
+    EvictionMap::Node* e = sh.map->get_refresh(sign);
+    if (e != nullptr && e->cls == cls) {
+      write_row(sh, cls, e->slot, vec, dim, space);
+      e->dim = dim;
+      restore_budget_locked(sh);
+      return;
+    }
+    if (e != nullptr) {
+      uint32_t ocls = 0, oslot = 0;
+      sh.map->erase(sign, &ocls, &oslot);
+      account(sh, ocls, -1);
+      sh.pool->free_slot(ocls, oslot);
+    }
+    uint32_t slot = sh.pool->alloc(cls);
+    write_row(sh, cls, slot, vec, dim, space);
+    sh.map->insert(sign, cls, slot, dim);
+    account(sh, cls, +1);
+    restore_budget_locked(sh);
+  }
+
+  void write_row(Shard& sh, uint32_t cls, uint32_t slot, const float* vec,
+                 uint32_t dim, uint32_t space) {
+    uint8_t* p = sh.pool->ptr(cls, slot);
+    narrow_row(dtype_, vec, dim, p);
+    std::memcpy(p + sh.pool->info(cls).emb_pad, vec + dim, 4ull * space);
+  }
+
+  void account(Shard& sh, uint32_t cls, int mult) {
+    const SlabPool::ClassInfo& ci = sh.pool->info(cls);
+    sh.resident_bytes += mult * ci.logical_bytes;
+    sh.emb_bytes += mult * static_cast<int64_t>(ci.emb_bytes);
+  }
+
+  void restore_budget_locked(Shard& sh) {
+    while (sh.map->size() > sh.map->capacity() ||
+           (bytes_per_shard_ && sh.resident_bytes > bytes_per_shard_ &&
+            sh.map->size() > 1)) {
+      uint64_t vsign;
+      uint32_t vcls, vslot, vdim;
+      if (!sh.map->evict_head(&vsign, &vcls, &vslot, &vdim)) break;
+      if (retain_evicted_) {
+        const SlabPool::ClassInfo& ci = sh.pool->info(vcls);
+        const uint8_t* p = sh.pool->ptr(vcls, vslot);
+        uint32_t nbytes = static_cast<uint32_t>(ci.logical_bytes);
+        size_t at = sh.drain.size();
+        sh.drain.resize(at + 16 + nbytes);
+        std::memcpy(sh.drain.data() + at, &vsign, 8);
+        std::memcpy(sh.drain.data() + at + 8, &vdim, 4);
+        std::memcpy(sh.drain.data() + at + 12, &nbytes, 4);
+        std::memcpy(sh.drain.data() + at + 16, p, ci.emb_bytes);
+        std::memcpy(sh.drain.data() + at + 16 + ci.emb_bytes,
+                    p + ci.emb_pad, 4ull * ci.space);
+      }
+      account(sh, vcls, -1);
+      sh.pool->free_slot(vcls, vslot);
+    }
+  }
+
   uint32_t num_shards_;
-  std::vector<std::unique_ptr<EvictionMap>> shards_;
-  std::vector<std::unique_ptr<std::mutex>> locks_;
+  RowDtype dtype_;
+  uint64_t bytes_per_shard_ = 0;
+  std::vector<std::unique_ptr<Shard>> shards_;
+  mutable std::vector<std::unique_ptr<std::mutex>> locks_;
   std::unique_ptr<Optimizer> optimizer_;
   int init_method_ = kBoundedUniform;
   InitParams init_params_;
@@ -509,6 +938,7 @@ class Store {
   float weight_bound_ = 10.0f;
   bool enable_weight_bound_ = true;
   bool configured_ = false;
+  bool retain_evicted_ = false;
   std::atomic<uint64_t> index_miss_count_{0};
   std::atomic<uint64_t> gradient_id_miss_count_{0};
 };

@@ -248,17 +248,19 @@ class EmbeddingParameterServerConfig:
     capacity: int = 1_000_000_000
     num_hashmap_internal_shards: int = 100
     # storage precision of the embedding slice of every row ("fp32" |
-    # "fp16" | "bf16"); optimizer state always stays fp32. Non-fp32 is
-    # Python-holder-only — the native C++ store is parity-gated to fp32
-    # (ps.native.lint_row_dtype rejects the combination loudly).
+    # "fp16" | "bf16"); optimizer state always stays fp32. Served by
+    # every backend since the arena refactor (PR 10); an OLD pre-arena
+    # native .so negotiates down to the Python arena holder loudly
+    # (ps.native.make_holder capability probe).
     row_dtype: str = "fp32"
     # optional BYTE budget for eviction (0 = row-count capacity only):
     # with it, an fp16 table genuinely admits ~2x the rows of fp32
     capacity_bytes: int = 0
     # disk spill tier (the cold rung of the storage ladder): unset (the
     # default) keeps drop-on-evict; a directory arms spill-instead-of-
-    # drop with transparent fault-in (Python holder only, like
-    # row_dtype). spill_bytes 0 = unbounded disk budget.
+    # drop with transparent fault-in on any backend (the native store
+    # drains evictions to the shared Python SpillStore).
+    # spill_bytes 0 = unbounded disk budget.
     spill_dir: str = ""
     spill_bytes: int = 0
     # accepted for config-file compatibility with the reference; the

@@ -61,6 +61,16 @@ def _k(name, type_, default, doc, **kw) -> Knob:
 # One entry per knob, alphabetical. The doc string is what
 # docs/KNOBS.md renders, so write it for an operator, not for the code.
 REGISTRY: Dict[str, Knob] = {k.name: k for k in [
+    _k("PERSIA_ARENA_INDEX_SLOTS", "int", 1024,
+       "Initial open-addressing sign-index size per internal shard of "
+       "the arena holder (rounded up to a power of two; the index "
+       "grows itself past 3/4 fill). Pre-size it near 2x the expected "
+       "per-shard rows to skip rehash churn during the first fill."),
+    _k("PERSIA_ARENA_SLAB_ROWS", "int", 65536,
+       "Arena growth quantum: rows added per slab extension of a "
+       "(shard, record-class) arena in the Python holder (amortized-"
+       "doubling, so large stores reallocate O(log n) times). The "
+       "native store's slab size is fixed at 4096 rows/slab."),
     _k("PERSIA_COORDINATOR_ADDR", "str", "127.0.0.1:23333",
        "Address of the persia-coordinator control-plane service (the "
        "NATS analogue). Service binaries take it as their argparse "
@@ -93,9 +103,6 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
        "overrides JAX_PLATFORMS via sitecustomize)."),
     _k("PERSIA_FORCE_PYTHON_MW", "bool", False,
        "Skip the native middleware kernels and use the numpy twins."),
-    _k("PERSIA_FORCE_PYTHON_PS", "bool", False,
-       "Skip the native embedding store and use the Python holder "
-       "(required for fp16/bf16 row storage)."),
     _k("PERSIA_HOTNESS", "bool", False,
        "Workload telemetry: arm per-table hotness sketches "
        "(Space-Saving top-K + count-min + HLL, per internal shard) on "
@@ -144,6 +151,14 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
        "How many steps the profiler window captures."),
     _k("PERSIA_PROFILE_START_STEP", "int", 10,
        "First step of the profiler capture window."),
+    _k("PERSIA_PS_BACKEND", "str", "auto",
+       "Embedding-store backend: `auto` picks the native C++ arena "
+       "store when the built library supports the configured storage "
+       "policy (negotiating down to the Python arena holder LOUDLY "
+       "when an older .so lacks a capability), `native` requires it, "
+       "`arena` forces the Python arena holder, `python-legacy` forces "
+       "the per-entry OrderedDict holder (A/B lever for bench.py "
+       "--mode mem). Replaces the retired PERSIA_FORCE_PYTHON_PS."),
     _k("PERSIA_PS_CIRCUIT_BREAKER", "bool", True,
        "Per-replica circuit breaker on every PsClient RPC (fail fast "
        "while a background TCP probe watches the address). `0` "
@@ -160,8 +175,9 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
        "(pre-zero-copy A/B lever for the worker-cycle bench)."),
     _k("PERSIA_PS_ROW_DTYPE", "str", None,
        "Storage precision of the embedding slice of every PS row "
-       "(fp32|fp16|bf16; optimizer state stays fp32). Python holder "
-       "only."),
+       "(fp32|fp16|bf16; optimizer state stays fp32). Served by every "
+       "backend; an old pre-arena native .so negotiates down to the "
+       "Python arena holder loudly."),
     _k("PERSIA_PS_SHARD_PARALLEL", "bool", True,
        "PS shard-parallel dispatch (per-internal-shard buckets). `0` "
        "forces single-threaded dispatch regardless of core count."),
@@ -203,8 +219,8 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
        "cold rows to spill packets under this directory "
        "(storage.PersiaPath — local or hdfs://) instead of dropping "
        "them, and lookups fault spilled rows back in transparently. "
-       "Python holder only (loud config lint on the native store, like "
-       "row_dtype)."),
+       "Works on every backend (the native store drains evictions to "
+       "the shared Python SpillStore)."),
     _k("PERSIA_TIER_WINDOW_FRAC", "float", 0.125,
        "Fraction of the device-cache capacity reserved as the "
        "probationary admission window under PERSIA_TIER_ADMIT=hotness "

@@ -135,6 +135,38 @@ class SpillStore:
             if self._staged_bytes >= self.packet_bytes:
                 self._flush_locked()
 
+    def put_batch(self, signs: np.ndarray, dim: int, rows: np.ndarray):
+        """Stage a SLAB SLICE of evicted rows in one call: ``rows`` is a
+        ``(k, nbytes)`` uint8 matrix of stored (logical) records, one
+        per sign. Each staged entry keeps a VIEW into the matrix — no
+        per-row byte copies on the demotion path; serialization happens
+        once, at packet flush. One lock acquisition for the batch."""
+        if len(signs) == 0:
+            return
+        rows = np.ascontiguousarray(rows).view(np.uint8)
+        nbytes = int(rows.shape[1])
+        with self._lock:
+            for i, sign in enumerate(signs.tolist()):
+                sign = int(sign)
+                self._evict_index_locked(sign)
+                self._staged[sign] = (int(dim), rows[i])
+                self._staged_bytes += nbytes
+                self._index[sign] = (0, 0, nbytes, int(dim))
+            self.spilled_rows_total += len(signs)
+            if self._staged_bytes >= self.packet_bytes:
+                self._flush_locked()
+
+    def contains_batch(self, signs: np.ndarray) -> np.ndarray:
+        """Vectorized membership (one lock acquisition): bool mask of
+        which signs currently have a spilled copy — the native
+        wrapper's pre-lookup fault-in planner."""
+        with self._lock:
+            if not self._index:
+                return np.zeros(len(signs), dtype=bool)
+            idx = self._index
+            return np.fromiter((int(s) in idx for s in signs),
+                               dtype=bool, count=len(signs))
+
     def flush(self):
         """Write every staged row to a packet (tests/checkpoint sync
         points; the spill path flushes on its own cadence)."""

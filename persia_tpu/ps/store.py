@@ -28,8 +28,15 @@ TPU-first deviations:
 - Admission decisions are deterministic per sign (rng.py ADMIT_SALT) rather
   than drawn from a thread-local RNG.
 
-Mixed-precision rows (this backend only; the native C++ store is
-parity-gated to fp32 — see :func:`persia_tpu.ps.native.make_holder`):
+NOTE (PR 10): this per-entry holder is the LEGACY Python backend,
+kept as the semantic reference and the ``PERSIA_PS_BACKEND=
+python-legacy`` A/B lever; :class:`persia_tpu.ps.arena.
+ArenaEmbeddingHolder` (contiguous slab rows, vectorized batch paths,
+GC-invisible storage) is what ``make_holder`` returns for the Python
+backend, with identical semantics — the parity suites pin the two
+against each other.
+
+Mixed-precision rows:
 ``row_dtype`` ∈ {fp32, fp16, bf16} stores the embedding slice in half
 precision while keeping the appended optimizer state fp32; all update
 math runs through :class:`~persia_tpu.ps.optim.RowPrecision`'s

@@ -230,8 +230,8 @@ class PsService:
         # meta; the difference is the update's staleness in apply steps.
         self._ver_lock = threading.Lock()
         self._update_ver = 0
-        # per-internal-shard resident-bytes gauges (Python holder only;
-        # the native store has no byte accounting) — refreshed on every
+        # per-internal-shard resident-bytes gauges (every arena-era
+        # backend; a pre-arena .so reports none) — refreshed on every
         # health read and before each /metrics render
         from persia_tpu.metrics import default_registry
 
@@ -244,7 +244,37 @@ class PsService:
                           {"server": port_label, "shard": str(i)})
                 for i in range(holder.num_internal_shards)
             ]
-        # disk-tier gauges (spill-armed Python holder only)
+        # arena slab accounting (both arena backends expose it): the
+        # GC-pressure fix is only real if its failure mode — slab space
+        # held by eviction-churned free slots — is observable, so the
+        # fragmentation ratio rides the same refresh hook and a default
+        # SLO rule (slos.arena_fragmentation_runaway) watches it
+        self._arena_gauges = None
+        if getattr(holder, "arena_stats", None) is not None:
+            self._arena_gauges = {
+                "slab_bytes": reg.gauge(
+                    "ps_arena_slab_bytes", {"server": port_label},
+                    help_text="bytes of allocated arena slabs (resident "
+                              "rows + free slots + padding)"),
+                "free_slots": reg.gauge(
+                    "ps_arena_free_slots", {"server": port_label},
+                    help_text="evicted row slots awaiting reuse in the "
+                              "arena free lists"),
+                "live_rows": reg.gauge(
+                    "ps_arena_live_rows", {"server": port_label},
+                    help_text="rows resident in the arena (excludes "
+                              "the disk spill tier)"),
+                "fragmentation_ratio": reg.gauge(
+                    "ps_arena_fragmentation_ratio",
+                    {"server": port_label},
+                    help_text="free slots / allocated slots — slab "
+                              "space held by eviction churn instead of "
+                              "live rows (the arena never returns "
+                              "slabs; a runaway ratio means capacity "
+                              "planning should shrink the table or "
+                              "restart the replica)"),
+            }
+        # disk-tier gauges (spill-armed holders only)
         self._spill_gauges = None
         if getattr(holder, "spill", None) is not None:
             self._spill_gauges = {
@@ -289,6 +319,10 @@ class PsService:
             for g, b in zip(self._mem_gauges,
                             self.holder.resident_bytes_per_shard()):
                 g.set(b)
+        if self._arena_gauges is not None:
+            stats = self.holder.arena_stats()
+            for key, g in self._arena_gauges.items():
+                g.set(stats.get(key, 0))
         if self._spill_gauges is not None:
             stats = self.holder.spill_stats()
             for key, g in self._spill_gauges.items():
@@ -330,6 +364,14 @@ class PsService:
         doc["resident_bytes"] = getattr(self.holder, "resident_bytes", -1)
         doc["resident_emb_bytes"] = getattr(
             self.holder, "resident_emb_bytes", -1)
+        doc["backend"] = type(self.holder).__name__
+        # arena slab accounting (slab bytes, free slots, fragmentation)
+        # for capacity tooling that reads health instead of /metrics
+        arena_stats = getattr(self.holder, "arena_stats", None)
+        if arena_stats is not None:
+            stats = arena_stats()
+            if stats:
+                doc["arena"] = stats
         # workload telemetry: armed or not (the /hotness endpoint and
         # the hotness RPC carry the data itself), and the staleness
         # version counter for operators correlating update progress
@@ -982,18 +1024,20 @@ def main():
                    help="storage precision of the embedding slice of "
                         "every row (optimizer state stays fp32); "
                         "overrides the global config's "
-                        "parameter_server.row_dtype. Python holder only "
-                        "— rejected loudly when the native backend is "
-                        "active (set PERSIA_FORCE_PYTHON_PS=1)")
+                        "parameter_server.row_dtype. Served by the "
+                        "native arena store when built (an old pre-"
+                        "arena .so negotiates down to the Python arena "
+                        "holder loudly; PERSIA_PS_BACKEND pins one)")
     p.add_argument("--spill-dir",
                    default=knobs.get("PERSIA_TIER_SPILL_DIR"),
                    help="arm the disk spill tier: budget evictions "
                         "demote rows to spill packets under "
                         "<dir>/r<replica-index> (PersiaPath — local or "
                         "hdfs://) instead of dropping them; lookups "
-                        "fault them back transparently. Python holder "
-                        "only (loud lint on the native store). "
-                        "Overrides parameter_server.spill_dir")
+                        "fault them back transparently. Works on every "
+                        "backend (the native store drains evictions to "
+                        "the shared Python SpillStore). Overrides "
+                        "parameter_server.spill_dir")
     p.add_argument("--spill-bytes", type=int,
                    default=knobs.get("PERSIA_TIER_SPILL_BYTES"),
                    help="disk budget for the spill tier (0 = "
@@ -1014,13 +1058,15 @@ def main():
     start_deadlock_detection()
     set_service_name(f"ps{args.replica_index}")
     if knobs.get("PERSIA_PS_GC_TUNE"):
-        # A PS replica's store holds millions of gc-tracked objects
-        # (per-entry tuples, dict nodes); CPython's default gen2 cadence
-        # (every ~7k net allocations x 10 x 10) then walks the ENTIRE
-        # store every few seconds of traffic — multi-hundred-ms request
-        # stalls that scale with resident rows. Entries are acyclic
-        # (tuple -> ndarray), so they never need cyclic collection:
-        # freeze the boot state and make full collections ~100x rarer.
+        # The LEGACY per-entry holder keeps millions of gc-tracked
+        # objects (per-entry tuples, dict nodes); CPython's default gen2
+        # cadence then walks the ENTIRE store every few seconds of
+        # traffic — multi-hundred-ms request stalls that scale with
+        # resident rows. The arena backends store rows in a handful of
+        # GC-invisible slab buffers, so since PR 10 this tune is no
+        # longer load-bearing for the default backends (bench --mode mem
+        # pins the full-GC pause without it); it stays harmless-on for
+        # the python-legacy A/B lever and frozen boot state.
         # PERSIA_PS_GC_TUNE=0 restores the interpreter defaults.
         # (aliased import: `gc` is this function's GlobalConfig below)
         import gc as _gcmod

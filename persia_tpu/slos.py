@@ -180,6 +180,22 @@ def default_rules() -> List[SloRule]:
         # step silently degrades to flat-PS speed. ratio() is 0 while
         # the probes counter does not move, so uncached trainers never
         # page on this.
+        # arena health: the slab arena never returns memory — evicted
+        # slots are reused, not freed — so slab bytes parked in free
+        # lists instead of live rows are invisible resident waste. A
+        # sustained majority-free arena means the workload shrank far
+        # below the allocated high-water mark (shrink the table, or
+        # restart the replica to compact). No-data until a ps_arena_*
+        # gauge exists, so legacy-holder fleets never page on this.
+        SloRule("arena_fragmentation_runaway",
+                "ps_arena_fragmentation_ratio",
+                ">", 0.5, window_sec=120.0, for_sec=60.0,
+                severity="ticket",
+                description="over half the embedding arena's allocated "
+                            "row slots are eviction-churned free space "
+                            "for 2+ minutes — slab memory is parked "
+                            "idle; shrink capacity or restart to "
+                            "compact"),
         SloRule("device_cache_hit_collapse",
                 "ratio(device_cache_misses_total,"
                 " device_cache_probes_total)",

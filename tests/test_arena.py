@@ -1,0 +1,322 @@
+"""Arena-backed embedding store (persia_tpu/ps/arena.py): property
+tests for the slab/free-list mechanics, differential parity against the
+per-entry reference holder, slab-slice spill demotion, dump-window
+capture, and the observability surface (ps_arena_* gauges + the
+fragmentation SLO rule).
+
+Cross-BACKEND (Python vs C++) parity lives in test_native_parity.py;
+this module pins the Python arena holder against the per-entry
+EmbeddingHolder, whose semantics are the reference."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from persia_tpu.ps.arena import ArenaEmbeddingHolder
+from persia_tpu.ps.store import EmbeddingHolder
+
+
+def _mk(cls, row_dtype="fp32", capacity=10_000, shards=4, optimizer=None,
+        admit=1.0, **kw):
+    h = cls(capacity=capacity, num_internal_shards=shards,
+            row_dtype=row_dtype, **kw)
+    h.configure("bounded_uniform", {"lower": -0.1, "upper": 0.1},
+                admit_probability=admit, weight_bound=10.0)
+    h.register_optimizer(optimizer or {"type": "adagrad", "lr": 0.01})
+    return h
+
+
+def _pair(**kw):
+    return _mk(EmbeddingHolder, **kw), _mk(ArenaEmbeddingHolder, **kw)
+
+
+# --- slab / free-list mechanics -------------------------------------------
+
+
+def test_fill_evict_refill_reuses_slots():
+    """Eviction frees slots to the free list; the refill reuses them
+    instead of growing new slabs — the arena's footprint is bounded by
+    the high-water mark, and fragmentation returns to ~0."""
+    h = _mk(ArenaEmbeddingHolder, capacity=1024, shards=1)
+    h.lookup(np.arange(1, 1025, dtype=np.uint64), 8, True)
+    full = h.arena_stats()
+    assert full["live_rows"] == 1024 and full["free_slots"] == 0
+    # overflow by another full capacity: every insert evicts
+    h.lookup(np.arange(2001, 3025, dtype=np.uint64), 8, True)
+    after = h.arena_stats()
+    assert len(h) == 1024
+    assert after["live_rows"] == 1024
+    # refills reused the evicted slots: no new slab allocation (the
+    # insert-then-evict sequence leaves at most ONE transiently free
+    # slot — the final eviction's)
+    assert after["slab_bytes"] == full["slab_bytes"]
+    assert after["free_slots"] <= 1
+    assert after["fragmentation_ratio"] < 0.01
+
+
+def test_fragmentation_ratio_reflects_churned_free_slots():
+    h = _mk(ArenaEmbeddingHolder, capacity=100_000, shards=1,
+            capacity_bytes=256 * (8 * 4 + 8 * 4))
+    h.lookup(np.arange(1, 257, dtype=np.uint64), 8, True)
+    assert h.arena_stats()["fragmentation_ratio"] == 0.0
+    # shrink the logical table via dim-mismatch churn: reinit at a
+    # wider dim moves rows to a new class, stranding old-class slots
+    h.lookup(np.arange(1, 129, dtype=np.uint64), 16, True)
+    stats = h.arena_stats()
+    assert stats["free_slots"] > 0
+    assert 0.0 < stats["fragmentation_ratio"] < 1.0
+
+
+def test_arena_grows_in_slab_quanta(monkeypatch):
+    monkeypatch.setenv("PERSIA_ARENA_SLAB_ROWS", "2048")
+    h = _mk(ArenaEmbeddingHolder, capacity=1 << 20, shards=1)
+    h.lookup(np.arange(1, 101, dtype=np.uint64), 8, True)
+    stats = h.arena_stats()
+    cls = h._shards[0].classes[0]
+    assert cls.cap == 2048  # one slab quantum, not 100 rows
+    assert stats["slab_bytes"] == 2048 * cls.stride
+
+
+def test_index_rebuild_mid_batch_keeps_unstamped_rows(monkeypatch):
+    """Regression: one big training batch whose index inserts cross the
+    3/4-fill rebuild threshold BEFORE the batch's stamps are applied.
+    The rebuild must reconstruct from the live index (not from stamps),
+    or every earlier-inserted row of the batch silently vanishes from
+    the index — ghost rows that re-initialize on the next lookup."""
+    monkeypatch.setenv("PERSIA_ARENA_INDEX_SLOTS", "1024")
+    h = _mk(ArenaEmbeddingHolder, capacity=100_000, shards=1)
+    signs = np.arange(1, 1001, dtype=np.uint64)  # crosses fill 768
+    first = h.lookup(signs, 8, True)
+    assert len(h) == 1000
+    again = h.lookup(signs, 8, True)
+    np.testing.assert_array_equal(first, again)
+    assert len(h) == 1000  # no ghosts
+    assert h.index_miss_count == 1000  # only the initial misses
+    # the sequential path survives a mid-insert rebuild too
+    h2 = _mk(ArenaEmbeddingHolder, capacity=100_000, shards=1)
+    dup = np.concatenate([signs, signs[:1]])  # dups force the seq path
+    h2.lookup(dup, 8, True)
+    assert len(h2) == 1000
+
+
+# --- differential parity vs the per-entry reference holder ----------------
+
+
+@pytest.mark.parametrize("row_dtype", ["fp32", "fp16", "bf16"])
+def test_random_traffic_parity(row_dtype):
+    """Random batches (duplicates, eval interleaved, byte-budget
+    eviction pressure): bit-identical values, miss counters, byte
+    accounting, survivor sets, and PSD dumps."""
+    rng = np.random.default_rng(7)
+    row_bytes = 8 * (2 if row_dtype != "fp32" else 4) + 8 * 4
+    py, ar = _pair(row_dtype=row_dtype, capacity=100_000, shards=2,
+                   capacity_bytes=96 * row_bytes)
+    for step in range(120):
+        n = int(rng.integers(1, 40))
+        signs = rng.integers(0, 300, n, dtype=np.uint64)
+        np.testing.assert_array_equal(
+            py.lookup(signs, 8, True), ar.lookup(signs, 8, True),
+            err_msg=f"train lookup step {step}")
+        g = rng.normal(size=(n, 8)).astype(np.float32)
+        py.update_gradients(signs, g, 8)
+        ar.update_gradients(signs, g.copy(), 8)
+        probe = rng.integers(0, 400, 32, dtype=np.uint64)
+        np.testing.assert_array_equal(
+            py.lookup(probe, 8, False), ar.lookup(probe, 8, False),
+            err_msg=f"eval lookup step {step}")
+        assert len(py) == len(ar)
+        assert py.resident_bytes == ar.resident_bytes
+    assert py.index_miss_count == ar.index_miss_count
+    assert py.gradient_id_miss_count == ar.gradient_id_miss_count
+    for s in range(300):
+        pe, ae = py.get_entry(s), ar.get_entry(s)
+        assert (pe is None) == (ae is None), s
+        if pe is not None:
+            assert pe[0] == ae[0]
+            np.testing.assert_array_equal(pe[1], ae[1])
+    assert py.dump_bytes() == ar.dump_bytes()
+
+
+def test_admission_and_dim_mismatch_parity():
+    py, ar = _pair(capacity=5000, shards=2, admit=0.3)
+    signs = np.arange(1, 3001, dtype=np.uint64)
+    np.testing.assert_array_equal(py.lookup(signs, 4, True),
+                                  ar.lookup(signs, 4, True))
+    assert len(py) == len(ar)
+    # dim-mismatch reinit (unconditional, regardless of admission)
+    np.testing.assert_array_equal(py.lookup(signs[:200], 6, True),
+                                  ar.lookup(signs[:200], 6, True))
+    assert len(py) == len(ar)
+    assert py.resident_bytes == ar.resident_bytes
+    assert py.index_miss_count == ar.index_miss_count
+
+
+def test_get_set_entries_parity():
+    py, ar = _pair(row_dtype="fp16")
+    signs = np.arange(1, 200, dtype=np.uint64)
+    py.lookup(signs, 8, True)
+    ar.lookup(signs, 8, True)
+    width = 8 + 8  # adagrad: state space == dim
+    fp, vp = py.get_entries(signs, width)
+    fa, va = ar.get_entries(signs, width)
+    np.testing.assert_array_equal(fp, fa)
+    np.testing.assert_array_equal(vp, va)
+    # absent + wrong-width probes read as not-found on both
+    fp, _ = py.get_entries(np.array([9999], np.uint64), width)
+    fa, _ = ar.get_entries(np.array([9999], np.uint64), width)
+    assert not fp[0] and not fa[0]
+    fp, _ = py.get_entries(signs[:4], width + 1)
+    fa, _ = ar.get_entries(signs[:4], width + 1)
+    assert not fp.any() and not fa.any()
+    vecs = np.random.default_rng(0).normal(
+        size=(50, width)).astype(np.float32)
+    py.set_entries(signs[:50], 8, vecs)
+    ar.set_entries(signs[:50], 8, vecs)
+    assert py.dump_bytes() == ar.dump_bytes()
+
+
+def test_fp32_dump_is_v1_bit_identical_with_reference():
+    py, ar = _pair()
+    signs = np.random.default_rng(2).integers(0, 2**63, 500,
+                                              dtype=np.uint64)
+    py.lookup(signs, 12, True)
+    ar.lookup(signs, 12, True)
+    blob = ar.dump_bytes()
+    assert blob[:8] == b"PSD1" + (1).to_bytes(4, "little")
+    assert blob == py.dump_bytes()
+    # v1 loads back into an arena holder identically
+    ar2 = _mk(ArenaEmbeddingHolder)
+    ar2.load_bytes(blob)
+    assert ar2.dump_bytes() == blob
+
+
+# --- spill tier -----------------------------------------------------------
+
+
+def test_spill_demotes_slab_slices_and_faults_back():
+    """Byte-budget evictions demote through SpillStore.put_batch (one
+    matrix per class, no per-row staging copies); later training
+    lookups fault rows back in bit-identically."""
+    rng = np.random.default_rng(3)
+    row = 8 * 2 + 8 * 4
+    with tempfile.TemporaryDirectory() as td:
+        h = _mk(ArenaEmbeddingHolder, row_dtype="fp16", capacity=100_000,
+                shards=2, capacity_bytes=64 * row, spill_dir=td)
+        first = h.lookup(np.arange(1, 129, dtype=np.uint64), 8, True)
+        # updates give rows distinguishable state
+        g = rng.normal(size=(128, 8)).astype(np.float32)
+        h.update_gradients(np.arange(1, 129, dtype=np.uint64), g, 8)
+        trained = h.lookup(np.arange(1, 129, dtype=np.uint64), 8, True)
+        assert not np.array_equal(first, trained)
+        # push the originals out: they demote to spill, not death
+        h.lookup(np.arange(1001, 1129, dtype=np.uint64), 8, True)
+        stats = h.spill_stats()
+        assert stats["spilled_rows"] > 0
+        assert len(h) == 128 + 128  # logical table spans both rungs
+        # fault-in returns the trained values bit-identically
+        back = h.lookup(np.arange(1, 129, dtype=np.uint64), 8, True)
+        np.testing.assert_array_equal(back, trained)
+        assert h.spill_stats()["spill_fault_ins_total"] > 0
+
+
+def test_spill_dump_window_capture_keeps_one_logical_table():
+    """A spilled row faulted in AFTER its destination shard was already
+    serialized must still appear in the checkpoint (the dump-window
+    capture net), with its pre-fault value."""
+    row = 8 * 2 + 8 * 4
+    with tempfile.TemporaryDirectory() as td:
+        h = _mk(ArenaEmbeddingHolder, row_dtype="fp16", capacity=100_000,
+                shards=2, capacity_bytes=32 * row, spill_dir=td)
+        h.lookup(np.arange(1, 65, dtype=np.uint64), 8, True)
+        h.lookup(np.arange(1001, 1065, dtype=np.uint64), 8, True)
+        spilled = [s for s in range(1, 65) if h.get_entry(s) is not None
+                   and s in h.spill]
+        assert spilled, "traffic did not spill any probe row"
+        victim = spilled[0]
+        before = h.get_entry(victim)
+        # deterministic race repro: the dump serializes every shard,
+        # then reads the spill; fault the victim in BETWEEN — its
+        # destination shard is already serialized, so only the capture
+        # can save it
+        orig_items = h.spill.items
+
+        def hooked_items():
+            h.lookup(np.array([victim], np.uint64), 8, True)  # fault in
+            yield from orig_items()
+
+        h.spill.items = hooked_items
+        try:
+            blob = h.dump_bytes()
+        finally:
+            h.spill.items = orig_items
+        h2 = _mk(ArenaEmbeddingHolder, row_dtype="fp16")
+        h2.load_bytes(blob)
+        got = h2.get_entry(victim)
+        assert got is not None, "faulted-in row fell out of the dump"
+        np.testing.assert_array_equal(got[1], before[1])
+
+
+# --- observability surface ------------------------------------------------
+
+
+def test_ps_service_exports_arena_gauges():
+    from persia_tpu.metrics import default_registry
+    from persia_tpu.service.ps_service import PsService
+
+    h = _mk(ArenaEmbeddingHolder, capacity=1000, shards=2)
+    svc = PsService(h, port=0)
+    try:
+        h.lookup(np.arange(1, 101, dtype=np.uint64), 8, True)
+        doc = svc._health()
+        assert doc["backend"] == "ArenaEmbeddingHolder"
+        assert doc["arena"]["live_rows"] == 100
+        assert doc["arena"]["slab_bytes"] > 0
+        rendered = default_registry().render()
+        for name in ("ps_arena_slab_bytes", "ps_arena_free_slots",
+                     "ps_arena_live_rows",
+                     "ps_arena_fragmentation_ratio"):
+            assert name in rendered, name
+    finally:
+        svc.stop()
+
+
+def test_arena_fragmentation_slo_rule_registered():
+    from persia_tpu.slos import SloEngine, default_rules
+
+    names = {r.name for r in default_rules()}
+    assert "arena_fragmentation_runaway" in names
+    eng = SloEngine(default_rules())
+    # no arena series -> silent (legacy-holder fleets never page)
+    eng.ingest("ps0", [("some_other_metric", {}, 1.0)])
+    alerts = {a["rule"]: a for a in eng.evaluate()}
+    assert not alerts["arena_fragmentation_runaway"]["firing"]
+    # a majority-free arena fires once the for_sec hold elapses
+    eng2 = SloEngine([r for r in default_rules()
+                      if r.name == "arena_fragmentation_runaway"])
+    for i in range(4):
+        eng2.ingest("ps0", [("ps_arena_fragmentation_ratio", {}, 0.8)],
+                    t=float(i * 30))
+        alerts = {a["rule"]: a
+                  for a in eng2.evaluate(now=float(i * 30))}
+    assert alerts["arena_fragmentation_runaway"]["firing"]
+
+
+def test_make_holder_backend_selection():
+    from persia_tpu.ps import native
+
+    h = native.make_holder(1000, 2, backend="arena")
+    assert isinstance(h, ArenaEmbeddingHolder)
+    h = native.make_holder(1000, 2, backend="python-legacy",
+                           row_dtype="fp16")
+    assert isinstance(h, EmbeddingHolder) and h.row_dtype == "fp16"
+    with pytest.raises(ValueError, match="unknown PS backend"):
+        native.make_holder(1000, 2, backend="bogus")
+    if native.load_native_lib(build_if_missing=False) is not None:
+        from persia_tpu.ps.native import NativeEmbeddingHolder
+
+        h = native.make_holder(1000, 2, backend="auto", row_dtype="fp16",
+                               capacity_bytes=1 << 20)
+        assert isinstance(h, NativeEmbeddingHolder)
+        assert h.row_dtype == "fp16"

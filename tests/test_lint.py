@@ -134,6 +134,46 @@ def test_lock_pass_honors_locked_suffix_and_shard_locks(tmp_path):
     assert "lock-discipline" not in _passes(r)
 
 
+def test_lock_pass_honors_arena_shard_lock_convention(tmp_path):
+    """The arena holder's per-shard discipline: shard payload objects
+    expose their mutex as ``.lock`` and the OWNER acquires it (`with
+    shard.lock:` / `with self._shards[i].lock:`). Mutations of the
+    owner's own guarded attributes under a shard lock must not be
+    flagged as unlocked."""
+    r = _lint_snippet(tmp_path, """
+        import threading
+
+        class Shard:
+            def __init__(self):
+                self.lock = threading.Lock()
+                self.rows = 0
+
+            def insert_locked(self):
+                self.rows += 1
+
+        class Holder:
+            def __init__(self):
+                self._stats_lock = threading.Lock()
+                self._shards = [Shard() for _ in range(4)]
+                self.misses = 0
+
+            def report(self):
+                with self._stats_lock:
+                    self.misses += 1
+
+            def access(self, i):
+                shard = self._shards[i]
+                with shard.lock:
+                    shard.insert_locked()
+                    self.misses += 1
+
+            def access_direct(self, i):
+                with self._shards[i].lock:
+                    self.misses += 1
+    """)
+    assert "lock-discipline" not in _passes(r)
+
+
 # --- pass 2: thread-lifecycle --------------------------------------------
 
 def test_thread_pass_flags_undaemonized_unjoined(tmp_path):

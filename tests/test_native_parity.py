@@ -238,6 +238,210 @@ def test_flat_table_rehash_growth_and_eviction():
         assert pe == ce
 
 
+# --- arena-era parity: fp16/bf16 rows, byte budgets, PSD v2 ---------------
+# The native store shares the arena record layout ([emb bytes | f32
+# state], numpy-bit-compatible round-to-nearest-even narrowing) with
+# the Python backends, so STORED bytes — not just values — must agree.
+
+
+def _mk(cls, row_dtype, capacity=10_000, shards=4, optimizer=None, **kw):
+    h = cls(capacity=capacity, num_internal_shards=shards,
+            row_dtype=row_dtype, **kw)
+    h.configure("bounded_uniform", {"lower": -0.1, "upper": 0.1},
+                admit_probability=1.0, weight_bound=10.0)
+    h.register_optimizer(optimizer or {"type": "adagrad", "lr": 0.01})
+    return h
+
+
+def test_native_capabilities_are_arena_era():
+    from persia_tpu.ps.native import native_capabilities
+
+    caps = native_capabilities()
+    assert {"row_dtype", "capacity_bytes", "psd_v2", "spill"} <= caps
+
+
+@pytest.mark.parametrize("row_dtype", ["fp16", "bf16"])
+def test_half_row_init_lookup_bit_parity(row_dtype):
+    """Fresh-init lookups return narrow-then-widened STORED values;
+    with the deterministic init RNG and bit-compatible narrowing they
+    must be bit-identical across all three backends."""
+    from persia_tpu.ps.arena import ArenaEmbeddingHolder
+
+    py = _mk(EmbeddingHolder, row_dtype)
+    ar = _mk(ArenaEmbeddingHolder, row_dtype)
+    cc = _mk(NativeEmbeddingHolder, row_dtype)
+    signs = np.random.default_rng(11).integers(0, 2**63, 128,
+                                               dtype=np.uint64)
+    a = py.lookup(signs, 9, True)
+    b = ar.lookup(signs, 9, True)
+    c = cc.lookup(signs, 9, True)
+    np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(a, c)
+    # immediate re-read returns the same stored bytes
+    np.testing.assert_array_equal(c, cc.lookup(signs, 9, True))
+    assert py.resident_bytes == ar.resident_bytes == cc.resident_bytes
+    assert (py.resident_emb_bytes == ar.resident_emb_bytes
+            == cc.resident_emb_bytes == 128 * 9 * 2)
+
+
+@pytest.mark.parametrize("row_dtype", ["fp16", "bf16"])
+@pytest.mark.parametrize("optimizer", [
+    {"type": "sgd", "lr": 0.1, "wd": 0.01},
+    {"type": "adagrad", "lr": 0.01},
+    {"type": "adam", "lr": 0.001},
+])
+def test_half_row_train_loop_parity(row_dtype, optimizer):
+    py = _mk(EmbeddingHolder, row_dtype, optimizer=optimizer)
+    cc = _mk(NativeEmbeddingHolder, row_dtype, optimizer=optimizer)
+    rng = np.random.default_rng(3)
+    signs = rng.integers(0, 2**63, 32, dtype=np.uint64)
+    dim = 8
+    for step in range(5):
+        a = py.lookup(signs, dim, True)
+        b = cc.lookup(signs, dim, True)
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=1e-6,
+                                   err_msg=f"step {step} lookup diverged")
+        grads = rng.normal(size=(32, dim)).astype(np.float32)
+        py.update_gradients(signs, grads, dim)
+        cc.update_gradients(signs, grads.copy(), dim)
+    for s in signs:
+        pd, pv = py.get_entry(int(s))
+        cd, cv = cc.get_entry(int(s))
+        assert pd == cd
+        np.testing.assert_allclose(pv, cv, rtol=2e-4, atol=1e-6)
+
+
+@pytest.mark.parametrize("row_dtype", ["fp16", "bf16"])
+def test_half_row_byte_budget_eviction_parity(row_dtype):
+    """Byte-accounted eviction must pick the identical victims on both
+    backends (same logical bytes/row, same LRU order)."""
+    row = 8 * 2 + 8 * 4  # fp16/bf16 emb + adagrad f32 state at dim 8
+    kw = dict(capacity=100_000, shards=2, capacity_bytes=64 * row)
+    py = _mk(EmbeddingHolder, row_dtype, **kw)
+    cc = _mk(NativeEmbeddingHolder, row_dtype, **kw)
+    rng = np.random.default_rng(9)
+    for step in range(100):
+        n = int(rng.integers(1, 50))
+        signs = rng.integers(0, 300, n, dtype=np.uint64)
+        a = py.lookup(signs, 8, True)
+        b = cc.lookup(signs, 8, True)
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=1e-6,
+                                   err_msg=f"step {step}")
+        assert len(py) == len(cc)
+        assert py.resident_bytes == cc.resident_bytes
+    for s in range(300):
+        assert (py.get_entry(s) is None) == (cc.get_entry(s) is None), s
+
+
+@pytest.mark.parametrize("row_dtype", ["fp16", "bf16"])
+def test_psd_v2_round_trip_bit_parity_both_directions(row_dtype):
+    """python-dump -> native-load -> native-dump must be byte-identical
+    with the original (and vice versa): one record layout, one framing,
+    narrow bytes preserved exactly through widen/narrow round trips."""
+    import os
+    import tempfile
+
+    py = _mk(EmbeddingHolder, row_dtype)
+    cc = _mk(NativeEmbeddingHolder, row_dtype)
+    signs = np.random.default_rng(4).integers(0, 2**63, 300,
+                                              dtype=np.uint64)
+    py.lookup(signs, 16, True)
+    cc.lookup(signs, 16, True)
+    with tempfile.TemporaryDirectory() as td:
+        pp, cp = os.path.join(td, "p.psd"), os.path.join(td, "c.psd")
+        py.dump_file(pp)
+        cc.dump_file(cp)
+        with open(pp, "rb") as f:
+            py_bytes = f.read()
+        with open(cp, "rb") as f:
+            cc_bytes = f.read()
+        assert py_bytes[:8] == b"PSD1" + (2).to_bytes(4, "little")
+        assert py_bytes == cc_bytes
+        # cross-load, re-dump, compare bytes
+        cc2 = _mk(NativeEmbeddingHolder, row_dtype)
+        cc2.load_file(pp)
+        py2 = _mk(EmbeddingHolder, row_dtype)
+        py2.load_file(cp)
+        pp2, cp2 = os.path.join(td, "p2.psd"), os.path.join(td, "c2.psd")
+        py2.dump_file(pp2)
+        cc2.dump_file(cp2)
+        with open(pp2, "rb") as f:
+            assert f.read() == cc_bytes
+        with open(cp2, "rb") as f:
+            assert f.read() == py_bytes
+        # v2 loads into an fp32 holder of either backend (widen on read)
+        wide_py = _mk(EmbeddingHolder, "fp32")
+        wide_py.load_file(cp)
+        wide_cc = _mk(NativeEmbeddingHolder, "fp32")
+        wide_cc.load_file(pp)
+        assert len(wide_py) == len(wide_cc) == 300
+        for s in signs[:50]:
+            np.testing.assert_array_equal(wide_py.get_entry(int(s))[1],
+                                          wide_cc.get_entry(int(s))[1])
+
+
+def test_native_spill_demotion_and_fault_in():
+    """The native store's retained-eviction drain feeds the shared
+    SpillStore: evictions demote instead of dying, later lookups fault
+    rows back in, and a spill-armed checkpoint is ONE logical table —
+    parity against the Python arena holder over the same traffic (the
+    budget comfortably exceeds one batch: intra-batch churn ordering
+    is the documented divergence regime)."""
+    import os
+    import tempfile
+
+    from persia_tpu.ps.arena import ArenaEmbeddingHolder
+
+    rng = np.random.default_rng(5)
+    row = 8 * 2 + 8 * 4
+    with tempfile.TemporaryDirectory() as td:
+        kw = dict(capacity=100_000, shards=2, capacity_bytes=96 * row)
+        ar = _mk(ArenaEmbeddingHolder, "fp16",
+                 spill_dir=os.path.join(td, "a"), **kw)
+        cc = _mk(NativeEmbeddingHolder, "fp16",
+                 spill_dir=os.path.join(td, "c"), **kw)
+        for step in range(80):
+            n = int(rng.integers(1, 30))
+            signs = rng.integers(0, 150, n, dtype=np.uint64)
+            a = ar.lookup(signs, 8, True)
+            b = cc.lookup(signs, 8, True)
+            np.testing.assert_allclose(a, b, rtol=2e-5, atol=1e-6,
+                                       err_msg=f"step {step}")
+            g = rng.normal(size=(n, 8)).astype(np.float32)
+            ar.update_gradients(signs, g, 8)
+            cc.update_gradients(signs, g.copy(), 8)
+            assert len(ar) == len(cc), step
+        assert cc.spill_stats()["spilled_rows"] > 0
+        assert cc.spill_stats()["spill_fault_ins_total"] > 0
+        # one logical table: dump the spill-armed native holder, load
+        # into a flat python holder, compare every entry
+        path = os.path.join(td, "c.psd")
+        cc.dump_file(path)
+        back = _mk(EmbeddingHolder, "fp16", capacity=100_000, shards=2)
+        back.load_file(path)
+        assert len(back) == len(cc)
+        for s in range(150):
+            a, b = back.get_entry(s), cc.get_entry(s)
+            assert (a is None) == (b is None), s
+            if a is not None:
+                assert a[0] == b[0]
+                np.testing.assert_array_equal(a[1], b[1])
+
+
+def test_native_arena_stats_surface():
+    cc = _mk(NativeEmbeddingHolder, "fp16", capacity=1000, shards=2)
+    signs = np.arange(1, 201, dtype=np.uint64)
+    cc.lookup(signs, 8, True)
+    stats = cc.arena_stats()
+    assert stats["live_rows"] == 200
+    assert stats["slab_bytes"] > 0
+    assert stats["free_slots"] == 0
+    assert stats["fragmentation_ratio"] == 0.0
+    assert stats["resident_bytes"] == cc.resident_bytes
+    per_shard = cc.resident_bytes_per_shard()
+    assert len(per_shard) == 2 and sum(per_shard) == cc.resident_bytes
+
+
 # --- middleware kernel parity (native/src/mw_kernels.h) -------------------
 
 

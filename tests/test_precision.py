@@ -509,23 +509,45 @@ def test_health_reports_resident_bytes_and_row_dtype():
         svc.stop()
 
 
-def test_native_lint_rejects_half_rows(monkeypatch):
-    """row_dtype != fp32 while the native backend is active must fail
-    LOUDLY (the C++ store would silently keep fp32 rows otherwise)."""
+def test_old_native_so_negotiates_down_loudly(monkeypatch):
+    """An OLD pre-arena ``.so`` (no ptps_new2 and friends) asked for a
+    policy it cannot store must negotiate DOWN to the Python arena
+    holder with a loud warning — never a silent policy downgrade. A
+    hard ``PERSIA_PS_BACKEND=native`` pin raises instead."""
     from persia_tpu.ps import native
+    from persia_tpu.ps.arena import ArenaEmbeddingHolder
 
-    monkeypatch.delenv("PERSIA_FORCE_PYTHON_PS", raising=False)
+    class OldLib:  # exports only the pre-arena symbols
+        pass
+
+    warnings = []  # the module logger does not propagate; capture direct
+    monkeypatch.setattr(native._logger, "warning",
+                        lambda msg, *a: warnings.append(msg % a if a
+                                                        else msg))
     monkeypatch.setattr(native, "load_native_lib",
-                        lambda build_if_missing=True: object())
-    with pytest.raises(ValueError, match="native"):
-        native.lint_row_dtype("fp16", prefer_native=True)
-    with pytest.raises(ValueError, match="native"):
-        native.make_holder(1000, 2, row_dtype="fp16")
-    # escape hatches: python holder forced, or fp32 policy
-    native.lint_row_dtype("fp32", prefer_native=True)
-    monkeypatch.setenv("PERSIA_FORCE_PYTHON_PS", "1")
+                        lambda build_if_missing=True: OldLib())
+    assert native.native_capabilities(OldLib()) == frozenset()
     h = native.make_holder(1000, 2, row_dtype="fp16")
-    assert h.row_dtype == "fp16"
+    assert isinstance(h, ArenaEmbeddingHolder)
+    assert h.row_dtype == "fp16"  # the policy is honored, not dropped
+    assert any("negotiating down" in w for w in warnings)
+    # byte budgets and the spill tier negotiate the same way
+    h2 = native.make_holder(1000, 2, capacity_bytes=1 << 20)
+    assert isinstance(h2, ArenaEmbeddingHolder)
+    assert sum("negotiating down" in w for w in warnings) == 2
+    # a hard native pin fails loudly instead of downgrading
+    monkeypatch.setenv("PERSIA_PS_BACKEND", "native")
+    with pytest.raises(RuntimeError, match="lacks"):
+        native.make_holder(1000, 2, row_dtype="fp16")
+    # backend levers: the Python holders are directly addressable
+    monkeypatch.setenv("PERSIA_PS_BACKEND", "python-legacy")
+    from persia_tpu.ps.store import EmbeddingHolder
+
+    h3 = native.make_holder(1000, 2, row_dtype="fp16")
+    assert isinstance(h3, EmbeddingHolder) and h3.row_dtype == "fp16"
+    monkeypatch.setenv("PERSIA_PS_BACKEND", "arena")
+    assert isinstance(native.make_holder(1000, 2),
+                      ArenaEmbeddingHolder)
 
 
 def test_global_config_parses_row_dtype():

@@ -24,7 +24,14 @@ Conventions honored (these are the codebase's, not invented here):
   ``self._locks[i]``, or any attribute assigned a Lock/RLock/Condition
   counts as holding a lock. Nested functions inherit the analysis of
   their enclosing method (a closure mutating under the method's lock
-  is locked).
+  is locked);
+- **the arena's per-shard lock convention** (ps/arena.py): a shard
+  payload object exposes its mutex as the attribute ``lock``, and the
+  OWNER acquires it — ``with self._shards[i].lock:`` or via a local
+  alias ``with shard.lock:``. Any with-item whose context expression is
+  an attribute access named exactly ``lock`` therefore counts as
+  holding a lock (the shard class itself keeps its mutating methods
+  ``_locked``-suffixed, caller-holds-lock).
 """
 
 import ast
@@ -68,10 +75,39 @@ def _self_attr(node: ast.AST):
     return None
 
 
-def _with_lock_attrs(item: ast.withitem, lock_attrs: Set[str]) -> bool:
-    """True when the with-item acquires one of the class's locks:
-    `with self.X:` or `with self.X[i]:` (per-shard lock lists)."""
+def _shard_aliases(fn: ast.AST) -> Set[str]:
+    """Local names assigned from a subscripted self attribute
+    (``shard = self._shards[i]``) — the arena holder's shard-alias
+    shape. Only these names' ``.lock`` counts as a lock below."""
+    aliases: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Subscript) \
+                and _self_attr(node.value.value) is not None:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    aliases.add(tgt.id)
+    return aliases
+
+
+def _with_lock_attrs(item: ast.withitem, lock_attrs: Set[str],
+                     shard_aliases: Set[str]) -> bool:
+    """True when the with-item acquires one of the class's locks —
+    `with self.X:` or `with self.X[i]:` (per-shard lock lists) — or a
+    shard object's mutex by the `.lock` convention: `with
+    self._shards[i].lock:` or `with shard.lock:` where ``shard`` is a
+    local alias of a subscripted self attribute (the arena holder's
+    per-shard discipline). An arbitrary expression's `.lock` does NOT
+    count — it must not blanket-silence the pass."""
     expr = item.context_expr
+    if isinstance(expr, ast.Attribute) and expr.attr == "lock":
+        base = expr.value
+        if isinstance(base, ast.Name) and base.id in shard_aliases:
+            return True
+        if isinstance(base, ast.Subscript) \
+                and _self_attr(base.value) is not None:
+            return True
+        return False
     if isinstance(expr, ast.Subscript):
         expr = expr.value
     attr = _self_attr(expr)
@@ -100,10 +136,11 @@ def _reads_self_attr(expr: ast.AST, attr: str) -> bool:
 def _collect_mutations(fn: ast.AST, method_name: str, lock_attrs: Set[str],
                        start_locked: bool) -> List[_Mutation]:
     muts: List[_Mutation] = []
+    aliases = _shard_aliases(fn)
 
     def visit(node, locked):
         if isinstance(node, ast.With):
-            inner = locked or any(_with_lock_attrs(i, lock_attrs)
+            inner = locked or any(_with_lock_attrs(i, lock_attrs, aliases)
                                   for i in node.items)
             for child in node.body:
                 visit(child, inner)

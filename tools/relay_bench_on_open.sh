@@ -49,12 +49,18 @@ while [ "$(date +%s)" -lt "$END" ]; do
       #     recovery numbers reflect production-class core counts
       step "bench chaos (fault tolerance)" python bench.py --mode chaos \
         --max-seconds 900
-      # 4d. mixed-precision embedding tier (PR 5): fp32 vs fp16-storage
-      #     vs fp16+int8-wire A/B over real PS subprocesses — wire
-      #     bytes, resident bytes, cycle-time gates; host-only but the
-      #     TPU host's core count derisks the 2-core dev-box numbers
-      step "bench mem (mixed precision)" python bench.py --mode mem \
-        --max-seconds 1100
+      # 4d. mixed-precision embedding tier + arena backends (PRs 5+10):
+      #     fp32 vs fp16-storage vs fp16+int8-wire, PLUS the per-
+      #     backend rows — python arena vs per-entry legacy holder vs
+      #     the native C++ arena store at fp16 (wire/resident gates,
+      #     arena-beats-legacy, native <= python-arena cycle, untuned
+      #     full-GC pause) — over real PS subprocesses; host-only but
+      #     the TPU host's core count derisks the 2-core dev-box
+      #     numbers. BENCH_mem.json (per-backend rows) lands next to
+      #     this log.
+      step "bench mem (precision + arena backends)" python bench.py \
+        --mode mem --mem-out /root/repo/BENCH_mem.json \
+        --max-seconds 1400
       # 4e. fleet control plane (PR 6): scrape-on vs scrape-off cycle
       #     inflation (<= 3% gate), SLO breach-detection latency for an
       #     injected PS fault (<= 2 scrape intervals), federated
