@@ -1213,6 +1213,302 @@ def bench_chaos(batch_size, steps, n_ps=2, dim=8, kill_replica=1,
     return result["kill_to_recovered_sec"], result
 
 
+def bench_reshard(batch_size, steps, smoke=False):
+    """Elastic PS tier bench: the whole resharding arc, hard-gated.
+
+    1. **Live 2→4→3 dance under traffic** (real PS services over
+       sockets, trainer threads hammering lookup+update through the
+       worker): a counting optimizer (zero init, unit-lr SGD, unit
+       gradients) makes every applied update visible as exactly -1 in
+       its row, so "zero lost updates" is an arithmetic identity —
+       sum of -values over rows AT THEIR NEW OWNERS == worker-side
+       ships — not a sampled claim. Gates: the identity holds exactly
+       across BOTH cutovers, and worker-cycle p99 during migration
+       stays within ``P99_INFLATION_X`` of quiet p99 (floored — on a
+       2-core box the copy phase steals cycles from everything).
+    2. **Skew A/B** (paired, same trace): zipf(1.05) traffic through a
+       4-replica fleet under uniform hash-even routing vs the
+       hotness-balanced placement planned from the fleet's OWN merged
+       sketches. Load is measured server-side (per-replica hotness
+       totals = signs actually served). Gate: the balanced table's
+       max-replica share beats hash-even.
+    3. **Checkpoint neutrality**: dumping through the routing-aware
+       path under a uniform table is byte-identical to the legacy
+       dump, marker included (the PSD v1 pin).
+    """
+    import tempfile
+    import threading
+
+    from persia_tpu import knobs
+    from persia_tpu.config import EmbeddingSchema, uniform_slots
+    from persia_tpu.data.batch import IDTypeFeature
+    from persia_tpu.ps.store import EmbeddingHolder
+    from persia_tpu.reshard import ReshardController
+    from persia_tpu.routing import RoutingTable
+    from persia_tpu.service.ps_service import PsClient, PsService
+    from persia_tpu.worker.worker import EmbeddingWorker
+
+    P99_INFLATION_X = 25.0
+    P99_FLOOR_SEC = 1.0
+    dim = 8
+    n_feats = 2
+    bs = min(batch_size, 256) if smoke else min(batch_size, 1024)
+    schema = EmbeddingSchema(slots_config=uniform_slots(
+        [f"slot_{i}" for i in range(n_feats)], dim=dim))
+
+    def feature(name, signs):
+        return IDTypeFeature(name, [np.asarray(signs, dtype=np.uint64)])
+
+    def mk_stack(n, hotness=False):
+        holders, services, clients = [], [], []
+        for _ in range(n):
+            h = EmbeddingHolder(capacity=2_000_000, hotness=hotness)
+            svc = PsService(h, port=0)
+            svc.server.serve_background()
+            c = PsClient(svc.addr, circuit_breaker=False)
+            c.configure("bounded_uniform", {"lower": 0.0, "upper": 0.0},
+                        admit_probability=1.0, weight_bound=1e9,
+                        enable_weight_bound=False)
+            c.register_optimizer({"type": "sgd", "lr": 1.0, "wd": 0.0})
+            holders.append(h)
+            services.append(svc)
+            clients.append(c)
+        return holders, services, clients
+
+    detail = {}
+
+    # --- phase 1: live 2→4→3 under traffic ------------------------------
+    holders, services, clients = mk_stack(4)
+    table = RoutingTable.uniform(2)
+    worker = EmbeddingWorker(schema, clients[:2], routing=table)
+    ships = [0]
+    samples = []  # (t_start, duration_sec) per worker cycle
+    s_lock = threading.Lock()
+    stop = threading.Event()
+    errors = []
+    sign_space = 1 << 20
+
+    def train(seed):
+        # counting invariant: with unit gradients and summed slots,
+        # every sign OCCURRENCE (nnz element) contributes exactly -1
+        # to its row — duplicate signs within a batch sum their
+        # per-sample gradients, so occurrences, not distincts, are
+        # what the fleet-wide value sum must equal
+        rng = np.random.default_rng(seed)
+        while not stop.is_set():
+            raw = [rng.integers(0, sign_space, bs, dtype=np.uint64)
+                   for _ in range(n_feats)]
+            t0 = time.perf_counter()
+            try:
+                ref, out = worker.lookup_direct_training(
+                    [feature(f"slot_{i}", r) for i, r in enumerate(raw)])
+                worker.update_gradients(
+                    ref, {k: np.ones_like(v.embeddings)
+                          for k, v in out.items()})
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                return
+            dt = time.perf_counter() - t0
+            with s_lock:
+                ships[0] += n_feats * bs
+                samples.append((t0, dt))
+
+    threads = [threading.Thread(target=train, args=(s,))
+               for s in range(2)]
+    for t in threads:
+        t.start()
+    windows = []
+    controller = ReshardController(clients[:2], table, workers=[worker],
+                                   replay_settle_rows=64, drain_sec=0.25)
+    quiet = 0.4 if smoke else 1.2
+    try:
+        time.sleep(quiet)
+        w0 = time.perf_counter()
+        t4 = controller.reshard_to(4, new_ps_clients=clients)
+        windows.append((w0, time.perf_counter()))
+        time.sleep(quiet)
+        w0 = time.perf_counter()
+        t3 = controller.reshard_to(3)
+        windows.append((w0, time.perf_counter()))
+        time.sleep(quiet)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=120)
+    if errors:
+        raise RuntimeError(f"trainer thread died mid-reshard: "
+                           f"{errors[0]!r}")
+    if any(t.is_alive() for t in threads):
+        raise RuntimeError("trainer thread wedged across the reshard "
+                           "(stale-retry loop did not settle)")
+    controller.finalize(drain_sec=0.0)
+    assert worker.routing_epoch == t3.epoch and t3.num_replicas == 3
+    # zero-lost identity (owner-filtered: donors keep frozen stale
+    # copies of moved rows through the double-read window by design)
+    applied = 0.0
+    for i, h in enumerate(holders):
+        rows = [(s, -float(vec[:d].sum()) / dim)
+                for shard in h._shards
+                for s, (d, vec) in shard._map.items()]
+        if not rows:
+            continue
+        owners = t3.replica_of(np.array([s for s, _ in rows], np.uint64))
+        applied += sum(v for (_s, v), o in zip(rows, owners) if o == i)
+    lost = ships[0] - applied
+    # p99 quiet vs during-migration (windows from the controller)
+    def p99(vals):
+        return float(np.percentile(np.asarray(vals), 99)) if vals else 0.0
+
+    during = [d for t0, d in samples
+              if any(a <= t0 <= b for a, b in windows)]
+    quiet_s = [d for t0, d in samples
+               if not any(a - 0.1 <= t0 <= b + 0.1 for a, b in windows)]
+    p99_quiet, p99_during = p99(quiet_s), p99(during)
+    inflation = (p99_during / p99_quiet) if p99_quiet > 0 else 0.0
+    detail["dance"] = {
+        "ships": int(ships[0]),
+        "applied": round(applied, 1),
+        "lost_updates": round(lost, 3),
+        "cycles_quiet": len(quiet_s),
+        "cycles_during_migration": len(during),
+        "p99_quiet_ms": round(p99_quiet * 1e3, 2),
+        "p99_during_ms": round(p99_during * 1e3, 2),
+        "p99_inflation_x": round(inflation, 2),
+        "epochs": [t4.epoch, t3.epoch],
+        "moved_rows": int(controller._c_moved.value),
+        "replayed_rows": int(controller._c_replayed.value),
+    }
+    worker.close()
+    for s in services:
+        s.stop()
+    log(f"reshard: dance 2→4→3 ships={ships[0]} applied={applied:.0f} "
+        f"lost={lost:.3f}; p99 quiet {p99_quiet * 1e3:.1f} ms vs "
+        f"during {p99_during * 1e3:.1f} ms ({inflation:.1f}x)")
+    if abs(lost) > 1e-3:
+        raise RuntimeError(
+            f"lost updates across live 2→4→3 reshard: ships={ships[0]} "
+            f"applied={applied:.1f} (delta {lost:.3f})")
+    if p99_during > P99_FLOOR_SEC and inflation > P99_INFLATION_X:
+        raise RuntimeError(
+            f"worker p99 during migration inflated {inflation:.1f}x over "
+            f"quiet (gate {P99_INFLATION_X}x, floor {P99_FLOOR_SEC}s)")
+
+    # --- phase 2: skew A/B — hotness-balanced vs hash-even --------------
+    # Scenario: a hot SET always present in every batch (the serving
+    # tier's per-batch dedup makes single-sign zipf heads count once
+    # per batch, so slot-level skew comes from hot signs CLUSTERING on
+    # slots — ~128 hot signs over 256 slots is Poisson(0.5) hot signs
+    # per slot, so hash-even hands some replica 2-3x its fair share of
+    # hot slots) riding a zipf(1.05)-ranked hot pool plus a uniform
+    # cold tail — the shape /fleet/hotness measures on production
+    # traffic.
+    from persia_tpu import hotness as _hotness
+
+    holders, services, clients = mk_stack(4, hotness=True)
+    spr = int(knobs.get("PERSIA_ROUTING_SLOTS_PER_REPLICA"))
+    even = RoutingTable(1, np.arange(4 * spr, dtype=np.int32) % 4, 4)
+    worker = EmbeddingWorker(schema, clients, routing=even)
+    rng = np.random.default_rng(11)
+    hot_pool_n = 128
+    hot_ranks = np.arange(1, hot_pool_n + 1, dtype=np.float64)
+    hot_p = hot_ranks ** -1.05
+    hot_p /= hot_p.sum()
+    with np.errstate(over="ignore"):
+        hot_pool = (np.arange(1, hot_pool_n + 1, dtype=np.uint64)
+                    * np.uint64(0x9E3779B97F4A7C15)) >> np.uint64(1)
+
+    # serving-shaped microbatches: the hot-set share of a batch (and
+    # with it the measurable slot skew) dilutes as batch size grows,
+    # so the scenario pins the A/B at the microbatch size the serving
+    # tier actually coalesces to
+    sbs = min(bs, 256)
+
+    def zipf_feats():
+        n_hot = int(sbs * 0.7)
+        hot = rng.choice(hot_pool, size=n_hot, p=hot_p)
+        cold = (rng.integers(1 << 30, 1 << 40, sbs - n_hot,
+                             dtype=np.uint64))
+        signs = np.concatenate([hot, cold])
+        return [feature(f"slot_{i}", signs) for i in range(n_feats)]
+
+    warm = max(12, steps)
+    trace_len = max(24, 2 * steps)
+    for _ in range(warm):  # sketch-building pass
+        worker.lookup_direct(zipf_feats(), training=False)
+    snap = _hotness.merge_snapshots(
+        [c.hotness() for c in clients])
+    plan = _hotness.placement_plan(snap, 4, current_table=even)
+    balanced = even.derive(np.asarray(plan["assignment"], np.int32), 4,
+                           weights=np.asarray(plan["slot_weights"]))
+    trace = [zipf_feats() for _ in range(trace_len)]
+
+    def measured_shares(tbl):
+        worker.apply_routing(tbl)
+        worker.close_routing_window()
+        before = [c.hotness().get("total", 0) for c in clients]
+        for feats in trace:
+            worker.lookup_direct(feats, training=False)
+        after = [c.hotness().get("total", 0) for c in clients]
+        served = np.array(after, np.float64) - np.array(before,
+                                                        np.float64)
+        return served / max(served.sum(), 1.0)
+
+    even_shares = measured_shares(even.derive(even.replica_of_slot, 4))
+    balanced_shares = measured_shares(
+        balanced.derive(balanced.replica_of_slot, 4))
+    even_max = float(even_shares.max())
+    bal_max = float(balanced_shares.max())
+    gain = even_max / bal_max if bal_max else 0.0
+    detail["skew"] = {
+        "zipf_alpha": 1.05,
+        "trace_batches": trace_len,
+        "even_shares": [round(x, 4) for x in even_shares],
+        "balanced_shares": [round(x, 4) for x in balanced_shares],
+        "even_max_share": round(even_max, 4),
+        "balanced_max_share": round(bal_max, 4),
+        "balance_gain_x": round(gain, 3),
+        "planned_max_share": plan["max_replica_share"],
+        "planned_hash_even_max_share": plan["hash_even_max_share"],
+        "moved_slots": plan["moved_slots"],
+    }
+    worker.close()
+    for s in services:
+        s.stop()
+    log(f"reshard: skew A/B max-replica share {even_max:.3f} hash-even "
+        f"vs {bal_max:.3f} hotness-balanced ({gain:.2f}x)")
+    if bal_max >= even_max:
+        raise RuntimeError(
+            f"hotness-balanced placement did not beat hash-even: "
+            f"max share {bal_max:.4f} vs {even_max:.4f}")
+
+    # --- phase 3: checkpoint neutrality under a uniform table -----------
+    import filecmp
+
+    from persia_tpu.checkpoint import dump_sharded
+
+    tmp = tempfile.mkdtemp(prefix="persia_reshard_ckpt_")
+    hs = [EmbeddingHolder(capacity=10_000) for _ in range(2)]
+    t2 = RoutingTable.uniform(2)
+    signs = np.unique(rng.integers(0, 1 << 40, 500, dtype=np.uint64))
+    for s, owner in zip(signs, t2.replica_of(signs)):
+        hs[owner].set_entry(int(s), dim,
+                            np.arange(2 * dim, dtype=np.float32))
+    d_a, d_b = os.path.join(tmp, "legacy"), os.path.join(tmp, "routed")
+    dump_sharded(hs, d_a)
+    dump_sharded(hs, d_b, routing=t2)
+    identical = all(
+        filecmp.cmp(os.path.join(d_a, n), os.path.join(d_b, n),
+                    shallow=False)
+        for n in sorted(os.listdir(d_a)))
+    detail["checkpoint_uniform_bit_identical"] = identical
+    if not identical:
+        raise RuntimeError(
+            "fp32 checkpoint under a uniform routing table is not "
+            "bit-identical to the legacy dump")
+    log("reshard: uniform-table checkpoint bit-identical to legacy dump")
+    return gain, detail
+
+
 def bench_fleet(batch_size, steps, n_ps=2, dim=DIM, scrape_interval=0.75,
                 scrape_timeout=0.5):
     """Fleet-control-plane bench over a REAL worker + PS-subprocess
@@ -3363,8 +3659,14 @@ def main():
                    choices=["hybrid", "device", "cached", "attn", "wire",
                             "worker", "worker-svc", "store", "roofline",
                             "infer", "rpc", "trace", "chaos", "mem",
-                            "fleet", "telemetry", "tier"],
+                            "fleet", "telemetry", "tier", "reshard"],
                    default="device")
+    p.add_argument("--reshard-out",
+                   default=os.path.join(
+                       os.path.dirname(os.path.abspath(__file__)),
+                       "BENCH_reshard.json"),
+                   help="reshard mode: machine-readable summary path "
+                        "(like BENCH_tier.json)")
     p.add_argument("--tier-out",
                    default=os.path.join(
                        os.path.dirname(os.path.abspath(__file__)),
@@ -3418,6 +3720,7 @@ def main():
         "fleet": ("fleet_scrape_cycle_inflation_pct", "percent"),
         "telemetry": ("telemetry_sketch_topk_recall", "recall"),
         "tier": ("tier_ladder_speedup_vs_flat_x", "x"),
+        "reshard": ("reshard_skew_balance_gain_x", "x"),
     }[args.mode]
 
     # Shared two-tier watchdog (persia_tpu.utils.arm_watchdog — the same
@@ -3437,8 +3740,8 @@ def main():
         args.batch_size, args.steps, args.warmup = 256, 3, 1
 
     if args.mode not in ("wire", "worker", "worker-svc", "store", "rpc",
-                         "trace", "chaos", "mem", "fleet",
-                         "telemetry"):  # host-only modes skip jax
+                         "trace", "chaos", "mem", "fleet", "telemetry",
+                         "reshard"):  # host-only modes skip jax
         # local verification escape hatch (nn_worker.py honors the same
         # variable); plain JAX_PLATFORMS=cpu also counts — the axon
         # platform plugin re-pins jax.config via sitecustomize, so the
@@ -3593,6 +3896,30 @@ def main():
             json.dump(summary, f, indent=1, sort_keys=True)
             f.write("\n")
         log(f"tier: summary written to {args.tier_out}")
+    elif args.mode == "reshard":
+        value, detail = bench_reshard(args.batch_size,
+                                      max(args.steps, 8),
+                                      smoke=args.smoke)
+        # the hard gates (zero lost updates across the live 2→4→3
+        # dance, bounded p99 inflation, hotness-balanced beats
+        # hash-even, uniform-table checkpoint bit-identity) fail
+        # inside bench_reshard; vs_baseline = the balance gain over
+        # break-even (1.0x = no better than hash-even)
+        vs_baseline = value
+        extra["detail"] = detail
+        summary = {
+            "mode": "reshard",
+            "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                         time.gmtime()),
+            "metric": metric,
+            "value": round(value, 4),
+            "unit": unit,
+            "detail": detail,
+        }
+        with open(args.reshard_out, "w") as f:
+            json.dump(summary, f, indent=1, sort_keys=True)
+            f.write("\n")
+        log(f"reshard: summary written to {args.reshard_out}")
     elif args.mode == "fleet":
         value, detail = bench_fleet(
             min(args.batch_size, 512) if args.smoke else args.batch_size,

@@ -74,8 +74,14 @@ class _StagedDir:
                 f.write(data)
 
 
-def dump_sharded(ps_clients: Sequence, dirpath: str):
-    """Fan out a dump to every PS replica, then write the done marker."""
+def dump_sharded(ps_clients: Sequence, dirpath: str, routing=None):
+    """Fan out a dump to every PS replica, then write the done marker.
+
+    A non-uniform ``routing`` table (post-reshard fleet) is recorded in
+    the marker so the load side can route rows by the table that
+    actually sharded them. Under the default/uniform table the marker
+    — and therefore the whole checkpoint — stays byte-identical to the
+    pre-routing layout (the PSD v1 pin)."""
     staged = _StagedDir(dirpath)
     os.makedirs(staged.local, exist_ok=True)
     marker = os.path.join(staged.local, DONE_MARKER)
@@ -84,12 +90,12 @@ def dump_sharded(ps_clients: Sequence, dirpath: str):
     for i, client in enumerate(ps_clients):
         client.dump_file(_replica_path(staged.local, i))
     wait_for_idle(ps_clients)
+    doc = {"num_shards": len(ps_clients),
+           "datetime": time.strftime("%Y-%m-%dT%H:%M:%S")}
+    if routing is not None and not routing.is_uniform_modulo:
+        doc["routing"] = routing.to_doc()
     with open(marker, "w") as f:
-        json.dump(
-            {"num_shards": len(ps_clients),
-             "datetime": time.strftime("%Y-%m-%dT%H:%M:%S")},
-            f,
-        )
+        json.dump(doc, f)
     staged.upload()
 
 
@@ -136,16 +142,35 @@ def iter_psd_entries(path: str):
         yield from iter_psd_records(f.read, version, count)
 
 
-def load_sharded(ps_clients: Sequence, dirpath: str):
-    """Load a dump, resharding if the PS count changed; entries are always
-    routed by ``farmhash64(sign) % len(ps_clients)`` (the worker's shard
-    function)."""
+def _same_assignment(routing, doc: Optional[dict],
+                     num_replicas: int) -> bool:
+    """Does the live table shard rows exactly like the dump's? (Epoch
+    is irrelevant — only the slot→replica assignment matters for
+    whether per-replica files can stream straight in.)"""
+    from persia_tpu.routing import RoutingTable
+
+    dumped = (RoutingTable.from_doc(doc) if doc
+              else RoutingTable.uniform(num_replicas))
+    live = routing if routing is not None else RoutingTable.uniform(
+        num_replicas)
+    return (live.num_replicas == dumped.num_replicas
+            and live.num_slots == dumped.num_slots
+            and np.array_equal(live.replica_of_slot,
+                               dumped.replica_of_slot))
+
+
+def load_sharded(ps_clients: Sequence, dirpath: str, routing=None):
+    """Load a dump, resharding if the shard layout changed; entries are
+    routed by the live :class:`~persia_tpu.routing.RoutingTable` when
+    one is given (the uniform default reproduces the legacy
+    ``farmhash64(sign) % len(ps_clients)`` bit-exactly)."""
     info = read_done_marker(dirpath)
     staged = _StagedDir(dirpath)
     staged.download()
     dirpath = staged.local
     num_shards = info["num_shards"]
-    if num_shards == len(ps_clients):
+    if (num_shards == len(ps_clients)
+            and _same_assignment(routing, info.get("routing"), num_shards)):
         for i, client in enumerate(ps_clients):
             client.load_file(_replica_path(dirpath, i))
         wait_for_idle(ps_clients)
@@ -154,10 +179,29 @@ def load_sharded(ps_clients: Sequence, dirpath: str):
         "resharding checkpoint: %d dump shards -> %d parameter servers",
         num_shards, len(ps_clients),
     )
+    from persia_tpu.routing import RoutingTable
+
+    # Ownership at DUMP time decides which file's copy of a sign is
+    # authoritative: after a live reshard, donors retain stale copies
+    # of moved rows (they age out of the LRU), and those rows appear in
+    # the donor's dump file too — installing files in index order would
+    # let a stale copy overwrite the live owner's row. Filter each
+    # file down to the rows its replica OWNED under the dump's table.
+    dumped = (RoutingTable.from_doc(info["routing"])
+              if info.get("routing")
+              else RoutingTable.uniform(num_shards))
     for client in ps_clients:
         client.clear()
-    # Re-route every entry by the worker's shard function. Batched per
-    # source file to keep memory flat.
+    # Re-route every surviving entry by the live shard function.
+    # Batched per source file to keep memory flat.
+    def install_owned(i, batch_signs, batch_entries):
+        owned = dumped.replica_of(
+            np.array(batch_signs, np.uint64)) == i
+        signs = [s for s, k in zip(batch_signs, owned) if k]
+        entries = [e for e, k in zip(batch_entries, owned) if k]
+        if signs:  # non-owned rows are donors' stale copies
+            _install(ps_clients, signs, entries, routing)
+
     for i in range(num_shards):
         batch_signs: List[int] = []
         batch_entries: List = []
@@ -165,17 +209,19 @@ def load_sharded(ps_clients: Sequence, dirpath: str):
             batch_signs.append(sign)
             batch_entries.append((dim, vec))
             if len(batch_signs) >= 65536:
-                _install(ps_clients, batch_signs, batch_entries)
+                install_owned(i, batch_signs, batch_entries)
                 batch_signs, batch_entries = [], []
         if batch_signs:
-            _install(ps_clients, batch_signs, batch_entries)
+            install_owned(i, batch_signs, batch_entries)
 
 
-def _install(ps_clients, signs, entries):
-    shards = (
-        farmhash64_np(np.array(signs, dtype=np.uint64))
-        % np.uint64(len(ps_clients))
-    ).astype(np.int64)
+def _install(ps_clients, signs, entries, routing=None):
+    sarr = np.array(signs, dtype=np.uint64)
+    if routing is not None:
+        shards = routing.replica_of(sarr)
+    else:
+        shards = (farmhash64_np(sarr)
+                  % np.uint64(len(ps_clients))).astype(np.int64)
     for sign, shard, (dim, vec) in zip(signs, shards, entries):
         ps_clients[shard].set_entry(int(sign), dim, vec)
 

@@ -110,6 +110,10 @@ class ScrapeTarget:
             # version stream is advancing
             "update_version": h.get("update_version"),
             "spill": h.get("spill"),
+            # elastic-tier observables: the replica's published routing
+            # epoch and, mid-migration, its donor capture/freeze state
+            "routing_epoch": h.get("routing_epoch"),
+            "reshard": h.get("reshard"),
             "last_scrape_age_sec": (
                 round(now - self.last_scrape_t, 3)
                 if self.last_scrape_t is not None else None),
@@ -554,7 +558,8 @@ class FleetMonitor:
                             "n_spans": len(merged)}
         return doc
 
-    def fleet_hotness(self, hbm_bytes: Optional[int] = None) -> Dict:
+    def fleet_hotness(self, hbm_bytes: Optional[int] = None,
+                      num_replicas: Optional[int] = None) -> Dict:
         """Cross-shard workload-hotness merge: pull every up target's
         ``/hotness?full=1`` snapshot (disabled/absent targets
         contribute nothing), merge them exactly — totals equal the sum
@@ -585,9 +590,50 @@ class FleetMonitor:
                 scraped.append({"service": t.service,
                                 "total": int(doc.get("total", 0))})
         merged = _hotness.merge_snapshots(snaps)
-        report = _hotness.fleet_report(merged, hbm_bytes=hbm_bytes)
+        report = _hotness.fleet_report(merged, hbm_bytes=hbm_bytes,
+                                       num_replicas=num_replicas)
         report["sources"] = scraped
         return report
+
+    def fleet_routing(self) -> Dict:
+        """The elastic tier's control-plane view: every target's
+        published routing epoch, the fleet-wide min/max (a skew means a
+        cutover is mid-publish or a replica missed it), and any
+        in-flight donor migration state — the operator's one-stop
+        'is the reshard done / stuck' document."""
+        now = time.monotonic()
+        targets = []
+        epochs = []
+        migrating = []
+        for t in self.targets():
+            h = t.last_health or {}
+            ep = h.get("routing_epoch")
+            doc = {
+                "service": t.service,
+                "role": t.role,
+                "up": t.up,
+                "routing_epoch": ep,
+                "reshard": h.get("reshard"),
+                "last_scrape_age_sec": (
+                    round(now - t.last_scrape_t, 3)
+                    if t.last_scrape_t is not None else None),
+            }
+            targets.append(doc)
+            if t.up and ep is not None:
+                epochs.append(int(ep))
+            if t.up and h.get("reshard"):
+                # up-gated like the epoch aggregation: a donor that
+                # died mid-migration keeps its stale health doc, and a
+                # forever-"migrating" ghost would block the runbook's
+                # no-concurrent-reshard precondition
+                migrating.append(t.service)
+        return {
+            "epoch_min": min(epochs) if epochs else None,
+            "epoch_max": max(epochs) if epochs else None,
+            "epoch_skew": bool(epochs) and min(epochs) != max(epochs),
+            "migrating": migrating,
+            "targets": targets,
+        }
 
     def alerts(self, firing_only: bool = False) -> List[Dict]:
         return self.engine.alerts(firing_only=firing_only)
@@ -636,13 +682,20 @@ class FleetHttpServer:
                     elif url.path == "/fleet/breaches":
                         body = json.dumps(
                             mon.engine.breach_events()).encode()
+                    elif url.path == "/fleet/routing":
+                        body = json.dumps(mon.fleet_routing()).encode()
                     elif url.path == "/fleet/hotness":
                         # ?hbm_gb= names the device-tier budget the
                         # capacity planner sizes against
+                        # ?replicas= additionally renders the elastic
+                        # tier's hotness-balanced placement plan
                         hbm_gb = q.get("hbm_gb", [None])[0]
+                        replicas = q.get("replicas", [None])[0]
                         body = json.dumps(mon.fleet_hotness(
                             hbm_bytes=(int(float(hbm_gb) * (1 << 30))
-                                       if hbm_gb else None))).encode()
+                                       if hbm_gb else None),
+                            num_replicas=(int(replicas)
+                                          if replicas else None))).encode()
                     elif url.path == "/healthz":
                         doc = mon.fleet_status()["fleet_monitor"]
                         doc.update({"status": "ok", "ready": True,

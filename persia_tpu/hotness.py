@@ -748,7 +748,8 @@ def table_report(table_snap: Dict,
 
 def planner_report(snapshot: Dict, hbm_bytes: int,
                    row_bytes: Optional[Dict[str, int]] = None,
-                   fracs: Sequence[float] = DEFAULT_COVERAGE_FRACS) -> Dict:
+                   fracs: Sequence[float] = DEFAULT_COVERAGE_FRACS,
+                   num_replicas: Optional[int] = None) -> Dict:
     """HBM-capacity plan for the frequency-admitted device cache
     (ROADMAP item 2): split ``hbm_bytes`` across tables in proportion
     to their lookup traffic, size each table's hot set, and read the
@@ -787,18 +788,102 @@ def planner_report(snapshot: Dict, hbm_bytes: int,
             "hot_row_frac": round(hot_rows / uniq, 6),
             "expected_hit_rate": hit,
         })
-    return {
+    doc = {
         "hbm_bytes": int(hbm_bytes),
         "total_lookups": int(total),
         "expected_overall_hit_rate": round(overall, 6),
         "tables": plan,
     }
+    if num_replicas:
+        # elastic-tier placement: per-slot traffic shares -> replica
+        # assignment, consumed by the reshard controller
+        doc["placement_plan"] = placement_plan(snapshot, num_replicas)
+    return doc
+
+
+def slot_weights(snapshot: Dict, num_slots: int) -> np.ndarray:
+    """Per-routing-slot traffic weights from a (merged) hotness
+    snapshot, for the elastic tier's hotness-balanced placement.
+
+    The tracked top-K heads (bias-corrected midpoint counts, summed
+    across tables — routing is global, not per-table) land on their
+    exact slot via the same ``farmhash % num_slots`` the
+    :class:`~persia_tpu.routing.RoutingTable` routes by; the untracked
+    tail mass (total - head) spreads uniformly across slots, which is
+    exactly what an un-skewed remainder does to load. Returns raw
+    lookup-count weights (length ``num_slots``); normalize if you need
+    shares."""
+    w = np.zeros(int(num_slots), dtype=np.float64)
+    tail_total = 0.0
+    for t in snapshot.get("tables", {}).values():
+        rows = t.get("topk", ())
+        head = 0.0
+        if rows:
+            signs = np.array([r[0] for r in rows], dtype=np.uint64)
+            counts = np.array([max(c - e / 2.0, 0.0)
+                               for _s, c, e in rows], dtype=np.float64)
+            slots = (farmhash64_np(signs)
+                     % np.uint64(num_slots)).astype(np.int64)
+            np.add.at(w, slots, counts)
+            head = float(counts.sum())
+        tail_total += max(float(t.get("total", 0)) - head, 0.0)
+    w += tail_total / float(num_slots)
+    return w
+
+
+def placement_plan(snapshot: Dict, num_replicas: int,
+                   num_slots: Optional[int] = None,
+                   current_table=None) -> Dict:
+    """Hotness-balanced slot→replica placement for ``num_replicas``
+    (the reshard controller's planning input): per-slot traffic shares
+    from :func:`slot_weights`, assigned by the move-minimizing greedy
+    LPT in :func:`persia_tpu.reshard.plan_assignment`. The report pairs
+    the plan's per-replica load shares with what uniform hash-even
+    (``slot % R``) would have carried, so "how much did balancing buy"
+    is a read-off, not a rerun — under zipf traffic the head slot no
+    longer pins max-replica load to head + 1/R."""
+    from persia_tpu import knobs
+    from persia_tpu.reshard import plan_assignment
+    from persia_tpu.routing import RoutingTable
+
+    if current_table is not None:
+        num_slots = current_table.num_slots
+    elif num_slots is None:
+        num_slots = num_replicas * int(
+            knobs.get("PERSIA_ROUTING_SLOTS_PER_REPLICA"))
+    if current_table is None:
+        current_table = RoutingTable(
+            1, np.arange(num_slots, dtype=np.int32)
+            % np.int32(num_replicas), num_replicas)
+    w = slot_weights(snapshot, num_slots)
+    total = float(w.sum()) or 1.0
+    assignment = plan_assignment(current_table, num_replicas, w)
+    loads = np.bincount(assignment, weights=w, minlength=num_replicas)
+    even = np.bincount(
+        np.arange(num_slots, dtype=np.int64) % num_replicas,
+        weights=w, minlength=num_replicas)
+    moved = int(np.count_nonzero(
+        assignment != current_table.replica_of_slot))
+    return {
+        "num_replicas": int(num_replicas),
+        "num_slots": int(num_slots),
+        "assignment": [int(r) for r in assignment],
+        "slot_weights": [round(float(x), 3) for x in w],
+        "replica_shares": [round(float(x) / total, 6) for x in loads],
+        "max_replica_share": round(float(loads.max()) / total, 6),
+        "hash_even_shares": [round(float(x) / total, 6) for x in even],
+        "hash_even_max_share": round(float(even.max()) / total, 6),
+        "moved_slots": moved,
+    }
 
 
 def fleet_report(snapshot: Dict, hbm_bytes: Optional[int] = None,
-                 fracs: Sequence[float] = DEFAULT_COVERAGE_FRACS) -> Dict:
+                 fracs: Sequence[float] = DEFAULT_COVERAGE_FRACS,
+                 num_replicas: Optional[int] = None) -> Dict:
     """The /fleet/hotness document: merged totals, per-table analysis,
-    and (when an HBM budget is named) the capacity plan."""
+    (when an HBM budget is named) the capacity plan, and (when a
+    replica count is named) the elastic tier's hotness-balanced
+    placement plan."""
     doc = {
         "enabled": bool(snapshot.get("enabled")),
         "total": int(snapshot.get("total") or 0),
@@ -806,7 +891,10 @@ def fleet_report(snapshot: Dict, hbm_bytes: Optional[int] = None,
                    for t, ts in snapshot.get("tables", {}).items()},
     }
     if hbm_bytes and snapshot.get("enabled"):
-        doc["planner"] = planner_report(snapshot, hbm_bytes, fracs=fracs)
+        doc["planner"] = planner_report(snapshot, hbm_bytes, fracs=fracs,
+                                        num_replicas=num_replicas)
+    elif num_replicas and snapshot.get("enabled"):
+        doc["placement_plan"] = placement_plan(snapshot, num_replicas)
     return doc
 
 

@@ -129,14 +129,29 @@ class IncrementalUpdateLoader:
     files — the crash-recovery boot replay uses this so a restored PS
     shard reconstructs exactly ITS rows (all replicas share one
     inc_dir); the default (None) keeps the infer-side behavior of
-    loading every replica's entries."""
+    loading every replica's entries.
+
+    ``routing`` (a :class:`~persia_tpu.routing.RoutingTable`) replaces
+    the filename filter with OWNERSHIP filtering: every replica's
+    packets are read, and only entries the table routes to
+    ``replica_index`` apply. This is the correct replay across a
+    shard-count change — a replica recovering after a 2→3 reshard must
+    reconstruct the rows it owns NOW, which live scattered across the
+    old fleet's packet files, and must never apply rows it no longer
+    owns (they would shadow the live owner's state at the next
+    checkpoint merge)."""
 
     def __init__(self, holder, inc_dir: str, scan_interval_sec: float = 10.0,
-                 replica_index: Optional[int] = None):
+                 replica_index: Optional[int] = None, routing=None):
         self.holder = holder
         self.inc_dir = inc_dir
         self.scan_interval_sec = scan_interval_sec
         self.replica_index = replica_index
+        self.routing = routing
+        if routing is not None and replica_index is None:
+            raise ValueError(
+                "routing-filtered replay needs the replica_index the "
+                "table should route to")
         self._applied: Set[str] = set()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -202,8 +217,26 @@ class IncrementalUpdateLoader:
             for fn in sorted(os.listdir(pkt_dir)):
                 if not fn.endswith(".inc"):
                     continue
-                if (self.replica_index is not None
+                if (self.routing is None and self.replica_index is not None
                         and fn != f"{self.replica_index}.inc"):
+                    continue
+                if self.routing is not None:
+                    # ownership replay: read EVERY replica's file,
+                    # batch the entries, and keep only the rows the
+                    # NEW table routes here — the filename filter
+                    # encodes the old fleet's shard count and is
+                    # wrong the moment it changes
+                    batch = list(iter_psd_entries(
+                        os.path.join(pkt_dir, fn)))
+                    if not batch:
+                        continue
+                    owners = self.routing.replica_of(np.array(
+                        [b[0] for b in batch], dtype=np.uint64))
+                    for (sign, dim, vec), owner in zip(batch, owners):
+                        if int(owner) != self.replica_index:
+                            continue
+                        self.holder.set_entry(sign, dim, vec)
+                        pkt_loaded += 1
                     continue
                 for sign, dim, vec in iter_psd_entries(
                         os.path.join(pkt_dir, fn)):

@@ -144,9 +144,17 @@ class Operator:
     """The reconcile loop (reference operator.rs:25-123)."""
 
     def __init__(self, api, job_specs: Optional[List[dict]] = None,
-                 interval: float = 10.0):
+                 interval: float = 10.0, reshard_driver=None):
         self.api = api
         self.interval = interval
+        # elastic-tier hook: ``reshard_driver(job_name, old, new,
+        # phase, spec)`` runs the live slot migration around PS pod
+        # reconciliation (phase "scale_out": pods already created,
+        # migrate onto them; phase "scale_in": migrate OFF the dying
+        # replicas BEFORE their pods are removed). Without a driver,
+        # scale intents are recorded for an external controller.
+        self._reshard_driver = reshard_driver
+        self._reshard_events: List[dict] = []
         self._jobs: Dict[str, dict] = {}
         # serializes reconcile passes against track/untrack (the REST
         # API mutates job state while the loop runs; without this a
@@ -235,6 +243,83 @@ class Operator:
         if any(stats.values()):
             _logger.info("reconciled %s: %s", job, stats)
         return stats
+
+    # --- elastic PS tier (scale-out / scale-in / drain) -----------------
+
+    @staticmethod
+    def _ps_replicas_of(spec: dict) -> int:
+        conf = spec.get("roles", {}).get("embeddingParameterServer")
+        return int(conf.get("replicas", 1)) if conf is not None else 0
+
+    def reshard_events(self) -> List[dict]:
+        with self._lock:
+            return list(self._reshard_events)
+
+    def scale_ps(self, job_name: str, replicas: int) -> dict:
+        """Reconcile a job's PS tier to ``replicas`` with the live
+        reshard sequenced safely around pod churn:
+
+        - **scale-out**: new PS pods are created FIRST (reconcile),
+          then the driver migrates hotness-balanced slot plans onto
+          them and publishes the successor routing epoch;
+        - **scale-in / drain**: the driver migrates every slot OFF the
+          dying replicas and cuts over BEFORE their pods are removed —
+          a drained replica serves stale-epoch double-reads until the
+          window closes, then reconcile deletes it.
+
+        Without a driver the intent is recorded (status "pending") so
+        an external reshard controller — or an operator following
+        docs/DEPLOY.md's runbook — can pick it up; the pod set is only
+        changed for scale-out in that case (never delete a PS that
+        still owns slots)."""
+        import time as _time
+
+        with self._lock:
+            spec = self._jobs.get(job_name)
+            if spec is None:
+                raise KeyError(f"job {job_name!r} is not tracked")
+            old = self._ps_replicas_of(spec)
+            if old == 0:
+                raise ValueError(f"job {job_name!r} has no PS role")
+        replicas = int(replicas)
+        event = {"job": job_name, "from": old, "to": replicas,
+                 "time": _time.strftime("%Y-%m-%dT%H:%M:%S"),
+                 "status": "noop" if replicas == old else "pending"}
+        if replicas == old:
+            with self._lock:
+                self._reshard_events.append(event)
+            return event
+
+        def _apply_spec_and_reconcile():
+            with self._lock:
+                spec["roles"]["embeddingParameterServer"]["replicas"] = \
+                    replicas
+                self._jobs[job_name] = spec
+                self._reconcile_job_locked(spec)
+
+        if replicas > old:
+            # grow the pod set, then migrate onto it
+            _apply_spec_and_reconcile()
+            if self._reshard_driver is not None:
+                self._reshard_driver(job_name, old, replicas,
+                                     "scale_out", spec)
+                event["status"] = "done"
+        else:
+            # drain slots off the dying replicas BEFORE removing pods
+            if self._reshard_driver is not None:
+                self._reshard_driver(job_name, old, replicas,
+                                     "scale_in", spec)
+                event["status"] = "done"
+                _apply_spec_and_reconcile()
+            else:
+                # no driver: record the intent but leave the pods —
+                # deleting a PS that still owns slots loses rows
+                event["status"] = "pending_drain"
+        with self._lock:
+            self._reshard_events.append(event)
+        _logger.info("scale_ps %s: %d -> %d (%s)", job_name, old,
+                     replicas, event["status"])
+        return event
 
     def reconcile_all(self, specs: Optional[List[dict]] = None):
         """One pass over every tracked job. ``specs`` overrides the
@@ -358,6 +443,8 @@ class SchedulingServer:
                                 })
                                 return
                         self._send(404, {"error": f"pod {pod!r} not found"})
+                    elif route == "/reshards":
+                        self._send(200, {"events": op.reshard_events()})
                     else:
                         self._send(404, {"error": f"no route {route!r}"})
                 except Exception as e:  # surface as HTTP, keep serving
@@ -389,6 +476,22 @@ class SchedulingServer:
                         job = self._query().get("job", "")
                         op.untrack(job)
                         self._send(200, {"deleted": job})
+                    elif route == "/scale":
+                        # elastic PS tier: reconcile the replica count
+                        # with the live reshard sequenced around pod
+                        # churn (see Operator.scale_ps)
+                        n = int(self.headers.get("Content-Length", 0))
+                        req = json.loads(self.rfile.read(n))
+                        try:
+                            event = op.scale_ps(req["jobName"],
+                                                int(req["psReplicas"]))
+                        except KeyError as e:
+                            self._send(404, {"error": repr(e)})
+                            return
+                        except ValueError as e:
+                            self._send(400, {"error": repr(e)})
+                            return
+                        self._send(200, event)
                     else:
                         self._send(404, {"error": f"no route {route!r}"})
                 except Exception as e:

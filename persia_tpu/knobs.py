@@ -187,6 +187,36 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
        "update gradients as int8 + per-row scales (error feedback "
        "client-side). Unset/off keeps the fp32 wire byte-identical to "
        "the legacy protocol."),
+    _k("PERSIA_RESHARD_BATCH_ROWS", "int", 65536,
+       "Rows per extract/install chunk while the reshard controller "
+       "streams a donor's slot snapshot to its target replica. Smaller "
+       "chunks bound the per-RPC copy stall a migrating replica "
+       "imposes on live traffic; larger chunks finish the copy phase "
+       "sooner."),
+    _k("PERSIA_RESHARD_DRAIN_SEC", "float", 5.0,
+       "Double-read window after a reshard cutover: donors keep the "
+       "moved rows readable (for in-flight lookups routed by the "
+       "previous epoch) this long before finalize deletes them. "
+       "Raise it when trainers run deep async staleness windows."),
+    _k("PERSIA_RESHARD_STALE_RETRY_SEC", "float", 10.0,
+       "How long a worker retries a shard group bounced with "
+       "routing_stale (the reshard freeze window) while waiting for "
+       "the new routing epoch to arrive before giving up. The freeze "
+       "window is normally milliseconds; this bound only catches a "
+       "wedged cutover."),
+    _k("PERSIA_ROUTING_SLOTS_PER_REPLICA", "int", 64,
+       "Routing slots per PS replica when a uniform table is born "
+       "(num_slots = replicas * this). Slots are the migration unit: "
+       "more slots = finer-grained hotness balancing and smaller "
+       "migration chunks, at a few bytes of table per slot. The "
+       "uniform table routes bit-exactly like the legacy "
+       "farmhash % R whatever this value is."),
+    _k("PERSIA_ROUTING_WIRE", "bool", False,
+       "PsClient probes the __routing__ envelope extension at dial "
+       "and stamps its routing epoch on lookup/update meta, letting a "
+       "resharding PS fast-reject stale-epoch writes before the "
+       "per-sign slot check. Off (default) keeps the wire "
+       "byte-identical; legacy servers negotiate down."),
     _k("PERSIA_RPC_FORCE_BLOCK", "bool", False,
        "Force negotiated block compression even on loopback (tests and "
        "benches exercise the codec path without a real DCN link).",

@@ -1,0 +1,455 @@
+"""Live resharding: the migration controller that moves routing slots
+between PS replicas under traffic with zero lost updates.
+
+State machine per move group (one donor → one target, N slots):
+
+    plan → copy → replay → freeze → cutover → drain
+
+- **plan**: :func:`persia_tpu.hotness.placement_plan` (or the uniform
+  round-robin fallback) assigns the slot space across the desired
+  replica count; :meth:`RoutingTable.moves_to` turns the delta into
+  (donor, target, slots) move groups.
+- **copy**: the donor snapshots the moving slots' rows through its
+  backend's PSD v2 stream (``reshard_begin``) and the controller pipes
+  bounded chunks to the target (``reshard_extract`` →
+  ``reshard_install``). Writes keep landing on the donor; every
+  written sign in a moving slot is **captured**.
+- **replay**: captured signs drain to the target in rounds
+  (``reshard_drain`` reads the rows' CURRENT donor state, so a sign
+  captured five times replays once, with its latest value) until a
+  round comes back small.
+- **freeze**: the donor atomically stops accepting writes for the
+  moving slots (in-flight write handlers are waited out), bouncing
+  late writers with a typed ``routing_stale`` error they retry after
+  the next epoch lands — PR 4's circuit-breaker cutover pattern,
+  applied per-slot.
+- **cutover**: one final drain empties the capture set (the donor is
+  now write-quiescent for those slots, so the read is definitive),
+  then the successor routing table publishes: in-process workers via
+  ``apply_routing``, fleets via the coordinator KV. Bounced writers
+  observe the new epoch and re-split — nothing is lost, nothing
+  applies twice.
+- **drain**: donors keep the moved rows readable for the double-read
+  window (in-flight lookups routed by the old epoch), then
+  ``reshard_finish`` disarms capture; the stale rows age out of the
+  donor's LRU/arena like any cold row.
+
+Zero-lost-updates argument: every write to a moving slot either (a)
+lands on the donor before freeze — then its sign is captured and its
+final value replays to the target before the new epoch publishes — or
+(b) bounces with ``routing_stale`` and re-applies on the target after
+the epoch lands. The target accepts no writes for the moved slots
+before the final replay completes (workers only route there under the
+new epoch, which publishes after), so replay can never clobber a
+post-cutover write. ``bench.py --mode reshard`` pins this with a
+counting optimizer over a live 2→4→3 dance.
+"""
+
+import struct
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from persia_tpu import knobs
+from persia_tpu.logger import get_default_logger
+from persia_tpu.routing import RoutingTable
+
+_logger = get_default_logger(__name__)
+
+
+class ReshardAborted(RuntimeError):
+    """A migration aborted before ANY routing consumer saw the new
+    epoch — the controller rolled the donors back to the old world and
+    nothing diverged. Safe to retry after fixing the cause."""
+
+
+# --- row stream format ------------------------------------------------------
+# PSD-v1-shaped record stream: '<Q' row count, then per row
+# '<QII' (sign, dim, vec_len) + vec_len f32s (value + optimizer state,
+# widened to f32 by the donor's version-agnostic reader).
+
+
+def pack_rows(rows: Iterable[Tuple[int, int, np.ndarray]]) -> bytes:
+    parts = [b""]
+    n = 0
+    for sign, dim, vec in rows:
+        vec = np.ascontiguousarray(vec, np.float32)
+        parts.append(struct.pack("<QII", int(sign), int(dim), len(vec)))
+        parts.append(vec.tobytes())
+        n += 1
+    parts[0] = struct.pack("<Q", n)
+    return b"".join(parts)
+
+
+def unpack_rows(buf: bytes) -> List[Tuple[int, int, np.ndarray]]:
+    (n,) = struct.unpack_from("<Q", buf, 0)
+    off = 8
+    out = []
+    for _ in range(n):
+        sign, dim, ln = struct.unpack_from("<QII", buf, off)
+        off += 16
+        vec = np.frombuffer(buf, np.float32, count=ln, offset=off).copy()
+        off += 4 * ln
+        out.append((sign, dim, vec))
+    return out
+
+
+# --- planning ---------------------------------------------------------------
+
+
+def plan_assignment(table: RoutingTable, num_replicas: int,
+                    slot_weights: Optional[np.ndarray] = None,
+                    ) -> np.ndarray:
+    """Successor slot→replica assignment for ``num_replicas`` over the
+    SAME slot space, minimizing movement.
+
+    With ``slot_weights`` (per-slot traffic shares, e.g. from
+    :func:`persia_tpu.hotness.slot_weights`): greedy LPT with a mild
+    keep-home bias — slots go heaviest-first to the least-loaded
+    replica, staying with their current owner only when that owner is
+    within a tenth of the slot's own weight of the argmin (movement is
+    not free, but balance is the point; a generous tolerance here lets
+    heavy slots pile up at home and hands back hash-even's skew).
+    Without weights: existing slots on surviving replicas stay put and
+    only the delta moves (scale-out steals the evenly-needed surplus;
+    scale-in re-deals the dying replicas' slots)."""
+    n = table.num_slots
+    cur = table.replica_of_slot
+    if slot_weights is not None:
+        w = np.ascontiguousarray(slot_weights, np.float64)
+        if len(w) != n:
+            raise ValueError("slot_weights length != num_slots")
+        out = np.empty(n, np.int32)
+        load = np.zeros(num_replicas, np.float64)
+        order = np.argsort(w, kind="stable")[::-1]
+        for s in order:
+            s = int(s)
+            home = int(cur[s]) if int(cur[s]) < num_replicas else -1
+            best = int(np.argmin(load))
+            if home >= 0 and load[home] - load[best] <= 0.1 * w[s]:
+                best = home
+            out[s] = best
+            load[best] += float(w[s])
+        return out
+    out = cur.astype(np.int32).copy()
+    stranded = [int(s) for s in range(n) if out[s] >= num_replicas]
+    counts = np.bincount(out[out < num_replicas], minlength=num_replicas)
+    # re-deal stranded (scale-in) slots, then even out (scale-out):
+    # every replica should end within 1 of n/num_replicas
+    for s in stranded:
+        r = int(np.argmin(counts))
+        out[s] = r
+        counts[r] += 1
+    target = n // num_replicas
+    overfull = [r for r in range(num_replicas) if counts[r] > target + 1
+                or (counts[r] > target and np.any(counts < target))]
+    for r in overfull:
+        donors = [int(s) for s in range(n) if out[s] == r]
+        while counts[r] > target and np.any(counts < target):
+            s = donors.pop()
+            to = int(np.argmin(counts))
+            out[s] = to
+            counts[r] -= 1
+            counts[to] += 1
+    return out
+
+
+# --- controller -------------------------------------------------------------
+
+
+class ReshardController:
+    """Drives one resharding operation against a fleet of PS replicas
+    speaking the ``reshard_*`` RPC surface (PsService; in-process
+    holders wrapped in PsService work identically over loopback).
+
+    ``workers`` is every routing consumer to swap at cutover — objects
+    with ``apply_routing(table)`` / ``close_routing_window()`` (the
+    EmbeddingWorker; RemoteEmbeddingWorker forwards the same calls).
+    ``coordinator`` (optional CoordinatorClient) additionally publishes
+    the table to the fleet KV for pull-side consumers."""
+
+    def __init__(self, ps_clients: Sequence, table: RoutingTable,
+                 workers: Sequence = (), coordinator=None,
+                 batch_rows: Optional[int] = None,
+                 replay_settle_rows: int = 256,
+                 max_replay_rounds: int = 8,
+                 drain_sec: Optional[float] = None):
+        self.ps_clients = list(ps_clients)
+        self.table = table
+        self.workers = list(workers)
+        self.coordinator = coordinator
+        self.drain_sec = drain_sec
+        self.batch_rows = int(batch_rows if batch_rows is not None
+                              else knobs.get("PERSIA_RESHARD_BATCH_ROWS"))
+        self.replay_settle_rows = int(replay_settle_rows)
+        self.max_replay_rounds = int(max_replay_rounds)
+        self._finalize_lock = threading.Lock()
+        self._pending_finish: List[Tuple[int, List[int]]] = []
+        # progress metrics (the fleet scrapes these off whichever
+        # process hosts the controller)
+        from persia_tpu.metrics import default_registry
+
+        reg = default_registry()
+        self._g_epoch = reg.gauge(
+            "reshard_controller_epoch",
+            help_text="routing epoch last published by this controller")
+        self._g_active = reg.gauge(
+            "reshard_active",
+            help_text="1 while a slot migration is in flight")
+        self._c_moved = reg.counter(
+            "reshard_moved_rows_total",
+            help_text="rows copied donor->target across all migrations")
+        self._c_replayed = reg.counter(
+            "reshard_replayed_rows_total",
+            help_text="captured-write rows replayed donor->target")
+        self._c_bounced = reg.counter(
+            "reshard_moves_total",
+            help_text="(donor, target) slot move groups completed")
+
+    # -- public entry points ----------------------------------------------
+
+    def reshard_to(self, num_replicas: int,
+                   slot_weights: Optional[np.ndarray] = None,
+                   new_ps_clients: Optional[Sequence] = None,
+                   ) -> RoutingTable:
+        """Scale/rebalance to ``num_replicas`` (hotness-balanced when
+        ``slot_weights`` is given). ``new_ps_clients`` replaces the
+        replica client list when the fleet grew; it must cover every
+        replica the successor table references. Returns the published
+        table."""
+        if new_ps_clients is not None:
+            self.ps_clients = list(new_ps_clients)
+        if num_replicas > len(self.ps_clients):
+            raise ValueError(
+                f"cannot reshard to {num_replicas} replicas with only "
+                f"{len(self.ps_clients)} PS clients")
+        assignment = plan_assignment(self.table, num_replicas,
+                                     slot_weights)
+        new_table = self.table.derive(assignment, num_replicas,
+                                      weights=slot_weights)
+        return self.execute(new_table)
+
+    def execute(self, new_table: RoutingTable) -> RoutingTable:
+        """Run the full plan → copy → replay → freeze → cutover for an
+        explicit successor table. Donor cleanup (the drain step) is
+        deferred to :meth:`finalize` so the double-read window stays
+        open for in-flight old-epoch readers."""
+        # migrations serialize fleet-wide: the PREVIOUS epoch's frozen
+        # donor states must clear before new moves begin — a slot that
+        # moves BACK to a prior donor would otherwise bounce against
+        # that donor's stale frozen mask forever
+        if self._pending_finish:
+            _logger.info("reshard: finalizing previous migration before "
+                         "epoch %d begins", new_table.epoch)
+            self.finalize()
+        moves = self.table.moves_to(new_table)
+        self._g_active.set(1)
+        t0 = time.perf_counter()
+        frozen: List[Tuple[int, List[int]]] = []
+        by_donor: Dict[int, List[Dict]] = {}
+        for mv in moves:
+            by_donor.setdefault(mv["donor"], []).append(mv)
+        try:
+            # copy + replay per donor (all of a donor's outgoing slots
+            # snapshot in ONE pass over its store)
+            for donor, donor_moves in sorted(by_donor.items()):
+                self._copy_and_replay(donor, donor_moves, new_table)
+            # freeze every donor, then final-drain each: after this
+            # loop no write for a moved slot can land anywhere
+            for donor, donor_moves in sorted(by_donor.items()):
+                slots = sorted(s for mv in donor_moves
+                               for s in mv["slots"])
+                self.ps_clients[donor].reshard_freeze(new_table.epoch)
+                frozen.append((donor, slots))
+                self._final_drain(donor, donor_moves, new_table)
+        except BaseException:
+            # pre-publish rollback is SAFE: no worker has seen the new
+            # epoch, so unfreezing every touched donor — frozen ones
+            # AND armed-but-unfrozen ones whose copy failed midway —
+            # restores exactly the old, still-routed-by world
+            for donor in by_donor:
+                try:
+                    self.ps_clients[donor].reshard_finish()
+                except Exception:
+                    pass
+            self._g_active.set(0)
+            raise
+        # cutover: publish the successor epoch everywhere. From here
+        # rollback is NOT safe — once any worker routes by the new
+        # epoch, unfreezing donors would let old-epoch writers diverge
+        # from the target copies — so a partial publish leaves the
+        # donors frozen (bounced writers keep re-trying / failing
+        # loudly) and raises for the operator.
+        try:
+            self._publish(new_table)
+        except ReshardAborted:
+            # zero consumers applied: the old world is intact, so the
+            # pre-publish rollback is still safe
+            for donor in by_donor:
+                try:
+                    self.ps_clients[donor].reshard_finish()
+                except Exception:
+                    pass
+            self._g_active.set(0)
+            raise
+        except BaseException:
+            _logger.error(
+                "reshard cutover for epoch %d failed MID-PUBLISH: "
+                "donors stay frozen (do NOT reshard_finish them by "
+                "hand unless every routing consumer is confirmed on "
+                "the old epoch); retry the publish or re-run "
+                "execute() with the same table", new_table.epoch)
+            self._g_active.set(0)
+            raise
+        with self._finalize_lock:
+            self._pending_finish.extend(frozen)
+        self.table = new_table
+        self._g_active.set(0)
+        self._c_bounced.inc(len(moves))
+        _logger.info(
+            "reshard to epoch %d done in %.2fs (%d move groups)",
+            new_table.epoch, time.perf_counter() - t0, len(moves))
+        return new_table
+
+    def finalize(self, drain_sec: Optional[float] = None):
+        """Close the double-read window: wait out ``drain_sec`` (knob
+        default) for in-flight old-epoch lookups, disarm every frozen
+        donor's capture state, and drop the workers' predecessor
+        tables."""
+        if drain_sec is None:
+            drain_sec = (self.drain_sec if self.drain_sec is not None
+                         else float(knobs.get("PERSIA_RESHARD_DRAIN_SEC")))
+        with self._finalize_lock:
+            pending, self._pending_finish = self._pending_finish, []
+        if not pending:
+            return
+        if drain_sec > 0:
+            time.sleep(drain_sec)
+        for donor, _slots in pending:
+            try:
+                self.ps_clients[donor].reshard_finish()
+            except Exception as e:
+                _logger.warning("reshard_finish on donor %d failed: %s",
+                                donor, e)
+        for w in self.workers:
+            close = getattr(w, "close_routing_window", None)
+            if close is not None:
+                close()
+
+    # -- phases -----------------------------------------------------------
+
+    def _copy_and_replay(self, donor: int, donor_moves: List[Dict],
+                         new_table: RoutingTable):
+        slots = sorted(s for mv in donor_moves for s in mv["slots"])
+        target_of_slot = {s: mv["target"] for mv in donor_moves
+                          for s in mv["slots"]}
+        client = self.ps_clients[donor]
+        total = client.reshard_begin(slots, new_table.num_slots,
+                                     new_table.epoch)
+        copied = 0
+        while True:
+            chunk, done = client.reshard_extract(self.batch_rows)
+            if chunk:
+                copied += self._install(chunk, target_of_slot, new_table)
+            if done:
+                break
+        self._c_moved.inc(copied)
+        _logger.info("reshard: donor %d copied %d/%s rows for %d slots",
+                     donor, copied, total, len(slots))
+        # replay rounds: captured writes accumulated during the copy
+        for _ in range(self.max_replay_rounds):
+            chunk = client.reshard_drain()
+            n = self._install(chunk, target_of_slot, new_table)
+            self._c_replayed.inc(n)
+            if n <= self.replay_settle_rows:
+                return
+        _logger.warning(
+            "reshard: donor %d capture set not settling after %d "
+            "rounds; the freeze window will absorb the rest",
+            donor, self.max_replay_rounds)
+
+    def _final_drain(self, donor: int, donor_moves: List[Dict],
+                     new_table: RoutingTable):
+        target_of_slot = {s: mv["target"] for mv in donor_moves
+                          for s in mv["slots"]}
+        # the donor is frozen: this read is definitive
+        chunk = self.ps_clients[donor].reshard_drain()
+        n = self._install(chunk, target_of_slot, new_table)
+        self._c_replayed.inc(n)
+
+    def _install(self, chunk: bytes, target_of_slot: Dict[int, int],
+                 new_table: RoutingTable) -> int:
+        rows = unpack_rows(chunk) if isinstance(chunk, (bytes, bytearray)) \
+            else list(chunk)
+        if not rows:
+            return 0
+        by_target: Dict[int, List] = {}
+        signs = np.array([r[0] for r in rows], np.uint64)
+        slot_ids = new_table.slot_of(signs)
+        for row, slot in zip(rows, slot_ids.tolist()):
+            tgt = target_of_slot.get(int(slot))
+            if tgt is None:
+                # a captured sign outside the moving set (possible when
+                # one capture set serves several move groups): skip
+                continue
+            by_target.setdefault(tgt, []).append(row)
+        for tgt, tgt_rows in by_target.items():
+            self.ps_clients[tgt].reshard_install(pack_rows(tgt_rows))
+        return sum(len(v) for v in by_target.values())
+
+    def _publish(self, table: RoutingTable):
+        applied = 0
+        refused = 0
+        first_error: Optional[BaseException] = None
+        for w in self.workers:
+            try:
+                if getattr(w, "addrs", None) is not None:
+                    # remote worker fleet: ships addresses, each
+                    # replica dials its own clients
+                    ok = w.apply_routing(table, ps_addrs=[
+                        c.addr for c in self.ps_clients])
+                else:
+                    ok = w.apply_routing(table,
+                                         ps_clients=self.ps_clients)
+            except BaseException as e:
+                first_error = first_error or e
+                # a partial broadcast (RemoteEmbeddingWorker fleet)
+                # reports whether ANY of its replicas applied — that
+                # poisons the zero-applied rollback just like a full
+                # consumer applying
+                if getattr(e, "applied_any", False):
+                    applied += 1
+                continue
+            applied += 1 if ok else 0
+            refused += 0 if ok else 1
+        if first_error is not None or refused:
+            if applied == 0:
+                # nobody routes by the new epoch: execute() may safely
+                # roll the donors back to the old world
+                raise ReshardAborted(
+                    f"routing epoch {table.epoch} reached no routing "
+                    f"consumer ({refused} refused as stale — the fleet "
+                    f"may already be PAST this epoch; rebuild the "
+                    f"controller from the live table via "
+                    f"/fleet/routing — first error: {first_error!r})")
+            raise RuntimeError(
+                f"routing epoch {table.epoch} published to only "
+                f"{applied}/{len(self.workers)} consumers "
+                f"({refused} refused, first error: {first_error!r})")
+        if self.coordinator is not None:
+            from persia_tpu.routing import publish_to_coordinator
+
+            publish_to_coordinator(self.coordinator, table)
+        for c in self.ps_clients:
+            note = getattr(c, "set_routing_epoch", None)
+            if note is not None:
+                try:
+                    note(table.epoch)
+                except Exception:
+                    pass
+        self._g_epoch.set(table.epoch)
+        _logger.info("routing epoch %d published to %d workers%s",
+                     table.epoch, len(self.workers),
+                     " + coordinator" if self.coordinator else "")

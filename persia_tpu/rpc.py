@@ -161,6 +161,12 @@ ENVELOPE_EXTENSIONS: Dict[str, Dict[str, str]] = {
         "doc": "negotiated payload codec: block compression + half-"
                "precision rows",
     },
+    "__routing__": {
+        "kind": "envelope",
+        "doc": "routing-epoch rider: client stamps its slot-table epoch "
+               "on request meta so a resharding server fast-rejects "
+               "stale-epoch writes; opt-in via PERSIA_ROUTING_WIRE",
+    },
     "__faults__": {
         "kind": "control",
         "doc": "remote fault-injection control, opt-in via "
@@ -1120,8 +1126,8 @@ class _ConnState:
     none of this state needs a lock."""
 
     __slots__ = ("sock", "compress", "tagged", "trace", "deadline",
-                 "codec", "block", "next_tag", "outstanding", "done",
-                 "evicted", "dead")
+                 "codec", "block", "routing", "routing_epoch", "next_tag",
+                 "outstanding", "done", "evicted", "dead")
 
     def __init__(self, sock: socket.socket, compress: bool):
         self.sock = sock
@@ -1131,6 +1137,8 @@ class _ConnState:
         self.deadline = False  # peer acked the __deadline__ envelope slot
         self.codec = False  # peer acked the __codec__ payload codec
         self.block = None  # negotiated block-compression algo (or None)
+        self.routing = False  # peer acked the __routing__ epoch rider
+        self.routing_epoch = None  # peer's routing epoch at dial time
         self.next_tag = 1
         self.outstanding = set()  # tags sent, reply not yet claimed
         self.done: Dict[int, tuple] = {}  # tag -> (env, payload) parked
@@ -1209,7 +1217,8 @@ class RpcClient:
                  enable_tags: bool = True,
                  deadline: Optional[float] = None,
                  enable_deadline: Optional[bool] = None,
-                 enable_codec: bool = False):
+                 enable_codec: bool = False,
+                 enable_routing: bool = False):
         self.addr = addr
         host, port = addr.rsplit(":", 1)
         self._target = (host, int(port))
@@ -1221,6 +1230,11 @@ class RpcClient:
         # mixed-precision wire): probes __codec__ at dial; legacy
         # servers negotiate down; when off, no probe — byte-identical
         self.enable_codec = enable_codec
+        # opt-in routing-epoch rider (PERSIA_ROUTING_WIRE / reshard
+        # tooling): probes __routing__ at dial; legacy servers refuse
+        # and the connection carries no rider; when off, no probe —
+        # byte-identical legacy wire
+        self.enable_routing = enable_routing
         # payload bytes in/out, pre-framing (what the wire codec
         # shrinks): the bench's bytes-on-wire accounting
         self._wire_lock = threading.Lock()
@@ -1289,6 +1303,21 @@ class RpcClient:
                         cs.block = (rep or {}).get("compress")
                     except Exception:
                         cs.block = None
+            if self.enable_routing:
+                # routing-epoch rider negotiation: a reshard-aware
+                # server acks with its current slot-table epoch; legacy
+                # peers answer "no such method" and the connection
+                # carries no rider (negotiate-down) — with the rider
+                # off the probe is never sent, byte-identical wire
+                _send_msg(sock, ["__routing__"], b"", False)
+                env, pl, _ = _recv_msg_tagged(sock)
+                if env[0] == "ok":
+                    cs.routing = True
+                    try:
+                        rep = msgpack.unpackb(_payload_bytes(pl), raw=False)
+                        cs.routing_epoch = int((rep or {}).get("epoch", 0))
+                    except Exception:
+                        cs.routing_epoch = None
         except BaseException:
             try:
                 sock.close()
@@ -1325,6 +1354,17 @@ class RpcClient:
             return False
         try:
             return self._conn().codec
+        except (ConnectionError, OSError):
+            return False
+
+    def routing_active(self) -> bool:
+        """True when this thread's connection negotiated the
+        __routing__ epoch rider (dialing if needed); False against
+        legacy peers or when the rider was never enabled."""
+        if not self.enable_routing:
+            return False
+        try:
+            return self._conn().routing
         except (ConnectionError, OSError):
             return False
 

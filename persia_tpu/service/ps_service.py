@@ -19,6 +19,7 @@ without code changes.
 import argparse
 import os
 import threading
+import time
 from typing import List, Optional
 
 import msgpack
@@ -40,6 +41,142 @@ from persia_tpu.rpc import (
 from persia_tpu.service.coordinator import ROLE_PS, CoordinatorClient
 
 _logger = get_default_logger(__name__)
+
+
+class _WriteGate:
+    """Generation-counted barrier over the PS write handlers.
+
+    Every write (gradient update, row write, training lookup — they
+    create rows) enters the CURRENT generation and exits when applied.
+    ``drain_prior`` flips the generation and waits for the old one to
+    empty: after it returns, every write that began before the flip is
+    fully visible in the holder. ``reshard_begin`` uses it between
+    arming capture and snapshotting, closing the race where an
+    in-flight pre-arm write lands in a shard the snapshot already
+    serialized — invisible to both the copy and the capture set, i.e.
+    a silently lost update. Cost on the hot path: one uncontended
+    lock pair per write handler."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        # per-generation in-flight counts (pruned when they hit zero):
+        # a dict, not a two-slot parity array, so a drain that TIMED
+        # OUT on a wedged write leaves that write's generation visible
+        # to the next drain instead of aliasing it into the current one
+        self._counts: Dict[int, int] = {}
+        self._gen = 0
+
+    def enter(self) -> int:
+        with self._lock:
+            g = self._gen
+            self._counts[g] = self._counts.get(g, 0) + 1
+        return g
+
+    def exit(self, g: int):
+        with self._lock:
+            self._counts[g] -= 1
+            if self._counts[g] == 0:
+                del self._counts[g]
+                self._cond.notify_all()
+
+    def drain_prior(self, timeout: float = 10.0):
+        """Bump the generation; wait until EVERY write of an earlier
+        generation has applied. One caller at a time (reshard_begin
+        holds the reshard lock)."""
+        with self._lock:
+            self._gen += 1
+            cur = self._gen
+            deadline = time.monotonic() + timeout
+            while any(g < cur for g in self._counts):
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise RuntimeError(
+                        "pre-arm writes did not drain before the "
+                        "reshard snapshot")
+                self._cond.wait(left)
+
+
+class _ReshardState:
+    """Donor-side state of one in-flight slot migration: the moving
+    slot mask, the write-capture set, the snapshot stream, and the
+    freeze barrier. One per replica at a time (reshard_begin refuses a
+    second); the hot-path cost while NO migration runs is a single
+    ``self._reshard is None`` test per handler."""
+
+    def __init__(self, slots, num_slots: int, epoch: int):
+        self.num_slots = int(num_slots)
+        self.epoch = int(epoch)
+        self.mask = np.zeros(self.num_slots, dtype=bool)
+        self.mask[np.asarray(sorted(set(int(s) for s in slots)),
+                             dtype=np.int64)] = True
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self.frozen = False  # plain-bool fast reads are GIL-atomic
+        self.inflight = 0
+        self.captured: set = set()
+        self.captured_total = 0
+        self.snapshot_rows: List = []
+        self.extract_pos = 0
+
+    def hits(self, signs: np.ndarray) -> Optional[np.ndarray]:
+        """The subset of ``signs`` living in a moving slot (None when
+        disjoint — the overwhelmingly common case)."""
+        from persia_tpu.hashing import farmhash64_np
+
+        s = np.ascontiguousarray(signs, dtype=np.uint64)
+        if len(s) == 0:
+            return None
+        slot = (farmhash64_np(s)
+                % np.uint64(self.num_slots)).astype(np.int64)
+        hit = self.mask[slot]
+        return s[hit] if hit.any() else None
+
+    def enter_write(self, signs: np.ndarray) -> Optional[np.ndarray]:
+        """Gate one write batch: None when it touches no moving slot;
+        otherwise registers the in-flight write (for the freeze
+        barrier) and returns the signs to capture on exit. A frozen
+        state bounces the writer with the typed routing_stale error
+        the worker's re-split path understands."""
+        hit = self.hits(signs)
+        if hit is None:
+            return None
+        with self._lock:
+            if self.frozen:
+                from persia_tpu.routing import STALE_PREFIX
+                from persia_tpu.rpc import RpcError
+
+                raise RpcError(f"{STALE_PREFIX}{self.epoch}")
+            self.inflight += 1
+        return hit
+
+    def exit_write(self, hit: np.ndarray):
+        with self._lock:
+            self.captured.update(int(x) for x in hit)
+            self.captured_total += len(hit)
+            self.inflight -= 1
+            if self.inflight == 0:
+                self._cond.notify_all()
+
+    def freeze(self, timeout: float = 5.0):
+        """Stop admitting writes for the moving slots and wait out the
+        writes already past the gate — after this returns, the final
+        capture drain reads definitive row state."""
+        with self._lock:
+            self.frozen = True
+            deadline = time.monotonic() + timeout
+            while self.inflight > 0:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise RuntimeError(
+                        "reshard freeze: in-flight writes did not "
+                        "settle within the barrier timeout")
+                self._cond.wait(left)
+
+    def drain_captured(self) -> set:
+        with self._lock:
+            out, self.captured = self.captured, set()
+        return out
 
 
 class ShardParallelDispatcher:
@@ -223,6 +360,29 @@ class PsService:
         # negotiation — and nobody calls it with telemetry off, keeping
         # the disabled wire byte-identical
         s.register("hotness", self._hotness_rpc)
+        # live-resharding surface (persia_tpu.reshard drives it): slot
+        # snapshot/extract on the donor, row install on the target,
+        # capture drain + write freeze for the zero-lost-updates
+        # cutover. Plain methods — nothing here rides the envelope, so
+        # fleets that never reshard keep a byte-identical wire.
+        self._reshard: Optional[_ReshardState] = None
+        self._reshard_lock = threading.Lock()
+        self._routing_epoch = 0
+        self._wgate = _WriteGate()
+        s.register("reshard_begin", self._reshard_begin)
+        s.register("reshard_extract", self._reshard_extract)
+        s.register("reshard_install", self._reshard_install)
+        s.register("reshard_drain", self._reshard_drain)
+        s.register("reshard_freeze", self._reshard_freeze)
+        s.register("reshard_finish", self._reshard_finish)
+        s.register("reshard_status", self._reshard_status)
+        s.register("set_routing_epoch", self._set_routing_epoch)
+        # __routing__ envelope rider (declared in ENVELOPE_EXTENSIONS):
+        # acks routing-aware clients with this replica's epoch; legacy
+        # clients never probe, probing clients of a legacy server get
+        # "no such method" — negotiate-down both ways
+        s.register("__routing__", lambda payload: msgpack.packb(
+            {"epoch": self._routing_epoch}))
         # gradient-staleness accounting: one update-batch version
         # counter bumped per update RPC (two uncontended lock ops — the
         # same cost class as the server's stats lock). A telemetry-armed
@@ -378,6 +538,21 @@ class PsService:
         doc["hotness_enabled"] = getattr(self.holder, "hotness",
                                          None) is not None
         doc["update_version"] = self._current_update_ver()
+        # elastic-tier observables: the published routing epoch and (only
+        # while a migration runs) the donor-side capture/freeze state —
+        # what /fleet/routing aggregates and the stuck-migration SLO
+        # rule watches
+        doc["routing_epoch"] = self._routing_epoch
+        rs = self._reshard
+        if rs is not None:
+            with rs._lock:
+                doc["reshard"] = {
+                    "frozen": rs.frozen,
+                    "pending_epoch": rs.epoch,
+                    "captured": len(rs.captured),
+                    "captured_total": rs.captured_total,
+                    "snapshot_rows_left": len(rs.snapshot_rows),
+                }
         # disk spill tier (the cold rung of the storage ladder): row/
         # byte/fault-in accounting for capacity planning and the tier
         # bench's per-level hit breakdown; absent when unarmed
@@ -446,10 +621,24 @@ class PsService:
         # bundle of THIS replica's ring can always validate. ctx= keeps
         # untraced requests untraced (no orphan roots) — same rule as
         # the shard dispatcher's sub-spans.
-        with tracing.span("ps/lookup", ctx=tracing.current_context(),
-                          n=len(signs), dim=meta["dim"]):
-            out = self._dispatch.lookup(signs, meta["dim"],
-                                        meta["training"])
+        # training lookups CREATE rows, so they are writes for the
+        # migration capture and the write gate (eval lookups pass
+        # untouched — reads are served from the donor through the
+        # whole double-read window)
+        rs = hit = None
+        g = self._wgate.enter() if meta["training"] else None
+        try:
+            if meta["training"]:
+                rs, hit = self._reshard_guard(signs, meta)
+            with tracing.span("ps/lookup", ctx=tracing.current_context(),
+                              n=len(signs), dim=meta["dim"]):
+                out = self._dispatch.lookup(signs, meta["dim"],
+                                            meta["training"])
+        finally:
+            if rs is not None and hit is not None:
+                rs.exit_write(hit)
+            if g is not None:
+                self._wgate.exit(g)
         # telemetry-armed client asked ("hv" in the request meta) for
         # the holder's update version: it rides the response meta and
         # comes back on the client's update as "hver". Reply-only-when-
@@ -484,9 +673,18 @@ class PsService:
             signs, grads = arrays
         if faults._active:
             faults.fire("ps.update", n=len(signs), dim=meta["dim"])
-        with tracing.span("ps/update", ctx=tracing.current_context(),
-                          n=len(signs), dim=meta["dim"]):
-            self._dispatch.update_gradients(signs, grads, meta["dim"])
+        rs = hit = None
+        g = self._wgate.enter()
+        try:
+            rs, hit = self._reshard_guard(signs, meta)
+            with tracing.span("ps/update", ctx=tracing.current_context(),
+                              n=len(signs), dim=meta["dim"]):
+                self._dispatch.update_gradients(signs, grads, meta["dim"])
+        finally:
+            if rs is not None and hit is not None:
+                rs.exit_write(hit)
+            if g is not None:
+                self._wgate.exit(g)
         ver = self._bump_update_ver()
         hver = meta.get("hver")
         if hver is not None:
@@ -511,7 +709,16 @@ class PsService:
 
     def _set_entry(self, payload: bytes) -> bytes:
         meta, (vec,) = unpack_arrays(payload)
-        self.holder.set_entry(meta["sign"], meta["dim"], vec)
+        rs = hit = None
+        g = self._wgate.enter()
+        try:
+            rs, hit = self._reshard_guard(
+                np.asarray([meta["sign"]], dtype=np.uint64), meta)
+            self.holder.set_entry(meta["sign"], meta["dim"], vec)
+        finally:
+            if rs is not None and hit is not None:
+                rs.exit_write(hit)
+            self._wgate.exit(g)
         # a full-row write is an update: it joins the version stream
         # and the incremental-update log exactly like a gradient apply,
         # so checkpoint replay and train->serve sync see one logical
@@ -532,9 +739,17 @@ class PsService:
 
     def _set_entries(self, payload: bytes) -> bytes:
         meta, (signs, vecs) = unpack_arrays(payload)
-        self.holder.set_entries(
-            signs, meta["dim"],
-            vecs.reshape(len(signs), -1))
+        rs = hit = None
+        g = self._wgate.enter()
+        try:
+            rs, hit = self._reshard_guard(signs, meta)
+            self.holder.set_entries(
+                signs, meta["dim"],
+                vecs.reshape(len(signs), -1))
+        finally:
+            if rs is not None and hit is not None:
+                rs.exit_write(hit)
+            self._wgate.exit(g)
         # the device cache's eviction/flush write-back: versioned like
         # update_gradients (write-backs are ordered with gradient
         # applies in one stream) and committed to the inc-update log —
@@ -554,6 +769,190 @@ class PsService:
 
     def _clear(self, payload: bytes) -> bytes:
         self.holder.clear()
+        return b""
+
+    # --- live resharding (donor/target surface) --------------------------
+
+    def _reshard_guard(self, signs: np.ndarray, meta: Optional[dict] = None):
+        """Write-path gate: one None test when no migration runs. With
+        a migration in flight, writes touching moving slots register
+        for capture (and bounce once frozen). The negotiated ``re``
+        meta rider short-circuits a frozen bounce before any hashing."""
+        rs = self._reshard
+        if rs is None:
+            return None, None
+        if rs.frozen and meta is not None:
+            ce = meta.get("re")
+            if ce is not None and int(ce) < rs.epoch:
+                from persia_tpu.routing import STALE_PREFIX
+                from persia_tpu.rpc import RpcError
+
+                raise RpcError(f"{STALE_PREFIX}{rs.epoch}")
+        return rs, rs.enter_write(signs)
+
+    def _reshard_begin(self, payload: bytes) -> bytes:
+        """Arm capture for the moving slots, then snapshot their rows
+        out of the backend's PSD stream (capture first: a write landing
+        mid-snapshot is re-read at replay, so the copy can never miss
+        it). The snapshot streams through a temp-file dump — every
+        backend writes the same PSD record format (store.h's v2 stream
+        included) — so donor RAM grows only by the MOVING rows, never
+        by a whole-store blob. Returns the snapshot row count."""
+        import tempfile
+
+        from persia_tpu.ps.store import iter_psd_records, read_psd_header
+
+        req = msgpack.unpackb(payload, raw=False)
+        rs = _ReshardState(req["slots"], req["num_slots"], req["epoch"])
+        with self._reshard_lock:
+            if self._reshard is not None:
+                raise RuntimeError(
+                    "a slot migration is already in flight on this "
+                    "replica")
+            self._reshard = rs
+            # barrier: writes already past the (then-absent) capture
+            # gate must finish applying BEFORE the snapshot reads the
+            # store, or an in-flight row lands in a shard the snapshot
+            # already serialized — invisible to both copy and capture,
+            # i.e. a lost update
+            self._wgate.drain_prior()
+        from persia_tpu.hashing import farmhash64_np
+
+        pending: List = []
+
+        def flush_pending():
+            if not pending:
+                return
+            signs = np.array([r[0] for r in pending], np.uint64)
+            slot = (farmhash64_np(signs)
+                    % np.uint64(rs.num_slots)).astype(np.int64)
+            keep = rs.mask[slot]
+            rs.snapshot_rows.extend(
+                r for r, k in zip(pending, keep) if k)
+            pending.clear()
+
+        fd, path = tempfile.mkstemp(prefix="persia_reshard_snap_")
+        os.close(fd)
+        try:
+            self.holder.dump_file(path)
+            with open(path, "rb") as fh:
+                version, count = read_psd_header(fh, "<reshard-snapshot>")
+                for rec in iter_psd_records(fh.read, version, count):
+                    pending.append(rec)
+                    if len(pending) >= 65536:
+                        flush_pending()
+                flush_pending()
+        finally:
+            os.unlink(path)
+        _logger.info("reshard_begin: %d slots, %d snapshot rows, "
+                     "epoch %d pending", int(rs.mask.sum()),
+                     len(rs.snapshot_rows), rs.epoch)
+        return msgpack.packb({"rows": len(rs.snapshot_rows)})
+
+    def _reshard_extract(self, payload: bytes) -> bytes:
+        from persia_tpu.reshard import pack_rows
+
+        req = msgpack.unpackb(payload, raw=False)
+        rs = self._reshard
+        if rs is None:
+            raise RuntimeError("no migration in flight")
+        a = rs.extract_pos
+        b = min(a + int(req.get("max_rows") or 65536),
+                len(rs.snapshot_rows))
+        rs.extract_pos = b
+        chunk = pack_rows(rs.snapshot_rows[a:b])
+        done = b >= len(rs.snapshot_rows)
+        if done:
+            rs.snapshot_rows = []  # freed; capture carries the rest
+            rs.extract_pos = 0
+        return pack_arrays({"done": done},
+                           [np.frombuffer(chunk, np.uint8)])
+
+    def _reshard_install(self, payload: bytes) -> bytes:
+        """Install a migrated row chunk on the target: batched per
+        (dim, row width) through the vectorized set_entries path (a
+        live target must not pay per-entry Python on millions of
+        rows), versioned and committed to the inc-update log exactly
+        like any other full-row write — a target that crashes after
+        the migration reconstructs its migrated rows from the replay
+        stream (see restore(routing=))."""
+        from persia_tpu.reshard import unpack_rows
+
+        meta, (blob,) = unpack_arrays(payload)
+        by_shape: dict = {}
+        for sign, dim, vec in unpack_rows(bytes(blob)):
+            by_shape.setdefault((int(dim), len(vec)), []).append(
+                (int(sign), vec))
+        n = 0
+        for (dim, _width), rows in by_shape.items():
+            signs = np.array([s for s, _v in rows], np.uint64)
+            vecs = np.stack([v for _s, v in rows])
+            self.holder.set_entries(signs, dim, vecs)
+            self._bump_update_ver()
+            if self.inc_dumper is not None:
+                self.inc_dumper.commit(signs)
+            n += len(rows)
+        return msgpack.packb({"installed": n})
+
+    def _reshard_drain(self, payload: bytes) -> bytes:
+        """Ship the captured writes' CURRENT rows (a sign captured N
+        times replays once, with its latest value + optimizer state).
+        Frozen, this read is definitive — the cutover's final drain."""
+        from persia_tpu.reshard import pack_rows
+
+        rs = self._reshard
+        if rs is None:
+            raise RuntimeError("no migration in flight")
+        rows = []
+        for sign in rs.drain_captured():
+            entry = self.holder.get_entry(sign)
+            if entry is not None:
+                rows.append((sign, entry[0], entry[1]))
+        chunk = pack_rows(rows)
+        return pack_arrays({"rows": len(rows)},
+                           [np.frombuffer(chunk, np.uint8)])
+
+    def _reshard_freeze(self, payload: bytes) -> bytes:
+        req = msgpack.unpackb(payload, raw=False)
+        rs = self._reshard
+        if rs is None:
+            raise RuntimeError("no migration in flight")
+        if req.get("epoch") is not None:
+            rs.epoch = int(req["epoch"])
+        rs.freeze()
+        _logger.info("reshard_freeze: moving slots write-frozen pending "
+                     "epoch %d", rs.epoch)
+        return b""
+
+    def _reshard_finish(self, payload: bytes) -> bytes:
+        """Disarm capture (cutover published + double-read window
+        closed). Moved rows stay resident and simply age out of the
+        LRU/arena like any cold row — they are unreachable under the
+        new table, so correctness never depends on deleting them."""
+        with self._reshard_lock:
+            rs, self._reshard = self._reshard, None
+        return msgpack.packb(
+            {"was_active": rs is not None,
+             "captured_total": rs.captured_total if rs else 0})
+
+    def _reshard_status(self, payload: bytes) -> bytes:
+        rs = self._reshard
+        doc = {"active": rs is not None,
+               "routing_epoch": self._routing_epoch}
+        if rs is not None:
+            with rs._lock:
+                doc.update({
+                    "frozen": rs.frozen,
+                    "pending_epoch": rs.epoch,
+                    "captured": len(rs.captured),
+                    "captured_total": rs.captured_total,
+                    "snapshot_rows_left": len(rs.snapshot_rows),
+                })
+        return msgpack.packb(doc)
+
+    def _set_routing_epoch(self, payload: bytes) -> bytes:
+        req = msgpack.unpackb(payload, raw=False)
+        self._routing_epoch = int(req["epoch"])
         return b""
 
     def _set_status(self, status: str):
@@ -598,7 +997,8 @@ class PsService:
 
     def restore(self, checkpoint_path: Optional[str] = None,
                 replay_inc_dir: Optional[str] = None,
-                replica_index: Optional[int] = None) -> int:
+                replica_index: Optional[int] = None,
+                routing=None) -> int:
         """Crash-recovery boot restore: load this replica's last
         checkpoint shard, then replay any incremental-update packets
         newer than it (the train-side dumper's ``inc_*`` directories) on
@@ -610,7 +1010,42 @@ class PsService:
         self._set_status("Loading")
         replayed = 0
         try:
-            if checkpoint_path:
+            if checkpoint_path and routing is not None:
+                # shard-layout-change recovery: the per-replica file
+                # was sharded by the OLD table, so load only the rows
+                # the NEW table routes here — rows this replica no
+                # longer owns would shadow the live owner's state at
+                # the next checkpoint merge. (Rows it gained from
+                # OTHER old shards come back through the routing-
+                # filtered inc replay below; a full reconstruction
+                # across layouts restores the whole directory via
+                # checkpoint.load_sharded instead.)
+                from persia_tpu.checkpoint import iter_psd_entries
+
+                kept = 0
+                batch: List = []
+
+                def flush_batch():
+                    nonlocal kept
+                    if not batch:
+                        return
+                    owners = routing.replica_of(np.array(
+                        [b[0] for b in batch], np.uint64))
+                    for (sign, dim, vec), o in zip(batch, owners):
+                        if int(o) == replica_index:
+                            self.holder.set_entry(sign, dim, vec)
+                            kept += 1
+                    batch.clear()
+
+                for rec in iter_psd_entries(checkpoint_path):
+                    batch.append(rec)
+                    if len(batch) >= 65536:
+                        flush_batch()
+                flush_batch()
+                _logger.info(
+                    "restored checkpoint %s (%d rows kept under the "
+                    "live routing table)", checkpoint_path, kept)
+            elif checkpoint_path:
                 self.holder.load_file(checkpoint_path)
                 _logger.info("restored checkpoint %s (%d entries)",
                              checkpoint_path, len(self.holder))
@@ -619,7 +1054,8 @@ class PsService:
 
                 replayed = IncrementalUpdateLoader(
                     self.holder, replay_inc_dir,
-                    replica_index=replica_index).scan_once()
+                    replica_index=replica_index,
+                    routing=routing).scan_once()
                 _logger.info("replayed %d incremental entries from %s",
                              replayed, replay_inc_dir)
             self._set_status("Idle")
@@ -695,8 +1131,19 @@ class PsClient:
                  legacy_frames: bool = False,
                  circuit_breaker=None, deadline: Optional[float] = None,
                  wire_codec: Optional[str] = None,
-                 hotness: Optional[bool] = None):
+                 hotness: Optional[bool] = None,
+                 routing_wire: Optional[bool] = None):
         self.addr = addr
+        # routing-epoch rider (None -> PERSIA_ROUTING_WIRE env): armed,
+        # the connection probes __routing__ at dial and every lookup/
+        # update stamps this client's routing epoch ("re" meta) so a
+        # mid-reshard server fast-rejects stale-epoch writes. Off (the
+        # default) sends no probe and no rider — byte-identical wire;
+        # legacy servers refuse the probe and negotiate down.
+        if routing_wire is None:
+            routing_wire = knobs.get("PERSIA_ROUTING_WIRE")
+        self.routing_wire = bool(routing_wire)
+        self.routing_epoch: Optional[int] = None
         # workload telemetry (None -> PERSIA_HOTNESS env): armed, every
         # lookup asks for the replica's update version ("hv" request
         # meta) and every update echoes the last seen one back
@@ -724,7 +1171,8 @@ class PsClient:
         self.client = RpcClient(addr, enable_tags=enable_tags,
                                 deadline=deadline,
                                 enable_codec=self.wire_fp16
-                                or self.wire_int8)
+                                or self.wire_int8,
+                                enable_routing=self.routing_wire)
         if self.wire_int8:
             from persia_tpu.worker.middleware import GradErrorFeedback
 
@@ -802,6 +1250,9 @@ class PsClient:
             meta["resp"] = "fp16"
         if self.telemetry:
             meta["hv"] = 1
+        if (self.routing_wire and self.routing_epoch is not None
+                and self.client.routing_active()):
+            meta["re"] = int(self.routing_epoch)
         return meta
 
     def _note_hver(self, meta: dict):
@@ -829,6 +1280,9 @@ class PsClient:
         meta = {"dim": int(dim)}
         if self.telemetry and self._last_hver is not None:
             meta["hver"] = self._last_hver
+        if (self.routing_wire and self.routing_epoch is not None
+                and self.client.routing_active()):
+            meta["re"] = int(self.routing_epoch)
         return meta
 
     def _update_payload(self, signs: np.ndarray, grads: np.ndarray,
@@ -972,6 +1426,62 @@ class PsClient:
 
     def clear(self):
         self._guarded(lambda: self.client.call("clear"))
+
+    # --- live-resharding surface (persia_tpu.reshard drives these) -------
+
+    def reshard_begin(self, slots, num_slots: int, epoch: int) -> int:
+        """Donor: arm write capture for ``slots`` and snapshot their
+        rows; returns the snapshot row count."""
+        rep = self._guarded(lambda: self.client.call_msg(
+            "reshard_begin", slots=[int(s) for s in slots],
+            num_slots=int(num_slots), epoch=int(epoch)))
+        return int(rep["rows"])
+
+    def reshard_extract(self, max_rows: int):
+        """Donor: next snapshot chunk. Returns (row_blob, done)."""
+        meta, (blob,) = unpack_arrays(self._guarded(
+            lambda: self.client.call(
+                "reshard_extract",
+                msgpack.packb({"max_rows": int(max_rows)},
+                              use_bin_type=True))))
+        return bytes(blob), bool(meta["done"])
+
+    def reshard_install(self, row_blob: bytes) -> int:
+        """Target: install a row chunk (value + optimizer state)."""
+        rep = msgpack.unpackb(self._guarded(
+            lambda: self.client.call("reshard_install", pack_arrays(
+                {}, [np.frombuffer(row_blob, np.uint8)]), dedup=True)),
+            raw=False)
+        return int(rep["installed"])
+
+    def reshard_drain(self) -> bytes:
+        """Donor: current rows of the captured writes (clears the
+        capture set)."""
+        _meta, (blob,) = unpack_arrays(self._guarded(
+            lambda: self.client.call("reshard_drain")))
+        return bytes(blob)
+
+    def reshard_freeze(self, epoch: Optional[int] = None):
+        """Donor: stop admitting writes for the moving slots (bounces
+        carry ``epoch`` as the demanded successor epoch)."""
+        self._guarded(lambda: self.client.call_msg(
+            "reshard_freeze", epoch=epoch))
+
+    def reshard_finish(self) -> dict:
+        return msgpack.unpackb(self._guarded(
+            lambda: self.client.call("reshard_finish")), raw=False)
+
+    def reshard_status(self) -> dict:
+        return msgpack.unpackb(self._guarded(
+            lambda: self.client.call("reshard_status")), raw=False)
+
+    def set_routing_epoch(self, epoch: int):
+        """Record the published routing epoch on the replica (rides
+        health docs and the __routing__ ack) and stamp it on this
+        client's future rider-armed requests."""
+        self.routing_epoch = int(epoch)
+        self._guarded(lambda: self.client.call_msg(
+            "set_routing_epoch", epoch=int(epoch)))
 
     def dump_file(self, path: str, blocking: bool = True):
         self._guarded(lambda: self.client.call_msg(

@@ -76,6 +76,11 @@ class WorkerService:
         s.register("load", self._load)
         s.register("staleness", self._staleness)
         s.register("ready", self._ready)
+        # elastic-tier control plane: the reshard controller pushes the
+        # successor routing table at cutover; scale-out additionally
+        # names the PS addresses the grown fleet serves from
+        s.register("apply_routing", self._apply_routing)
+        s.register("close_routing_window", self._close_routing_window)
 
     @property
     def addr(self):
@@ -98,6 +103,9 @@ class WorkerService:
             doc["post_forward_buffer_depth"] = len(w._post_forward_buffer)
             doc["staleness"] = w.staleness
         doc["ps_replicas"] = w.replica_size
+        # elastic-tier observable: which routing epoch this worker
+        # splits by (the fleet's /fleet/routing skew check reads it)
+        doc["routing_epoch"] = w.routing_epoch
         # readiness: can this worker actually serve lookups right now
         # (every PS replica armed and Idle)? /healthz?ready=1 turns a
         # False into a 503 so probes stop routing here mid-PS-recovery
@@ -190,6 +198,28 @@ class WorkerService:
     def _staleness(self, payload: bytes) -> bytes:
         return msgpack.packb({"staleness": self.worker.staleness})
 
+    def _apply_routing(self, payload: bytes) -> bytes:
+        from persia_tpu.routing import RoutingTable
+
+        req = msgpack.unpackb(payload, raw=False)
+        table = RoutingTable.from_bytes(req["table"])
+        clients = None
+        if req.get("ps_addrs"):
+            # reuse the live client (and its pooled connections) for
+            # every address we already hold; dial only the newcomers —
+            # apply_routing closes whichever clients drop out
+            held = {getattr(c, "addr", None): c
+                    for c in self.worker.ps_clients}
+            clients = [held.get(a) or PsClient(a)
+                       for a in req["ps_addrs"]]
+        applied = self.worker.apply_routing(table, ps_clients=clients)
+        return msgpack.packb({"applied": bool(applied),
+                              "epoch": self.worker.routing_epoch})
+
+    def _close_routing_window(self, payload: bytes) -> bytes:
+        self.worker.close_routing_window()
+        return b""
+
     def _ready(self, payload: bytes) -> bytes:
         """Ready iff every PS replica is serving (the trainer's recovery
         wait polls this; reference forward.rs:708-715 wait_for_serving)."""
@@ -201,6 +231,21 @@ class WorkerService:
         except Exception:
             ready = False
         return msgpack.packb({"ready": bool(ready)})
+
+
+class PartialPublishError(RuntimeError):
+    """A routing-table broadcast reached only part of a worker fleet.
+    ``applied_any`` is the controller's rollback gate: True means at
+    least one replica already routes by the new epoch, so donors must
+    STAY frozen (retry the publish) rather than roll back."""
+
+    def __init__(self, applied_any: bool, failures):
+        self.applied_any = bool(applied_any)
+        self.failures = list(failures)
+        super().__init__(
+            f"routing publish failed on {len(self.failures)} worker "
+            f"replica(s) (applied_any={self.applied_any}): "
+            + "; ".join(f"{a}: {e!r}" for a, e in self.failures))
 
 
 class RemoteEmbeddingWorker:
@@ -351,6 +396,37 @@ class RemoteEmbeddingWorker:
     def load(self, path: str):
         self._clients[self.addrs[0]].call_msg("load", path=path)
 
+    # --- elastic-tier control plane --------------------------------------
+
+    def apply_routing(self, table, ps_addrs: Optional[List[str]] = None
+                      ) -> bool:
+        """Broadcast a successor routing table (and, on scale-out, the
+        grown PS address list) to EVERY worker replica — the reshard
+        controller's cutover publish for a remote worker fleet. A
+        partial broadcast raises :class:`PartialPublishError` carrying
+        whether ANY replica applied: the controller's rollback
+        decision hinges on that bit (rolling donors back while one
+        replica already routes by the new epoch would split the
+        fleet's view of slot ownership)."""
+        applied = False
+        failures = []
+        for addr in self.addrs:
+            try:
+                rep = self._clients[addr].call_msg(
+                    "apply_routing", table=table.to_bytes(),
+                    ps_addrs=list(ps_addrs) if ps_addrs else None)
+            except Exception as e:  # noqa: BLE001
+                failures.append((addr, e))
+                continue
+            applied = applied or bool(rep.get("applied"))
+        if failures:
+            raise PartialPublishError(applied, failures)
+        return applied
+
+    def close_routing_window(self):
+        for addr in self.addrs:
+            self._clients[addr].call("close_routing_window")
+
     def shutdown(self):
         for c in self._clients.values():
             c.shutdown_server()
@@ -388,6 +464,7 @@ def main():
     schema = EmbeddingSchema.load(args.embedding_config)
     gc = GlobalConfig.load(args.global_config) if args.global_config else GlobalConfig()
     ps_resolver = None
+    routing_fetch = None
     if args.ps_addrs:
         ps_addrs = args.ps_addrs.split(",")
     else:
@@ -397,6 +474,15 @@ def main():
         def ps_resolver():
             return [PsClient(a) for a in
                     coord.wait_members(ROLE_PS, args.num_ps, timeout=120)]
+
+        def routing_fetch():
+            # pull-side routing distribution: the reshard controller
+            # publishes successor tables to the coordinator KV; a
+            # worker bounced with routing_stale fetches the epoch
+            # itself instead of waiting for a push
+            from persia_tpu.routing import fetch_from_coordinator
+
+            return fetch_from_coordinator(coord)
     ps_clients = [PsClient(a) for a in ps_addrs]
     worker = EmbeddingWorker(
         schema, ps_clients,
@@ -404,6 +490,7 @@ def main():
         buffered_data_expired_sec=gc.embedding_worker.buffered_data_expired_sec,
         enable_monitor=args.enable_monitor,
         ps_resolver=ps_resolver,
+        routing_fetch=routing_fetch,
     )
     service = WorkerService(
         worker, args.host, args.port,

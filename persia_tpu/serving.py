@@ -532,6 +532,12 @@ class InferenceServer:
             doc["cache_ttl_sec"] = self.cache.ttl_sec
         doc["requests_total"] = self._m_requests.value
         doc["degraded_lookups_total"] = self._m_degraded.value
+        # elastic-tier observable: which routing epoch the embedding
+        # fetch path splits by (an in-process EmbeddingWorker exposes
+        # it; a RemoteEmbeddingWorker's replicas report their own)
+        epoch = getattr(self.worker, "routing_epoch", None)
+        if epoch is not None:
+            doc["routing_epoch"] = epoch
         # the serving tier stays READY while degrading (zero-vector
         # fallback answers requests); degraded_lookups_total climbing is
         # the alert, not a routing decision

@@ -196,6 +196,23 @@ def default_rules() -> List[SloRule]:
                             "for 2+ minutes — slab memory is parked "
                             "idle; shrink capacity or restart to "
                             "compact"),
+        # elastic-tier objectives (no-data until a reshard controller
+        # exports its gauges, so static fleets never page on them)
+        SloRule("reshard_stuck", "reshard_active", ">", 0.0,
+                window_sec=120.0, for_sec=600.0,
+                description="a slot migration has been in flight for "
+                            "over 10 minutes — the copy/replay loop is "
+                            "stuck (donor wedged, capture set not "
+                            "settling, or the controller died "
+                            "mid-freeze); check /fleet/routing for the "
+                            "frozen donor"),
+        SloRule("reshard_replay_runaway",
+                "rate(reshard_replayed_rows_total)", ">", 100000.0,
+                window_sec=120.0, severity="ticket",
+                description="capture replay moving >100k rows/s for "
+                            "minutes — write traffic into the moving "
+                            "slots outruns the drain; shrink the move "
+                            "batch or reshard off-peak"),
         SloRule("device_cache_hit_collapse",
                 "ratio(device_cache_misses_total,"
                 " device_cache_probes_total)",

@@ -302,16 +302,49 @@ class ShardGroup:
     distinct_idx: np.ndarray  # (m,) int32 — index into that feature's distinct
 
 
+_NONUNIFORM_WARNED = [False]
+
+
+def _routing_replicas(signs: np.ndarray, routing) -> np.ndarray:
+    """Slot-table replica per sign, negotiating DOWN from the native
+    shard_order kernel (which hard-codes ``hash % R``) — loudly, once,
+    per the capability-negotiation convention: a non-uniform epoch is
+    an operator-visible event, not a silent slow path."""
+    if not _NONUNIFORM_WARNED[0]:
+        _NONUNIFORM_WARNED[0] = True
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "routing epoch %d is non-uniform: negotiating down from "
+            "native shard_order (modulo-only kernel) to the Python "
+            "slot-table split", routing.epoch)
+    return routing.replica_of(signs)
+
+
 def shard_split(
-    feats: List[DedupedFeature], schema: EmbeddingSchema, replica_size: int
+    feats: List[DedupedFeature], schema: EmbeddingSchema, replica_size: int,
+    routing=None,
 ) -> List[ShardGroup]:
-    """Group every feature's distinct signs by (PS shard, dim)."""
+    """Group every feature's distinct signs by (PS shard, dim).
+
+    ``routing`` (a :class:`persia_tpu.routing.RoutingTable`) replaces
+    the raw ``farmhash % replica_size`` when present AND non-uniform; a
+    uniform table routes bit-exactly like the modulo, so it keeps the
+    native fast path and the byte-identical wire."""
     from persia_tpu.hashing import sign_to_shard
 
-    native = _mw_native()
+    if routing is not None and routing.is_uniform_modulo:
+        routing = None  # exact modulo: the legacy paths serve it
+    native = _mw_native() if routing is None else None
     by_key: Dict[Tuple[int, int], List[Tuple[np.ndarray, int]]] = {}
     for fi, feat in enumerate(feats):
         dim = schema.get_slot(feat.name).dim
+        if routing is not None:
+            shards = _routing_replicas(feat.distinct_signs, routing)
+            for shard in np.unique(shards):
+                sel = np.nonzero(shards == shard)[0].astype(np.int32)
+                by_key.setdefault((int(shard), dim), []).append((sel, fi))
+            continue
         if native is not None:
             # fused farmhash + counting sort; slice order within a shard
             # is ascending, identical to the nonzero path below
@@ -518,7 +551,7 @@ def aggregate_gradients(
 def shard_gradients(
     feats: List[DedupedFeature], schema: EmbeddingSchema,
     per_feature_grads: List[np.ndarray], replica_size: int,
-    groups: Optional[List[ShardGroup]] = None,
+    groups: Optional[List[ShardGroup]] = None, routing=None,
 ) -> List[Tuple[int, int, np.ndarray, np.ndarray]]:
     """Group per-sign gradients by (shard, dim) for the PS update calls.
 
@@ -526,7 +559,7 @@ def shard_gradients(
     worker caches them in its post-forward buffer) to skip re-hashing and
     re-grouping every sign. Returns a list of (shard, dim, signs, grads)."""
     if groups is None:
-        groups = shard_split(feats, schema, replica_size)
+        groups = shard_split(feats, schema, replica_size, routing=routing)
     return [
         (g.shard, g.dim, g.signs, gather_group_grads(g, per_feature_grads))
         for g in groups

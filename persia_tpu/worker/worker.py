@@ -64,6 +64,8 @@ class EmbeddingWorker:
         enable_monitor: bool = False,
         ps_resolver=None,
         streaming: Optional[bool] = None,
+        routing=None,
+        routing_fetch=None,
     ):
         self.schema = schema
         self.ps_clients = list(ps_clients)
@@ -81,6 +83,24 @@ class EmbeddingWorker:
         self.replica_size = len(self.ps_clients)
         if self.replica_size == 0:
             raise ValueError("EmbeddingWorker needs at least one PS client")
+        # Slot-table routing (persia_tpu.routing): every shard decision
+        # reads ONE immutable table through this atomic-swap cell. The
+        # launch default is the uniform table — bit-exact legacy
+        # farmhash % R routing, native fast path intact. The reshard
+        # controller (or a coordinator watcher) installs successor
+        # epochs via apply_routing; `routing_fetch` (optional callable
+        # returning the latest published table) lets the stale-retry
+        # path pull the new epoch itself when nobody pushes it.
+        from persia_tpu.routing import RoutingHolder, RoutingTable
+
+        if routing is None:
+            routing = RoutingTable.uniform(self.replica_size)
+        elif routing.num_replicas > self.replica_size:
+            raise ValueError(
+                f"routing table references {routing.num_replicas} "
+                f"replicas but only {self.replica_size} PS clients given")
+        self._routing = RoutingHolder(routing)
+        self._routing_fetch = routing_fetch
         self.forward_buffer_size = forward_buffer_size
         self.buffered_data_expired_sec = buffered_data_expired_sec
         # Concurrent fan-out to the PS replicas (the reference joins all
@@ -178,6 +198,119 @@ class EmbeddingWorker:
                 config,
                 feature_index_prefix_bit=self.schema.feature_index_prefix_bit,
             )
+
+    # --- routing control plane -------------------------------------------
+
+    @property
+    def routing(self):
+        """The current :class:`~persia_tpu.routing.RoutingTable`
+        (immutable; an atomic reference read)."""
+        return self._routing.table
+
+    @property
+    def routing_epoch(self) -> int:
+        return self._routing.epoch
+
+    def apply_routing(self, table, ps_clients=None) -> bool:
+        """Atomically swap in a successor routing table (and, on
+        scale-out/in, the replica client list) mid-traffic. Epoch-
+        checked: a stale or duplicate publish is a no-op (returns
+        False). The predecessor stays readable through the double-read
+        window until :meth:`close_routing_window`; in-flight batches
+        split under the old epoch keep their cached shard groups and
+        settle against donors, which retain moved rows until the
+        migration's finalize."""
+        dropped = []
+        with self._ps_lock:
+            if table.epoch <= self._routing.epoch:
+                return False
+            new_clients = (list(ps_clients) if ps_clients is not None
+                           else self.ps_clients)
+            if table.num_replicas > len(new_clients):
+                raise ValueError(
+                    f"routing epoch {table.epoch} references "
+                    f"{table.num_replicas} replicas but worker has "
+                    f"{len(new_clients)} PS clients")
+            applied = self._routing.apply(table)
+            if applied:
+                # the client list only changes WITH its table: a late
+                # lower-epoch publish must not shrink the live list out
+                # from under a newer epoch's routing
+                if self.ps_clients is not new_clients:
+                    keep = set(map(id, new_clients))
+                    dropped = [c for c in self.ps_clients
+                               if id(c) not in keep]
+                self.ps_clients = new_clients
+                self.replica_size = len(new_clients)
+        for c in dropped:
+            # a replaced client's sockets must not leak one generation
+            # per reshard (same discipline as _refresh_ps_clients;
+            # racing callers simply redial)
+            close = getattr(getattr(c, "client", None), "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:
+                    pass
+        if applied and self._fanout is None and len(self.ps_clients) > 1:
+            self._fanout = ThreadPoolExecutor(
+                max_workers=min(2 * len(self.ps_clients), 32),
+                thread_name_prefix="ps-fanout")
+        if applied:
+            _logger.info("routing epoch %d applied (%d replicas, %d slots)",
+                         table.epoch, table.num_replicas, table.num_slots)
+        return applied
+
+    def close_routing_window(self):
+        """End the double-read window (migration drained)."""
+        self._routing.close_window()
+
+    def _await_epoch(self, min_epoch: int, deadline: float,
+                     retry_interval: float = 0.25):
+        """Wait for the routing cell to reach ``epoch >= min_epoch`` —
+        the worker side of the reshard freeze window — returning EARLY
+        every ``retry_interval`` so the settle loops can retry at the
+        CURRENT epoch: an aborted migration unfreezes its donors
+        without ever publishing the demanded epoch, and the old routing
+        is then fully valid again. Pulls from ``routing_fetch`` when
+        provided (coordinator KV); a pulled table goes through
+        :meth:`apply_routing` (epoch + client-count guarded), growing
+        the client list through the resolver when a scale-out table
+        references replicas this worker has not dialed yet."""
+        t_next_retry = time.monotonic() + retry_interval
+        while self._routing.epoch < min_epoch:
+            if self._routing_fetch is not None:
+                try:
+                    t = self._routing_fetch()
+                    if t is not None and t.epoch > self._routing.epoch:
+                        try:
+                            self.apply_routing(t)
+                            continue
+                        except ValueError:
+                            # the pulled table references replicas we
+                            # have no clients for: re-resolve the fleet
+                            if self._ps_resolver is not None:
+                                clients = list(self._ps_resolver())
+                                if len(clients) >= t.num_replicas:
+                                    self.apply_routing(t,
+                                                       ps_clients=clients)
+                                    continue
+                except Exception:
+                    pass
+            now = time.monotonic()
+            if now > deadline:
+                raise RuntimeError(
+                    f"routing epoch {min_epoch} demanded by a resharding "
+                    f"PS never arrived within the stale-retry budget")
+            if now >= t_next_retry:
+                return  # let the caller retry at the current epoch
+            time.sleep(0.01)
+
+    def _stale_deadline(self) -> float:
+        from persia_tpu import knobs
+
+        return time.monotonic() + float(
+            knobs.get("PERSIA_RESHARD_STALE_RETRY_SEC"))
 
     # --- data-loader side ------------------------------------------------
 
@@ -323,8 +456,10 @@ class EmbeddingWorker:
         if self.monitor is not None:
             for f in feats:
                 self.monitor.observe(f.name, f.distinct_signs)
+        routing = self._routing.table
         with self._t_preprocess.timer(), tracing.span("worker/preprocess"):
-            groups = mw.shard_split(feats, self.schema, self.replica_size)
+            groups = mw.shard_split(feats, self.schema,
+                                    routing.num_replicas, routing=routing)
             mats = mw.alloc_lookup_mats(feats, self.schema)
         # fan-out pool threads have no thread-local trace context — the
         # do_lookup_* closures capture the active worker/rpc span (they
@@ -335,8 +470,11 @@ class EmbeddingWorker:
         def ps_lookup(g):
             with tracing.span("worker/ps_lookup", ctx=tctx, shard=g.shard,
                               dim=g.dim, n=len(g.signs)):
-                return self.ps_clients[g.shard].lookup(g.signs, g.dim,
-                                                       training)
+                try:
+                    return self.ps_clients[g.shard].lookup(g.signs, g.dim,
+                                                           training)
+                except Exception as e:
+                    return self._settle_stale_lookup(g, training, e)
 
         def do_lookup_serialized():
             nonlocal tctx
@@ -372,18 +510,25 @@ class EmbeddingWorker:
 
             def run_shard_mux(gs):
                 client = self.ps_clients[gs[0].shard]
+
+                def settle(g, resolve):
+                    try:
+                        return resolve()
+                    except Exception as e:
+                        return self._settle_stale_lookup(g, training, e)
+
                 with tracing.span("worker/ps_lookup_mux", ctx=tctx,
                                   shard=gs[0].shard, groups=len(gs)):
                     pend = []
                     for g in gs:
                         if len(pend) >= self.MUX_WINDOW:
                             pg, resolve = pend.pop(0)
-                            mw.scatter_group(mats, pg, resolve())
+                            mw.scatter_group(mats, pg, settle(pg, resolve))
                         pend.append(
                             (g, client.lookup_future(g.signs, g.dim,
                                                      training)))
                     for g, resolve in pend:
-                        mw.scatter_group(mats, g, resolve())
+                        mw.scatter_group(mats, g, settle(g, resolve))
 
             tasks = []
             for gs in by_shard.values():
@@ -452,8 +597,9 @@ class EmbeddingWorker:
             self._update_gradients_serialized(feats, fwd_groups, grads,
                                               loss_scale)
             return
+        routing = self._routing.table
         groups = fwd_groups if fwd_groups is not None else mw.shard_split(
-            feats, self.schema, self.replica_size)
+            feats, self.schema, routing.num_replicas, routing=routing)
         # a group is shippable once its LAST feature (feature_idx is
         # nondecreasing) has aggregated
         by_last: Dict[int, list] = {}
@@ -505,7 +651,76 @@ class EmbeddingWorker:
     def _ship_group(self, shard, signs, gmat, dim, tctx=None):
         with tracing.span("worker/ps_update", ctx=tctx, shard=shard,
                           dim=dim, n=len(signs)):
-            self.ps_clients[shard].update_gradients(signs, gmat, dim)
+            try:
+                self.ps_clients[shard].update_gradients(signs, gmat, dim)
+            except Exception as e:
+                self._settle_stale_update(signs, gmat, dim, e)
+
+    # --- reshard cutover settlement --------------------------------------
+
+    def _settle_stale(self, signs, exc, ship_fn):
+        """The one bounce-retry protocol behind every write path: a
+        shipment bounced with routing_stale (its slots froze for
+        migration) re-splits ONLY ITSELF by the current table and
+        re-issues per new owner — applied groups are untouched, so
+        nothing double-counts, and the migration replays every
+        captured row to the target before the new epoch publishes, so
+        a re-routed shipment lands on a replica that already owns the
+        rows. The epoch wait returns periodically (see
+        :meth:`_await_epoch`) so an ABORTED migration — donors
+        unfrozen, demanded epoch never published — settles by plain
+        retry at the current epoch. ``ship_fn(replica, sel)`` issues
+        the per-replica RPC for the selected sign indices; chained
+        bounces (a second reshard mid-retry) loop until the deadline.
+        Re-raises anything that is not a stale bounce."""
+        from persia_tpu.routing import is_routing_stale
+
+        min_epoch = is_routing_stale(exc)
+        if min_epoch is None:
+            raise exc
+        deadline = self._stale_deadline()
+        pending = np.arange(len(signs), dtype=np.int64)
+        while len(pending):
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    "routing_stale bounces did not settle within the "
+                    "stale-retry budget (a replica is refusing writes "
+                    "for slots the current table routes to it)")
+            self._await_epoch(min_epoch, deadline)
+            shards = self._routing.table.replica_of(signs[pending])
+            bounced = []
+            for r in np.unique(shards):
+                sel = pending[np.nonzero(shards == r)[0]]
+                try:
+                    ship_fn(int(r), sel)
+                except Exception as e:
+                    me = is_routing_stale(e)
+                    if me is None:
+                        raise
+                    min_epoch = max(min_epoch, me)
+                    bounced.append(sel)
+            pending = (np.concatenate(bounced) if bounced
+                       else pending[:0])
+            if len(pending):
+                time.sleep(0.005)  # a bounce at the CURRENT epoch
+                # means the freeze window is still closing — back off
+
+    def _settle_stale_lookup(self, group, training: bool, exc):
+        signs, dim = group.signs, group.dim
+        res = np.empty((len(signs), dim), np.float32)
+
+        def ship(r, sel):
+            res[sel] = self.ps_clients[r].lookup(signs[sel], dim,
+                                                 training)
+
+        self._settle_stale(signs, exc, ship)
+        return res
+
+    def _settle_stale_update(self, signs, gmat, dim, exc):
+        self._settle_stale(
+            signs, exc,
+            lambda r, sel: self.ps_clients[r].update_gradients(
+                signs[sel], gmat[sel], dim))
 
     def _update_gradients_serialized(self, feats, fwd_groups, grads,
                                      loss_scale):
@@ -516,9 +731,10 @@ class EmbeddingWorker:
                                        grads[feat.name], loss_scale)
                 for feat in feats
             ]
+            routing = self._routing.table
             shard_groups = mw.shard_gradients(
-                feats, self.schema, per_feature, self.replica_size,
-                groups=fwd_groups,
+                feats, self.schema, per_feature, routing.num_replicas,
+                groups=fwd_groups, routing=routing,
             )
         def do_update():
             # runs inside the worker/ship span — capture it so fan-out
@@ -635,16 +851,21 @@ class EmbeddingWorker:
         (the serving tier runs dedup/hashstack/prefix itself and sends
         only its cache misses here — one deduplicated call instead of a
         full per-request lookup fan-out). Shard-routed by the same
-        farmhash split as every other lookup; absent signs zero-fill
+        slot split as every other lookup; absent signs zero-fill
         (PS eval semantics) and are NEVER created — the serving path is
-        read-only."""
-        from persia_tpu.hashing import sign_to_shard
-
+        read-only. During a reshard's double-read window, signs whose
+        owner just changed are read from BOTH owners: the new owner
+        wins unless it answers all-zero (row not yet visible there)
+        while the previous owner still has it — so an in-flight or
+        out-of-band epoch swap never serves a transient zero for a row
+        the fleet durably holds."""
+        routing = self._routing.table
+        prev = self._routing.prev
         signs = np.ascontiguousarray(signs, dtype=np.uint64)
         out = np.zeros((len(signs), dim), np.float32)
         if len(signs) == 0:
             return out
-        shards = sign_to_shard(signs, self.replica_size)
+        shards = routing.replica_of(signs)
         groups = [np.nonzero(shards == r)[0] for r in np.unique(shards)]
         replicas = [int(shards[sel[0]]) for sel in groups]
 
@@ -666,6 +887,20 @@ class EmbeddingWorker:
             results = self._with_ps_retry(fetch_all)
         for sel, rows in zip(groups, results):
             out[sel] = rows
+        if prev is not None and prev.num_slots == routing.num_slots:
+            # double-read: only the moved signs that read back empty
+            moved = np.nonzero(prev.replica_of(signs) != shards)[0]
+            if len(moved):
+                empty = moved[~out[moved].any(axis=1)]
+                if len(empty):
+                    old_owner = prev.replica_of(signs[empty])
+                    for r in np.unique(old_owner):
+                        sel = empty[np.nonzero(old_owner == r)[0]]
+                        try:
+                            out[sel] = self.ps_clients[int(r)].lookup(
+                                signs[sel], dim, False)
+                        except Exception:
+                            pass  # donor already gone: keep the zeros
         return out
 
     # --- checkpoint fan-out ----------------------------------------------
@@ -684,14 +919,12 @@ class EmbeddingWorker:
         ``default_state``. Returns (vals (n, dim) f32, state (n, dim)
         f32; non-shared Adagrad state width == dim, the only optimizer
         the device cache admits)."""
-        from persia_tpu.hashing import sign_to_shard
-
         signs = np.ascontiguousarray(signs, dtype=np.uint64)
         n = len(signs)
         width = 2 * dim  # value + per-element accumulator
         vals = np.zeros((n, dim), np.float32)
         state = np.full((n, dim), default_state, np.float32)
-        shards = sign_to_shard(signs, self.replica_size)
+        shards = self._routing.table.replica_of(signs)
         groups = [np.nonzero(shards == r)[0] for r in np.unique(shards)]
         replicas = [int(shards[sel[0]]) for sel in groups]
 
@@ -720,35 +953,47 @@ class EmbeddingWorker:
         """Write full rows (value + optimizer state) back, shard-routed,
         one batched RPC per replica — the device cache's eviction
         write-back / flush_all."""
-        from persia_tpu.hashing import sign_to_shard
-
         signs = np.ascontiguousarray(signs, dtype=np.uint64)
         vecs = np.ascontiguousarray(vecs, dtype=np.float32)
-        shards = sign_to_shard(signs, self.replica_size)
+        shards = self._routing.table.replica_of(signs)
         groups = [np.nonzero(shards == r)[0] for r in np.unique(shards)]
         replicas = [int(shards[sel[0]]) for sel in groups]
+
+        def push_one(r, sel):
+            try:
+                self.ps_clients[r].set_entries(signs[sel], dim, vecs[sel])
+            except Exception as e:
+                # a write-back to frozen moving slots re-routes exactly
+                # like a gradient shipment — the device cache's flushed
+                # rows must land somewhere or eviction loses state
+                self._settle_stale_set(signs[sel], vecs[sel], dim, e)
 
         def push_all():
             if self._fanout is None or len(groups) <= 1:
                 for r, sel in zip(replicas, groups):
-                    self.ps_clients[r].set_entries(signs[sel], dim,
-                                                   vecs[sel])
+                    push_one(r, sel)
                 return
-            list(self._fanout.map(
-                lambda rs: self.ps_clients[rs[0]].set_entries(
-                    signs[rs[1]], dim, vecs[rs[1]]),
-                zip(replicas, groups)))
+            list(self._fanout.map(lambda rs: push_one(*rs),
+                                  zip(replicas, groups)))
 
         self._with_ps_retry(push_all)
+
+    def _settle_stale_set(self, signs, vecs, dim, exc):
+        self._settle_stale(
+            signs, exc,
+            lambda r, sel: self.ps_clients[r].set_entries(
+                signs[sel], dim, vecs[sel]))
 
     def dump(self, dirpath: str):
         from persia_tpu.checkpoint import dump_sharded
         from persia_tpu.pipeline import flush_backward_engines
 
         flush_backward_engines(self)
-        dump_sharded(self.ps_clients, dirpath)
+        t = self._routing.table
+        dump_sharded(self.ps_clients[:t.num_replicas], dirpath, routing=t)
 
     def load(self, dirpath: str):
         from persia_tpu.checkpoint import load_sharded
 
-        load_sharded(self.ps_clients, dirpath)
+        t = self._routing.table
+        load_sharded(self.ps_clients[:t.num_replicas], dirpath, routing=t)

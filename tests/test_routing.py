@@ -1,0 +1,316 @@
+"""RoutingTable contract tests: uniform-modulo bit-exactness, epoch
+monotonicity, serialization, atomic swap under concurrent lookups, the
+double-read window, and the byte-identical-wire pin (served-request
+counts) for the ``__routing__`` rider's off state."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from persia_tpu.config import EmbeddingSchema, uniform_slots
+from persia_tpu.data.batch import IDTypeFeature
+from persia_tpu.hashing import sign_to_shard
+from persia_tpu.routing import (
+    STALE_PREFIX,
+    RoutingHolder,
+    RoutingTable,
+    RoutingStaleError,
+    is_routing_stale,
+)
+from persia_tpu.worker import middleware as mw
+from persia_tpu.worker.worker import EmbeddingWorker
+
+
+def _schema(dim=8, n_slots=2):
+    return EmbeddingSchema(slots_config=uniform_slots(
+        [f"slot_{i}" for i in range(n_slots)], dim=dim))
+
+
+def _feature(name, signs):
+    return IDTypeFeature(name, [np.asarray(signs, dtype=np.uint64)])
+
+
+def _holders(n, dim=8):
+    from persia_tpu.ps.store import EmbeddingHolder
+
+    hs = []
+    for _ in range(n):
+        h = EmbeddingHolder(capacity=100_000)
+        h.configure("bounded_uniform", {"lower": -0.1, "upper": 0.1},
+                    admit_probability=1.0, weight_bound=100.0)
+        h.register_optimizer({"type": "sgd", "lr": 1.0, "wd": 0.0})
+        hs.append(h)
+    return hs
+
+
+# --- the routing function ---------------------------------------------------
+
+
+@pytest.mark.parametrize("replicas", [1, 2, 3, 5, 8])
+def test_uniform_table_is_bit_exact_modulo(replicas):
+    t = RoutingTable.uniform(replicas)
+    assert t.is_uniform_modulo
+    signs = np.random.default_rng(0).integers(
+        0, 1 << 63, size=4096, dtype=np.uint64)
+    np.testing.assert_array_equal(t.replica_of(signs),
+                                  sign_to_shard(signs, replicas))
+
+
+def test_non_uniform_detection_and_slots_of_replica():
+    t = RoutingTable.uniform(2, slots_per_replica=4)  # 8 slots
+    assert t.is_uniform_modulo
+    custom = t.derive([0, 0, 0, 0, 0, 1, 1, 1], 2)
+    assert not custom.is_uniform_modulo
+    np.testing.assert_array_equal(custom.slots_of_replica(0),
+                                  [0, 1, 2, 3, 4])
+    # every sign routes to its slot's owner
+    signs = np.arange(1000, dtype=np.uint64)
+    slots = custom.slot_of(signs)
+    np.testing.assert_array_equal(
+        custom.replica_of(signs), custom.replica_of_slot[slots])
+
+
+def test_epoch_monotonicity_and_holder_swap():
+    t1 = RoutingTable.uniform(2, slots_per_replica=4)
+    h = RoutingHolder(t1)
+    t2 = t1.derive(np.zeros(8, np.int32), 1)
+    assert t2.epoch == t1.epoch + 1
+    assert h.apply(t2)
+    assert h.table is t2
+    assert h.prev is t1  # double-read predecessor retained
+    # duplicate and stale publishes are no-ops
+    assert not h.apply(t2)
+    assert not h.apply(t1)
+    assert h.table is t2
+    h.close_window()
+    assert h.prev is None
+
+
+def test_derive_refuses_slot_space_change():
+    t = RoutingTable.uniform(2, slots_per_replica=4)
+    with pytest.raises(ValueError, match="slot space"):
+        t.derive(np.zeros(16, np.int32), 2)
+
+
+def test_moves_to_groups_by_donor_target():
+    t = RoutingTable.uniform(2, slots_per_replica=2)  # 4 slots: 0101
+    t2 = t.derive([0, 1, 2, 2], 3)
+    moves = t.moves_to(t2)
+    assert {(m["donor"], m["target"]) for m in moves} == {(0, 2), (1, 2)}
+    assert sorted(s for m in moves for s in m["slots"]) == [2, 3]
+
+
+def test_serialization_round_trip_and_version_gate():
+    t = RoutingTable.uniform(3, slots_per_replica=5)
+    t2 = t.derive(np.arange(15, dtype=np.int32) % 2, 2,
+                  weights=np.linspace(0, 1, 15))
+    for table in (t, t2):
+        raw = table.to_bytes()
+        back = RoutingTable.from_bytes(raw)
+        assert back == table
+        assert back.to_bytes() == raw  # canonical: byte-stable
+    doc = t.to_doc()
+    doc["v"] = 99
+    with pytest.raises(ValueError, match="version"):
+        RoutingTable.from_doc(doc)
+
+
+def test_stale_error_parsing():
+    assert is_routing_stale(RoutingStaleError(7)) == 7
+    from persia_tpu.rpc import RpcError
+
+    assert is_routing_stale(
+        RpcError(f"ps0: handler failed: {STALE_PREFIX}12 ")) == 12
+    assert is_routing_stale(RpcError("boring failure")) is None
+
+
+# --- middleware integration -------------------------------------------------
+
+
+def test_shard_split_uniform_routing_identical_to_legacy():
+    schema = _schema(n_slots=3)
+    rng = np.random.default_rng(1)
+    feats = mw.preprocess_batch(
+        [_feature(f"slot_{i}",
+                  rng.integers(0, 1 << 40, 257, dtype=np.uint64))
+         for i in range(3)], schema)
+    legacy = mw.shard_split(feats, schema, 4)
+    routed = mw.shard_split(feats, schema, 4,
+                            routing=RoutingTable.uniform(4))
+    assert len(legacy) == len(routed)
+    for a, b in zip(legacy, routed):
+        assert (a.shard, a.dim) == (b.shard, b.dim)
+        np.testing.assert_array_equal(a.signs, b.signs)
+        np.testing.assert_array_equal(a.distinct_idx, b.distinct_idx)
+
+
+def test_shard_split_honors_custom_table():
+    schema = _schema(n_slots=1)
+    feats = mw.preprocess_batch(
+        [_feature("slot_0", np.arange(2048, dtype=np.uint64))], schema)
+    t = RoutingTable.uniform(2, slots_per_replica=4)
+    everything_on_1 = t.derive(np.ones(8, np.int32), 2)
+    groups = mw.shard_split(feats, schema, 2, routing=everything_on_1)
+    assert [g.shard for g in groups] == [1]
+    assert len(groups[0].signs) == feats[0].num_distinct
+
+
+# --- worker integration -----------------------------------------------------
+
+
+def test_worker_uniform_served_request_counts_pinned():
+    """The wire pin: a worker born with an EXPLICIT uniform table must
+    split traffic across replicas exactly like the legacy modulo stack
+    — same per-replica sign counts, request for request — and the
+    ``__routing__`` rider must not be probed when unarmed (the count
+    equality would break if any extra RPC rode along)."""
+
+    class CountingHolder:
+        def __init__(self):
+            self.calls = 0
+            self.signs = 0
+
+        def lookup(self, signs, dim, training):
+            self.calls += 1
+            self.signs += len(signs)
+            return np.zeros((len(signs), dim), np.float32)
+
+    schema = _schema(n_slots=2)
+    rng = np.random.default_rng(2)
+    batches = [
+        [_feature(f"slot_{i}",
+                  rng.integers(0, 1 << 40, 511, dtype=np.uint64))
+         for i in range(2)]
+        for _ in range(3)
+    ]
+    counts = []
+    for routing in (None, RoutingTable.uniform(3)):
+        holders = [CountingHolder() for _ in range(3)]
+        w = EmbeddingWorker(schema, holders, routing=routing)
+        for b in batches:
+            w.lookup_direct(b)
+        w.close()
+        counts.append([(h.calls, h.signs) for h in holders])
+    assert counts[0] == counts[1]
+
+
+def test_worker_atomic_swap_under_concurrent_lookups():
+    """Hammer lookups from several threads while successor tables land
+    mid-traffic: every lookup must complete against a single coherent
+    table (no torn reads, no index errors), before and after swaps."""
+    schema = _schema(n_slots=2)
+    holders = _holders(3)
+    w = EmbeddingWorker(schema, holders)
+    t = w.routing
+    stop = threading.Event()
+    errors = []
+
+    def hammer(seed):
+        rng = np.random.default_rng(seed)
+        while not stop.is_set():
+            feats = [_feature(f"slot_{i}",
+                              rng.integers(0, 1 << 30, 64,
+                                           dtype=np.uint64))
+                     for i in range(2)]
+            try:
+                out = w.lookup_direct(feats, training=True)
+                for i in range(2):
+                    assert out[f"slot_{i}"].embeddings.shape[1] == 8
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=hammer, args=(s,))
+               for s in range(4)]
+    for th in threads:
+        th.start()
+    rng = np.random.default_rng(99)
+    try:
+        for _ in range(6):
+            t = t.derive(rng.integers(0, 3, t.num_slots).astype(np.int32),
+                         3)
+            assert w.apply_routing(t)
+    finally:
+        stop.set()
+        for th in threads:
+            th.join(timeout=10)
+        w.close()
+    assert not errors
+    assert w.routing_epoch == t.epoch
+
+
+def test_double_read_window_serves_moved_rows_from_donor():
+    """After an out-of-band cutover (table swapped with NO migration),
+    eval reads of a moved row fall back to the previous owner until
+    the window closes — in-flight old-epoch readers never see a
+    transient zero for a row the fleet still holds."""
+    schema = _schema()
+    holders = _holders(2)
+    w = EmbeddingWorker(schema, holders)
+    t1 = w.routing
+    sign = 12345
+    slot = int(t1.slot_of(np.array([sign], np.uint64))[0])
+    donor = int(t1.replica_of_slot[slot])
+    row = np.arange(8, dtype=np.float32) + 1.0
+    holders[donor].set_entry(sign, 8, np.concatenate([row, row]))
+    # move ONLY that slot to the other replica, without migrating
+    assignment = t1.replica_of_slot.copy()
+    assignment[slot] = 1 - donor
+    w.apply_routing(t1.derive(assignment, 2))
+    out = w.lookup_signs(np.array([sign], np.uint64), 8)
+    np.testing.assert_array_equal(out[0], row)  # double-read hit
+    w.close_routing_window()
+    out = w.lookup_signs(np.array([sign], np.uint64), 8)
+    np.testing.assert_array_equal(out[0], np.zeros(8))  # window closed
+    w.close()
+
+
+def test_worker_refuses_undersized_client_list():
+    schema = _schema()
+    with pytest.raises(ValueError, match="replicas"):
+        EmbeddingWorker(schema, _holders(2),
+                        routing=RoutingTable.uniform(4))
+
+
+# --- the __routing__ envelope rider ----------------------------------------
+
+
+def test_routing_probe_negotiates_down_against_legacy_server():
+    """A rider-armed client against a server that never registered
+    ``__routing__`` (the legacy fleet) falls back cleanly: probe
+    refused, no rider, calls work."""
+    import msgpack
+
+    from persia_tpu.rpc import RpcClient, RpcServer
+
+    srv = RpcServer("127.0.0.1", 0)
+    srv.register("echo", lambda p: p)
+    srv.serve_background()
+    try:
+        c = RpcClient(srv.addr, enable_routing=True)
+        assert c.call("echo", b"x") == b"x"
+        assert c.routing_active() is False
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_routing_probe_acks_with_epoch_on_ps_service():
+    from persia_tpu.service.ps_service import PsClient, PsService
+
+    holder = _holders(1)[0]
+    svc = PsService(holder, port=0)
+    svc.server.serve_background()
+    try:
+        client = PsClient(svc.addr, routing_wire=True)
+        client.set_routing_epoch(3)
+        assert client.client.routing_active() is True
+        st = client.reshard_status()
+        assert st["routing_epoch"] == 3 and st["active"] is False
+        # an unarmed client never probes (the byte-identical default)
+        legacy = PsClient(svc.addr, routing_wire=False)
+        assert legacy.client.routing_active() is False
+        legacy.lookup(np.array([1, 2], np.uint64), 8, False)
+    finally:
+        svc.stop()

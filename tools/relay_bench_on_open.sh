@@ -86,6 +86,16 @@ while [ "$(date +%s)" -lt "$END" ]; do
       #     BENCH_tier.json lands next to this log
       step "bench tier (embedding ladder)" python bench.py \
         --mode tier --max-seconds 1100
+      # 4h. elastic PS tier (PR 11): live 2→4→3 reshard under traffic
+      #     (zero lost updates via the counting-optimizer identity,
+      #     bounded p99 inflation), the hotness-balanced vs hash-even
+      #     skew A/B, and the uniform-table checkpoint bit-identity —
+      #     host-only, but the migration p99 window on production-class
+      #     cores is the number the runbook quotes (the 2-core dev box
+      #     serializes the copy phase against the trainer threads);
+      #     BENCH_reshard.json lands next to this log
+      step "bench reshard (elastic PS tier)" python bench.py \
+        --mode reshard --max-seconds 900
       # 5. re-capture the headline near the end of the window
       step "re-capture: python bench.py" python bench.py
       echo "$(date -u +%FT%TZ) chip sequence complete — see BENCH_CAPTURE_r05.log" >> "$LOG"
