@@ -1213,6 +1213,585 @@ def bench_chaos(batch_size, steps, n_ps=2, dim=8, kill_replica=1,
     return result["kill_to_recovered_sec"], result
 
 
+# --- chaos-reshard matrix (PR 12): SIGKILL each actor at each state ---------
+
+# every (actor, protocol-state) kill cell the matrix covers. controller
+# cells run the controller as a REAL subprocess that SIGKILLs itself at
+# the state (faults `die` at the reshard.controller site) and then
+# resume from the durable journal; donor/target cells run a supervised
+# PS-subprocess fleet and snipe the replica at the state via the
+# controller's phase hook, then recover through the PR-4 supervisor +
+# inc replay and retry the migration. the extra "lease" cell kills the
+# controller at freeze and measures the donor's self-healing auto-thaw
+# instead of resuming immediately.
+CHAOS_RESHARD_FULL = (
+    [("controller", s) for s in ("copy", "replay", "freeze", "cutover",
+                                 "drain")]
+    + [("donor", s) for s in ("copy", "replay", "freeze", "cutover",
+                              "drain")]
+    + [("target", s) for s in ("copy", "replay", "cutover")]
+    + [("lease", "freeze")]
+)
+CHAOS_RESHARD_SMOKE = [("controller", "freeze"), ("controller", "drain"),
+                       ("donor", "copy"), ("lease", "freeze")]
+
+
+def _chaos_reshard_identity(holders, table):
+    """Owner-filtered counting identity over in-process holders (the
+    donor keeps stale frozen copies through the double-read window by
+    design — only rows AT their owners count)."""
+    applied = 0.0
+    for i, h in enumerate(holders):
+        rows = [(s, -float(vec[:d].sum()) / d)
+                for shard in h._shards
+                for s, (d, vec) in shard._map.items()]
+        if not rows:
+            continue
+        owners = table.replica_of(np.array([s for s, _ in rows],
+                                           np.uint64))
+        applied += sum(v for (_s, v), o in zip(rows, owners) if o == i)
+    return applied
+
+
+def _chaos_reshard_controller_cell(state, bs, lease_cell=False,
+                                   smoke=False):
+    """One controller-kill cell: in-process PS fleet, REAL subprocess
+    controller SIGKILLed (faults die) at ``state``, then either an
+    immediate resume from the journal (controller cells) or — for the
+    lease cell — wait for the donor's freeze lease to auto-thaw first,
+    measuring the self-healing latency, and resume afterwards."""
+    import shutil
+    import subprocess
+    import sys
+    import tempfile
+    import threading
+
+    from persia_tpu.config import EmbeddingSchema, uniform_slots
+    from persia_tpu.data.batch import IDTypeFeature
+    from persia_tpu.ps.store import EmbeddingHolder
+    from persia_tpu.reshard import MigrationJournal, ReshardController
+    from persia_tpu.routing import RoutingTable
+    from persia_tpu.service.ps_service import PsClient, PsService
+    from persia_tpu.worker.worker import EmbeddingWorker
+
+    dim = 8
+    n_feats = 2
+    schema = EmbeddingSchema(slots_config=uniform_slots(
+        [f"slot_{i}" for i in range(n_feats)], dim=dim))
+    holders = [EmbeddingHolder(capacity=2_000_000) for _ in range(3)]
+    services, clients = [], []
+    for h in holders:
+        svc = PsService(h, port=0)
+        svc.server.serve_background()
+        c = PsClient(svc.addr, circuit_breaker=False)
+        c.configure("bounded_uniform", {"lower": 0.0, "upper": 0.0},
+                    admit_probability=1.0, weight_bound=1e9,
+                    enable_weight_bound=False)
+        c.register_optimizer({"type": "sgd", "lr": 1.0, "wd": 0.0})
+        services.append(svc)
+        clients.append(c)
+    table = RoutingTable.uniform(2)
+    worker = EmbeddingWorker(schema, clients[:2], routing=table)
+    tmp = tempfile.mkdtemp(prefix="persia_chaos_reshard_")
+    journal = os.path.join(tmp, "journal")
+    os.makedirs(journal)
+    ships = [0]
+    s_lock = threading.Lock()
+    stop = threading.Event()
+    errors = []
+    rng_space = 1 << 18
+
+    def train(seed):
+        rng = np.random.default_rng(seed)
+        while not stop.is_set():
+            feats = [IDTypeFeature(f"slot_{i}", [
+                rng.integers(0, rng_space, bs, dtype=np.uint64)])
+                for i in range(n_feats)]
+            try:
+                ref, out = worker.lookup_direct_training(feats)
+                worker.update_gradients(
+                    ref, {k: np.ones_like(v.embeddings)
+                          for k, v in out.items()})
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                time.sleep(0.25)
+                continue
+            with s_lock:
+                ships[0] += n_feats * bs
+
+    threads = [threading.Thread(target=train, args=(s,))
+               for s in range(2)]
+    for t in threads:
+        t.start()
+    lease_recovery_sec = None
+    try:
+        time.sleep(0.2 if smoke else 0.5)
+        table_path = os.path.join(tmp, "table.json")
+        with open(table_path, "w") as f:
+            json.dump(table.to_doc(), f)
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PERSIA_RESHARD_STALE_RETRY_SEC="30")
+        if lease_cell:
+            # short enough to measure the auto-thaw promptly, but with
+            # headroom over the longest inter-RPC gap a donor sees
+            # while the controller copies its SIBLING (every reshard
+            # RPC renews the lease; the gap is one donor's whole
+            # copy+replay phase on this box)
+            env["PERSIA_RESHARD_FREEZE_LEASE_SEC"] = "6"
+            os.environ["PERSIA_RESHARD_FREEZE_LEASE_SEC"] = "6"
+        proc = subprocess.run(
+            [sys.executable, "-m", "persia_tpu.reshard",
+             "--journal", journal, "--ps",
+             ",".join(c.addr for c in clients),
+             "--table", table_path, "--to", "3", "--die-at", state],
+            env=env, capture_output=True, timeout=180)
+        if proc.returncode == 0:
+            raise RuntimeError(
+                f"controller driver survived --die-at {state}: "
+                f"{proc.stdout[-500:]!r}")
+        st = MigrationJournal(journal).state()
+        if st is None:
+            raise RuntimeError("controller died before journaling the "
+                               "plan — no crash-safe record")
+        if st["phase"] in MigrationJournal.TERMINAL:
+            raise RuntimeError(
+                f"driver reached terminal phase {st['phase']!r} instead "
+                f"of dying mid-migration at {state!r} (lease too short "
+                f"for the protocol phases?): {proc.stderr[-800:]!r}")
+        if lease_cell:
+            # do NOT resume: the donor must self-heal. poll every
+            # planned donor until the lease thaws its frozen state
+            donors = sorted({int(mv["donor"]) for mv in st["moves"]})
+            t0 = time.monotonic()
+            deadline = t0 + 30
+            while time.monotonic() < deadline:
+                if all(not clients[d].reshard_status()["active"]
+                       for d in donors):
+                    lease_recovery_sec = time.monotonic() - t0
+                    break
+                time.sleep(0.05)
+            else:
+                raise RuntimeError(
+                    "frozen donors never auto-thawed within 30s of "
+                    "the controller kill (lease broken)")
+            # traffic must flow again under the OLD epoch
+            base = ships[0]
+            t_flow = time.monotonic() + 10
+            while ships[0] <= base and time.monotonic() < t_flow:
+                time.sleep(0.05)
+            if ships[0] <= base:
+                raise RuntimeError("writers did not recover after the "
+                                   "donor auto-thaw")
+        ctrl, action = ReshardController.resume(journal, clients,
+                                                workers=[worker])
+        ctrl.finalize(drain_sec=0.2)
+        new_table = ctrl.table
+        time.sleep(0.2 if smoke else 0.4)
+    finally:
+        if lease_cell:
+            os.environ.pop("PERSIA_RESHARD_FREEZE_LEASE_SEC", None)
+        stop.set()
+        for t in threads:
+            t.join(timeout=120)
+    if errors:
+        raise RuntimeError(
+            f"[controller:{state}] trainer errors across the kill + "
+            f"resume: {errors[0]!r} (+{len(errors) - 1} more)")
+    if new_table.epoch != table.epoch + 1 or new_table.num_replicas != 3:
+        raise RuntimeError(f"resume landed on the wrong table: "
+                           f"{new_table!r}")
+    if worker.routing_epoch != new_table.epoch:
+        raise RuntimeError("worker never reached the resumed epoch")
+    for i, c in enumerate(clients):
+        stat = c.reshard_status()
+        if stat["active"]:
+            raise RuntimeError(f"replica {i} left with armed reshard "
+                               f"state after finalize")
+    jstate = MigrationJournal(journal).state()
+    if jstate["phase"] != "finalized":
+        raise RuntimeError(f"journal not finalized: {jstate['phase']}")
+    applied = _chaos_reshard_identity(holders, new_table)
+    lost = ships[0] - applied
+    n_journal_records = len(MigrationJournal(journal).records())
+    worker.close()
+    for s in services:
+        s.stop()
+    shutil.rmtree(tmp, ignore_errors=True)
+    if abs(lost) > 1e-3:
+        raise RuntimeError(
+            f"[controller:{state}] counting identity broken: "
+            f"ships={ships[0]} applied={applied:.1f}")
+    cell = {"actor": "lease" if lease_cell else "controller",
+            "state": state, "action": action,
+            "ships": int(ships[0]), "applied": round(applied, 1),
+            "lost_updates": round(lost, 3),
+            "final_epoch": new_table.epoch,
+            "journal_records": n_journal_records}
+    if lease_recovery_sec is not None:
+        cell["lease_recovery_sec"] = round(lease_recovery_sec, 3)
+    return cell
+
+
+def _chaos_reshard_ps_cell(actor, state, bs, smoke=False):
+    """One donor/target-kill cell: supervised PS-subprocess fleet
+    (checkpoint + flush-per-commit inc packets, so every ACKED update
+    is durable before the kill), in-process controller whose phase
+    hook SIGKILLs the victim replica at the protocol state. The
+    supervisor restarts + restores the victim, the migration aborts to
+    a consistent epoch (or completes, for post-role kills) and a fresh
+    controller retries to completion. Counting identity is gated with
+    an explicit ambiguity budget: updates IN FLIGHT at the kill are
+    at-least-once across a server restart (the dedup cache dies with
+    the process), so applied may exceed acked by at most their
+    elements — never fall below (that would be a lost update)."""
+    import tempfile
+    import threading
+
+    import yaml
+
+    from persia_tpu import tracing as _tracing
+    from persia_tpu.checkpoint import dump_sharded
+    from persia_tpu.config import EmbeddingSchema, uniform_slots
+    from persia_tpu.data.batch import IDTypeFeature
+    from persia_tpu.reshard import ReshardController
+    from persia_tpu.routing import RoutingTable
+    from persia_tpu.service.coordinator import ROLE_PS, CoordinatorClient
+    from persia_tpu.service.helper import ServiceCtx
+    from persia_tpu.service.ps_service import PsClient
+    from persia_tpu.worker.worker import EmbeddingWorker
+
+    dim = 8
+    n_feats = 2
+    schema = EmbeddingSchema(slots_config=uniform_slots(
+        [f"slot_{i}" for i in range(n_feats)], dim=dim))
+    tmp = tempfile.mkdtemp(prefix="persia_chaos_reshard_ps_")
+    ckpt_dir = os.path.join(tmp, "ckpt")
+    inc_dir = os.path.join(tmp, "inc")
+    pm_dir = os.path.join(tmp, "postmortems")
+    journal = os.path.join(tmp, "journal")
+    gc_path = os.path.join(tmp, "global.yml")
+    with open(gc_path, "w") as f:
+        # flush-per-commit incremental packets: an ACKED update is on
+        # disk before the handler returns, so a SIGKILL loses only
+        # unacked work — the precondition for the exact identity gate
+        yaml.safe_dump({"parameter_server": {
+            "capacity": 1_000_000, "num_hashmap_internal_shards": 4,
+            "enable_incremental_update": True,
+            "incremental_buffer_size": 1,
+            "incremental_dir": inc_dir}}, f)
+    pool = np.unique(np.random.default_rng(7).integers(
+        0, 1 << 40, 8192, dtype=np.uint64))
+    _tracing.enable_tracing(True)
+    try:
+        with ServiceCtx(schema, n_workers=0, n_ps=3,
+                        global_config_path=gc_path, supervise_ps=True,
+                        ps_restore_dir=ckpt_dir, ps_inc_dir=inc_dir,
+                        ps_probe_interval=0.25,
+                        postmortem_dir=pm_dir, flight_interval=0.4,
+                        env={"PERSIA_TRACING": "1"}) as svc:
+            coord = CoordinatorClient(svc.coordinator_addr)
+            clients = [PsClient(a) for a in svc.ps_addrs]
+            ARM = (("bounded_uniform", {"lower": 0.0, "upper": 0.0},
+                    1.0, 1e9, False),
+                   {"type": "sgd", "lr": 1.0, "wd": 0.0})
+            for c in clients:
+                c.configure(*ARM[0])
+                c.register_optimizer(ARM[1])
+            # traced warmup against EVERY replica (the future target
+            # included): its flight ring must hold a real
+            # rpc/lookup -> ps/lookup chain for the postmortem-bundle
+            # gate even when the kill lands before it serves worker
+            # traffic
+            with _tracing.span("chaos_reshard/warmup"):
+                for c in clients:
+                    c.lookup(np.arange(16, dtype=np.uint64), dim, False)
+            table = RoutingTable.uniform(2)
+
+            def resolver():
+                addrs = coord.wait_members(ROLE_PS, 3, 60)
+                fresh = [PsClient(a) for a in addrs]
+                for c in fresh:
+                    try:
+                        if not c.ready_for_serving():
+                            c.configure(*ARM[0])
+                            c.register_optimizer(ARM[1])
+                    except Exception:
+                        pass
+                return fresh
+
+            worker = EmbeddingWorker(
+                schema, clients[:2], routing=table,
+                ps_resolver=lambda: resolver()[:worker.replica_size])
+            worker._last_configure = ARM[0]
+            worker._last_optimizer = ARM[1]
+
+            rng_w = np.random.default_rng(3)
+            draws0 = [rng_w.choice(pool, size=bs)
+                      for _ in range(n_feats)]
+            feats0 = [IDTypeFeature(f"slot_{i}", [d])
+                      for i, d in enumerate(draws0)]
+            ref, out = worker.lookup_direct_training(feats0)
+            worker.update_gradients(ref, {
+                k: np.ones_like(v.embeddings) for k, v in out.items()})
+            dump_sharded(clients[:2], ckpt_dir, routing=table)
+
+            acked = [n_feats * bs]
+            windows = []   # (t0, t1, elems) per acked cycle
+            failures = []  # (t0, t1, elems) per failed cycle
+            a_lock = threading.Lock()
+            stop = threading.Event()
+            # per-sign expected counts (pool-indexed): the elementwise
+            # ledger behind the identity gate, and — on a miss — the
+            # forensic pointer to WHICH slot/owner dropped updates
+            expected = np.zeros(len(pool), np.int64)
+            np.add.at(expected,
+                      np.searchsorted(pool, np.concatenate(draws0)), 1)
+
+            def train(seed):
+                rng = np.random.default_rng(seed)
+                while not stop.is_set():
+                    draws = [rng.choice(pool, size=bs)
+                             for _ in range(n_feats)]
+                    feats = [IDTypeFeature(f"slot_{i}", [d])
+                             for i, d in enumerate(draws)]
+                    t0 = time.monotonic()
+                    try:
+                        r, o = worker.lookup_direct_training(feats)
+                        worker.update_gradients(r, {
+                            k: np.ones_like(v.embeddings)
+                            for k, v in o.items()})
+                    except Exception:  # noqa: BLE001
+                        with a_lock:
+                            failures.append((t0, time.monotonic(),
+                                             n_feats * bs))
+                        time.sleep(0.25)
+                        continue
+                    idx = np.searchsorted(pool, np.concatenate(draws))
+                    with a_lock:
+                        acked[0] += n_feats * bs
+                        windows.append((t0, time.monotonic(),
+                                        n_feats * bs))
+                        np.add.at(expected, idx, 1)
+
+            threads = [threading.Thread(target=train, args=(s,))
+                       for s in range(2)]
+            for t in threads:
+                t.start()
+            killed = [False]
+            t_kill = [None]
+            victim = [None]
+
+            def phase_hook(st, **kw):
+                if st != state or killed[0]:
+                    return
+                idx = (int(kw.get("donor", 0)) if actor == "donor"
+                       else 2)
+                p = svc.ps_proc(idx)
+                log(f"chaos-reshard [{actor}:{state}]: SIGKILL ps-{idx} "
+                    f"(pid {p.pid})")
+                t_kill[0] = time.monotonic()
+                victim[0] = idx
+                p.kill()
+                killed[0] = True
+
+            completed_first_try = False
+            first_error = None
+            new_table = None
+            try:
+                # at least two flight-recorder polls (0.4s cadence) must
+                # land after the traced warmup, or an early kill leaves
+                # a bundle snapshotted before any span existed
+                time.sleep(0.9)
+                ctrl = ReshardController(
+                    clients, table, workers=[worker],
+                    journal_dir=journal, drain_sec=0.25,
+                    replay_settle_rows=64, phase_hook=phase_hook)
+                try:
+                    new_table = ctrl.reshard_to(3)
+                    completed_first_try = True
+                    ctrl.finalize(drain_sec=0.3)
+                except Exception as e:  # noqa: BLE001
+                    first_error = e
+                if not killed[0]:
+                    raise RuntimeError(
+                        f"[{actor}:{state}] the kill never fired — the "
+                        f"phase hook did not reach state {state!r}")
+                events = svc.wait_ps_recoveries(1, timeout=90)
+                ev = events[0]
+                if "failed" in ev:
+                    raise RuntimeError(f"PS recovery failed: {ev}")
+                bundle = ev.get("postmortem")
+                if not bundle or not os.path.isdir(bundle):
+                    raise RuntimeError(
+                        f"[{actor}:{state}] no postmortem bundle for "
+                        f"killed ps-{victim[0]} (event: {ev})")
+                pm = _validate_postmortem(bundle)
+                if not completed_first_try:
+                    # migration aborted: the fleet must sit on a
+                    # consistent OLD epoch before the retry
+                    if worker.routing_epoch != table.epoch:
+                        raise RuntimeError(
+                            f"[{actor}:{state}] abort left the worker "
+                            f"on epoch {worker.routing_epoch}")
+                    fresh = resolver()
+                    deadline = time.monotonic() + 60
+                    while time.monotonic() < deadline:
+                        try:
+                            if all(c.ready_for_serving()
+                                   for c in fresh):
+                                break
+                        except Exception:
+                            pass
+                        time.sleep(0.25)
+                        fresh = resolver()
+                    ctrl = ReshardController(
+                        fresh, table, workers=[worker],
+                        journal_dir=journal, drain_sec=0.25,
+                        replay_settle_rows=64)
+                    new_table = ctrl.reshard_to(3)
+                    ctrl.finalize(drain_sec=0.3)
+                time.sleep(0.2 if smoke else 0.5)
+            finally:
+                stop.set()
+                for t in threads:
+                    t.join(timeout=120)
+            # ambiguity budget: updates in flight at the kill are
+            # at-least-once across the restart (dedup cache died with
+            # the process); failed cycles may have partially applied
+            ambiguous = sum(
+                e for (a, b, e) in windows
+                if t_kill[0] is not None and a <= t_kill[0] <= b)
+            ambiguous += sum(e for (_a, _b, e) in failures)
+            if len(failures) > 24:
+                raise RuntimeError(
+                    f"[{actor}:{state}] {len(failures)} trainer cycles "
+                    f"failed — recovery is not transparent")
+            rows = worker.lookup_signs(pool, dim)
+            applied = -float(rows.sum()) / dim
+            lost = acked[0] - applied
+            if lost > 1e-3:
+                # diagnostic split: read EVERY replica's copy of the
+                # pool (stale donor copies included) — a fleet-wide
+                # total >= acked means rows sit at the wrong owner
+                # (placement bug); < acked means durability loss
+                got = -rows.sum(axis=1) / dim
+                short = np.nonzero(expected - got > 0.5)[0]
+                owners = new_table.replica_of(pool)
+                old_owners = table.replica_of(pool)
+                slots = new_table.slot_of(pool)
+                per_rep_counts = []
+                for c in resolver():
+                    _f, vecs = c.get_entries(pool[short], dim)
+                    per_rep_counts.append(-vecs.sum(axis=1) / dim)
+                forensic = [
+                    {"sign": int(pool[i]), "slot": int(slots[i]),
+                     "old_owner": int(old_owners[i]),
+                     "new_owner": int(owners[i]),
+                     "expected": int(expected[i]),
+                     "got": round(float(got[i]), 1),
+                     "per_replica": [round(float(pr[j]), 1)
+                                     for pr in per_rep_counts]}
+                    for j, i in enumerate(short[:8])]
+                raise RuntimeError(
+                    f"[{actor}:{state}] LOST UPDATES: acked={acked[0]} "
+                    f"applied={applied:.1f} (delta {lost:.1f}); "
+                    f"{len(short)} short signs, first: {forensic}")
+            if -lost > ambiguous + 1e-3:
+                raise RuntimeError(
+                    f"[{actor}:{state}] over-applied beyond the "
+                    f"in-flight ambiguity budget: acked={acked[0]} "
+                    f"applied={applied:.1f} ambiguous={ambiguous}")
+            if worker.routing_epoch != new_table.epoch:
+                raise RuntimeError(f"[{actor}:{state}] worker epoch "
+                                   f"{worker.routing_epoch} != "
+                                   f"{new_table.epoch}")
+            for i, c in enumerate(resolver()):
+                stat = c.reshard_status()
+                if stat["active"]:
+                    raise RuntimeError(
+                        f"[{actor}:{state}] replica {i} left frozen/"
+                        f"armed after the dance")
+                if (stat["routing_epoch"] or 0) > new_table.epoch:
+                    raise RuntimeError(
+                        f"[{actor}:{state}] replica {i} beyond the "
+                        f"final epoch")
+            worker.close()
+            return {
+                "actor": actor, "state": state,
+                "completed_first_try": completed_first_try,
+                "aborted_then_retried": not completed_first_try,
+                "abort_error": (type(first_error).__name__
+                                if first_error else None),
+                "killed_replica": victim[0],
+                "detection_sec": round(
+                    ev["t_detected"] - t_kill[0], 3),
+                "recovery_sec": round(ev["recovery_sec"], 3),
+                "acked": int(acked[0]),
+                "applied": round(applied, 1),
+                "ambiguous_elems": int(ambiguous),
+                "failed_cycles": len(failures),
+                "final_epoch": new_table.epoch,
+                "postmortem_spans": pm["spans"],
+            }
+    finally:
+        _tracing.enable_tracing(False)
+
+
+def bench_chaos_reshard(batch_size, steps, smoke=False, cells=None):
+    """The reshard actor×state chaos matrix: SIGKILL each protocol
+    actor (controller / donor PS / target PS) at each protocol state
+    (copy, replay, freeze, cutover, drain) and hard-gate, per cell:
+
+    - the migration either completes or aborts to a consistent epoch,
+      and a follow-up controller (resume-from-journal for controller
+      kills, plain retry after supervisor recovery for PS kills)
+      drives it to completion;
+    - the counting-optimizer identity shows ZERO lost updates (PS-kill
+      cells additionally bound over-application by the in-flight-at-
+      kill ambiguity — at-least-once across a server restart);
+    - a killed PS leaves a valid flight-recorder bundle
+      (_validate_postmortem); a killed controller leaves a resumable
+      journal;
+    - the dedicated lease cell measures the donor's self-healing
+      auto-thaw latency under a dead controller.
+    """
+    bs = min(batch_size, 128) if smoke else min(batch_size, 256)
+    plan = cells if cells else (CHAOS_RESHARD_SMOKE if smoke
+                                else CHAOS_RESHARD_FULL)
+    results = []
+    t_start = time.perf_counter()
+    for actor, state in plan:
+        log(f"chaos-reshard: cell {actor}:{state} "
+            f"({len(results) + 1}/{len(plan)})")
+        t0 = time.perf_counter()
+        if actor in ("controller", "lease"):
+            cell = _chaos_reshard_controller_cell(
+                state, bs, lease_cell=(actor == "lease"), smoke=smoke)
+        elif actor in ("donor", "target"):
+            cell = _chaos_reshard_ps_cell(actor, state, bs, smoke=smoke)
+        else:
+            raise ValueError(f"unknown chaos-reshard actor {actor!r}")
+        cell["cell_sec"] = round(time.perf_counter() - t0, 1)
+        results.append(cell)
+        log(f"chaos-reshard: cell {actor}:{state} GREEN in "
+            f"{cell['cell_sec']}s "
+            f"({cell.get('action') or ('completed' if cell.get('completed_first_try') else 'aborted+retried')})")
+    lease = [c for c in results if c["actor"] == "lease"]
+    detail = {
+        "cells": results,
+        "cells_green": len(results),
+        "cells_total": len(plan),
+        "lease_recovery_sec": (lease[0]["lease_recovery_sec"]
+                               if lease else None),
+        "total_sec": round(time.perf_counter() - t_start, 1),
+    }
+    log(f"chaos-reshard: {len(results)}/{len(plan)} cells green in "
+        f"{detail['total_sec']}s"
+        + (f", lease recovery {detail['lease_recovery_sec']}s"
+           if detail["lease_recovery_sec"] is not None else ""))
+    return len(results), detail
+
+
 def bench_reshard(batch_size, steps, smoke=False):
     """Elastic PS tier bench: the whole resharding arc, hard-gated.
 
@@ -3687,6 +4266,21 @@ def main():
                         "per-backend rows (like BENCH_tier.json)")
     p.add_argument("--trace-out", default="/tmp/persia_trace_capture.json",
                    help="trace mode: exported Chrome-trace JSON path")
+    p.add_argument("--chaos-reshard-out",
+                   default=os.path.join(
+                       os.path.dirname(os.path.abspath(__file__)),
+                       "BENCH_chaos_reshard.json"),
+                   help="chaos mode: per-cell reshard kill-matrix "
+                        "summary path")
+    p.add_argument("--chaos-cells", default=None,
+                   help="chaos mode: restrict the reshard kill matrix "
+                        "to these actor:state cells (comma-joined, "
+                        "e.g. 'controller:freeze,donor:copy'); default "
+                        "is the full matrix (smoke: a 4-cell subset)")
+    p.add_argument("--chaos-reshard-only", action="store_true",
+                   help="chaos mode: skip the PR-4 kill/recovery bench "
+                        "and run only the reshard kill matrix (the CI "
+                        "smoke lane)")
     p.add_argument("--clients", type=int, default=8,
                    help="infer mode: concurrent closed-loop clients")
     p.add_argument("--entries", type=int, default=10_000_000,
@@ -3840,14 +4434,44 @@ def main():
             f.write("\n")
         log(f"mem: summary written to {args.mem_out}")
     elif args.mode == "chaos":
-        value, detail = bench_chaos(
-            min(args.batch_size, 256) if args.smoke else args.batch_size,
-            max(args.steps, 5))
+        if args.chaos_reshard_only:
+            value, detail = 0.0, {}
+        else:
+            value, detail = bench_chaos(
+                min(args.batch_size, 256) if args.smoke
+                else args.batch_size,
+                max(args.steps, 5))
         # no external baseline for recovery time; the hard gates (zero
         # leaked permits, parity-exact restore) are enforced inside —
         # reaching here means they held
         vs_baseline = 1.0
         extra["detail"] = detail
+        # reshard actor×state kill matrix (PR 12): each cell hard-gates
+        # inside; the machine-readable per-cell results land next to
+        # the other BENCH_*.json captures
+        cells = None
+        if args.chaos_cells:
+            cells = [tuple(c.split(":", 1))
+                     for c in args.chaos_cells.split(",") if c]
+        _green, reshard_detail = bench_chaos_reshard(
+            min(args.batch_size, 256) if args.smoke else args.batch_size,
+            max(args.steps, 5), smoke=args.smoke, cells=cells)
+        extra["chaos_reshard"] = reshard_detail
+        summary = {
+            "mode": "chaos_reshard",
+            "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                         time.gmtime()),
+            "metric": "chaos_reshard_cells_green",
+            "value": reshard_detail["cells_green"],
+            "unit": "cells",
+            "detail": reshard_detail,
+        }
+        with open(args.chaos_reshard_out, "w") as f:
+            json.dump(summary, f, indent=1, sort_keys=True)
+            f.write("\n")
+        log(f"chaos: reshard matrix written to {args.chaos_reshard_out}")
+        if args.chaos_reshard_only:
+            value = float(reshard_detail["cells_green"])
     elif args.mode == "telemetry":
         value, inflation_pct, detail = bench_telemetry(
             min(args.batch_size, 512) if args.smoke else args.batch_size,

@@ -37,10 +37,28 @@ Zero-overhead disabled path: call sites guard on the module global
 pays a single predictable branch per site — the wire and the timing are
 identical to a build without the harness.
 
+Sites, by tier:
+
+- ``rpc.client.send`` / ``rpc.server.recv`` — the transport plane
+  (kwargs: addr/method);
+- ``ps.lookup`` / ``ps.update`` — the PS data plane (kwargs: n, dim);
+- ``ps.reshard.{begin,extract,install,drain,freeze,finish}`` — the live
+  migration protocol's server side (kwargs vary per site; ``drain``
+  carries ``frozen=`` so a rule can distinguish the replay rounds from
+  the definitive cutover drain) — a PERSIA_FAULTS spec can kill or slow
+  a donor/target at an exact protocol step;
+- ``reshard.controller`` — fired by the ReshardController at each
+  protocol transition (kwargs: ``state=`` copy/replay/freeze/cutover/
+  drain plus donor= where applicable); a ``die`` rule here is the chaos
+  matrix's controller SIGKILL;
+- ``obs.http`` — the observability sidecar (scrape-resilience tests).
+
 Example::
 
     faults.add("rpc.server.recv", "reset", after=2, method="lookup")
     faults.add("ps.lookup", "delay", arg=0.05, prob=0.5)
+    faults.add("ps.reshard.extract", "die")          # kill donor in copy
+    faults.add("reshard.controller", "die", state="freeze")
 """
 
 import os

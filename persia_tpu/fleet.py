@@ -627,11 +627,24 @@ class FleetMonitor:
                 # forever-"migrating" ghost would block the runbook's
                 # no-concurrent-reshard precondition
                 migrating.append(t.service)
+        # donors whose moving slots are write-frozen, with the age the
+        # reshard_frozen_slot_stuck rule alarms on — the operator's
+        # shortlist when deciding between resume() and abort (the
+        # DEPLOY.md wedged-migration runbook keys on this field)
+        frozen_donors = [
+            {"service": d["service"],
+             "frozen_age_sec": d["reshard"].get("frozen_age_sec"),
+             "pending_epoch": d["reshard"].get("pending_epoch"),
+             "mig_id": d["reshard"].get("mig_id")}
+            for d in targets
+            if d["up"] and d["reshard"] and d["reshard"].get("frozen")
+        ]
         return {
             "epoch_min": min(epochs) if epochs else None,
             "epoch_max": max(epochs) if epochs else None,
             "epoch_skew": bool(epochs) and min(epochs) != max(epochs),
             "migrating": migrating,
+            "frozen_donors": frozen_donors,
             "targets": targets,
         }
 

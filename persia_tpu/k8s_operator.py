@@ -26,6 +26,7 @@ CLI: ``python -m persia_tpu.k8s_operator job1.yml job2.yml
 
 import argparse
 import json
+import os
 import subprocess
 import threading
 import time
@@ -144,16 +145,32 @@ class Operator:
     """The reconcile loop (reference operator.rs:25-123)."""
 
     def __init__(self, api, job_specs: Optional[List[dict]] = None,
-                 interval: float = 10.0, reshard_driver=None):
+                 interval: float = 10.0, reshard_driver=None,
+                 reshard_journal_dir: Optional[str] = None):
         self.api = api
         self.interval = interval
         # elastic-tier hook: ``reshard_driver(job_name, old, new,
         # phase, spec)`` runs the live slot migration around PS pod
         # reconciliation (phase "scale_out": pods already created,
         # migrate onto them; phase "scale_in": migrate OFF the dying
-        # replicas BEFORE their pods are removed). Without a driver,
-        # scale intents are recorded for an external controller.
+        # replicas BEFORE their pods are removed; phase "resume": a
+        # restarted operator found the job's migration journal showing
+        # an in-flight migration — the driver must
+        # ReshardController.resume() it before any new scale runs).
+        # Without a driver, scale intents are recorded for an external
+        # controller.
         self._reshard_driver = reshard_driver
+        # per-job durable migration journals live under
+        # <reshard_journal_dir>/<job_name> (the driver passes the same
+        # path to its ReshardController); on operator start the first
+        # reconcile pass scans them and resumes/flags any migration a
+        # previous operator incarnation left in flight
+        self._reshard_journal_dir = reshard_journal_dir
+        # (job, mig_id, attempt) triples already resumed/surfaced — the
+        # scan runs every reconcile pass (a job tracked AFTER startup
+        # still gets its wedged migration found), but each in-flight
+        # attempt is handled once
+        self._resumed_migs: set = set()
         self._reshard_events: List[dict] = []
         self._jobs: Dict[str, dict] = {}
         # serializes reconcile passes against track/untrack (the REST
@@ -321,10 +338,89 @@ class Operator:
                      replicas, event["status"])
         return event
 
+    def resume_pending_reshards(self) -> List[dict]:
+        """Operator-crash recovery: scan each tracked job's migration
+        journal (``<reshard_journal_dir>/<job>``) for a migration a
+        previous operator incarnation left in flight. With a driver,
+        hand it the job under phase ``"resume"`` (it runs
+        ``ReshardController.resume()`` against the live fleet — roll
+        forward post-publish, fence-and-retry pre-publish); without
+        one, record a ``resume_pending`` event so the runbook operator
+        sees the wedged migration instead of a silently frozen donor.
+        Returns the events recorded (one per in-flight journal)."""
+        if self._reshard_journal_dir is None:
+            return []
+        import time as _time
+
+        from persia_tpu.reshard import MigrationJournal
+
+        events = []
+        for job in self.job_names():
+            root = os.path.join(self._reshard_journal_dir, job)
+            if not os.path.isdir(root):
+                continue
+            try:
+                st = MigrationJournal(root).state()
+            except Exception as e:
+                _logger.error("unreadable reshard journal %s: %s",
+                              root, e)
+                continue
+            if st is None or st["phase"] in MigrationJournal.TERMINAL:
+                continue
+            key = (job, st["mig_id"], st["attempt"])
+            with self._lock:
+                if key in self._resumed_migs:
+                    continue
+                spec = self._jobs.get(job)
+            old = self._ps_replicas_of(spec) if spec else None
+            new = int(st["new_table"]["num_replicas"])
+            event = {"job": job, "from": old, "to": new,
+                     "mig_id": st["mig_id"], "phase": st["phase"],
+                     "time": _time.strftime("%Y-%m-%dT%H:%M:%S"),
+                     "status": "resume_pending"}
+            if self._reshard_driver is not None and spec is not None:
+                try:
+                    self._reshard_driver(job, old, new, "resume", spec)
+                    event["status"] = "resumed"
+                except Exception as e:
+                    # a failed resume must RETRY next pass, not be
+                    # silently marked handled (the PS fleet is often
+                    # briefly unreachable right after an operator
+                    # restart — exactly when this scan runs); other
+                    # jobs' scans proceed regardless
+                    _logger.error("reshard resume driver for %s "
+                                  "failed (will retry): %s", job, e)
+                    event["status"] = "resume_failed"
+                    event["error"] = str(e)
+                    with self._lock:
+                        self._reshard_events.append(event)
+                    events.append(event)
+                    continue
+            # handled (resumed, or surfaced as pending for a
+            # driverless operator) — don't re-fire for this attempt
+            with self._lock:
+                self._resumed_migs.add(key)
+            _logger.warning(
+                "reshard journal for %s shows migration %s in flight "
+                "(phase %s) -> %s", job, st["mig_id"], st["phase"],
+                event["status"])
+            with self._lock:
+                self._reshard_events.append(event)
+            events.append(event)
+        return events
+
     def reconcile_all(self, specs: Optional[List[dict]] = None):
         """One pass over every tracked job. ``specs`` overrides the
         snapshot (tests use it to inject a stale one and prove the
-        deleted-while-iterating guard below)."""
+        deleted-while-iterating guard below). Every pass also scans
+        the tracked jobs' migration journals (each in-flight attempt
+        handled once) — a reshard a previous operator incarnation died
+        driving is resumed (or surfaced) before any pod churn can race
+        it, including for jobs tracked after startup."""
+        try:
+            self.resume_pending_reshards()
+        except Exception as e:
+            _logger.error("reshard resume scan failed: %s", e)
         if specs is None:
             with self._lock:
                 specs = list(self._jobs.values())

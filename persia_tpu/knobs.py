@@ -193,6 +193,32 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
        "chunks bound the per-RPC copy stall a migrating replica "
        "imposes on live traffic; larger chunks finish the copy phase "
        "sooner."),
+    _k("PERSIA_RESHARD_FREEZE_LEASE_SEC", "float", 30.0,
+       "Donor self-healing lease on reshard state: every controller "
+       "RPC (begin/extract/drain/freeze/status) renews it; when it "
+       "expires — the controller died or was partitioned away — the "
+       "donor auto-thaws, discarding capture state and unfreezing the "
+       "moving slots, so bounced writers recover under the OLD epoch "
+       "instead of facing a frozen-forever shard. Keep it well above "
+       "the longest expected extract/install gap; a resumed controller "
+       "fences out the dead attempt either way. 0 disables the lease "
+       "(frozen state persists until reshard_finish)."),
+    _k("PERSIA_RESHARD_JOURNAL_DIR", "str", None,
+       "Arm the reshard controller's durable migration journal: "
+       "append-only, atomically-written protocol records (plan, "
+       "per-donor copy/freeze/drain, publish bracket, finalize/abort) "
+       "land under this directory (storage.PersiaPath — local or "
+       "hdfs://), so a controller killed mid-migration can resume() "
+       "or abort the same migration after restart. Unset = in-memory "
+       "only (a controller crash relies on the freeze lease for donor "
+       "recovery)."),
+    _k("PERSIA_RESHARD_RPC_TIMEOUT_SEC", "float", 120.0,
+       "Per-RPC deadline the reshard controller stamps on every "
+       "reshard_* call (negotiated __deadline__ envelope slot, armed "
+       "on its clients at migration start): a wedged donor sheds the "
+       "expired extract/install instead of hanging the migration "
+       "unboundedly. Idle fleets never negotiate it — the "
+       "no-migration wire stays byte-identical. 0 disables."),
     _k("PERSIA_RESHARD_DRAIN_SEC", "float", 5.0,
        "Double-read window after a reshard cutover: donors keep the "
        "moved rows readable (for in-flight lookups routed by the "

@@ -307,7 +307,21 @@ class RoutingHolder:
 
 def publish_to_coordinator(coordinator_client, table: RoutingTable):
     """Publish a table through the coordinator KV (the control-plane
-    distribution path for multi-process fleets)."""
+    distribution path for multi-process fleets). Epoch-guarded: a
+    stale publisher (a resumed controller whose journal a newer
+    migration already superseded) must never roll the fleet's
+    bootstrap table back — pull-side consumers would route writes to
+    non-owners."""
+    raw = coordinator_client.kv_get(COORDINATOR_KEY)
+    if raw:
+        current = RoutingTable.from_bytes(raw)
+        if current.epoch >= table.epoch:
+            if current.epoch > table.epoch:
+                _logger.warning(
+                    "refusing to publish routing epoch %d over the "
+                    "coordinator's newer epoch %d", table.epoch,
+                    current.epoch)
+            return
     coordinator_client.kv_put(COORDINATOR_KEY, table.to_bytes())
 
 

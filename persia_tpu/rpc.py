@@ -1345,6 +1345,17 @@ class RpcClient:
             cs = self._dial()
         return cs
 
+    def renegotiate(self):
+        """Drop the calling thread's pooled connection so its next call
+        re-dials and re-runs envelope-extension negotiation. Used when
+        an extension flag flips after the first dial — e.g. the reshard
+        controller arming ``enable_deadline`` on an already-connected
+        client; other threads' connections are untouched (their wire
+        stays exactly as negotiated)."""
+        cs = getattr(self._local, "cs", None)
+        if cs is not None:
+            self._drop_conn(cs)
+
     def codec_active(self) -> bool:
         """True when this thread's connection negotiated the payload
         codec (dialing if needed); False against legacy peers, on

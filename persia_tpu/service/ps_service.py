@@ -104,20 +104,48 @@ class _ReshardState:
     second); the hot-path cost while NO migration runs is a single
     ``self._reshard is None`` test per handler."""
 
-    def __init__(self, slots, num_slots: int, epoch: int):
+    def __init__(self, slots, num_slots: int, epoch: int,
+                 mig_id: Optional[str] = None,
+                 token: Optional[tuple] = None,
+                 lease_sec: Optional[float] = None):
         self.num_slots = int(num_slots)
         self.epoch = int(epoch)
+        # fencing identity: which migration attempt owns this state
+        # (None on both = a legacy unfenced controller)
+        self.mig_id = mig_id
+        self.token = (int(token[0]), int(token[1])) if token else None
         self.mask = np.zeros(self.num_slots, dtype=bool)
         self.mask[np.asarray(sorted(set(int(s) for s in slots)),
                              dtype=np.int64)] = True
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self.frozen = False  # plain-bool fast reads are GIL-atomic
+        self.frozen_at = 0.0  # monotonic stamp of the freeze
         self.inflight = 0
         self.captured: set = set()
         self.captured_total = 0
         self.snapshot_rows: List = []
         self.extract_pos = 0
+        # donor self-healing lease: every controller RPC touching this
+        # state renews it; expiry means the controller stopped
+        # heartbeating (died, partitioned) and the donor auto-thaws —
+        # discard capture, unfreeze, bounce back to the old epoch —
+        # rather than serving a frozen-forever shard. 0 disables.
+        if lease_sec is None:
+            lease_sec = float(
+                knobs.get("PERSIA_RESHARD_FREEZE_LEASE_SEC"))
+        self.lease_sec = float(lease_sec)
+        self.lease_deadline = (time.monotonic() + self.lease_sec
+                               if self.lease_sec > 0 else float("inf"))
+
+    def touch(self):
+        """Renew the controller lease (called by every fence-valid
+        reshard RPC that reaches this state)."""
+        if self.lease_sec > 0:
+            self.lease_deadline = time.monotonic() + self.lease_sec
+
+    def lease_expired(self) -> bool:
+        return time.monotonic() >= self.lease_deadline
 
     def hits(self, signs: np.ndarray) -> Optional[np.ndarray]:
         """The subset of ``signs`` living in a moving slot (None when
@@ -161,9 +189,13 @@ class _ReshardState:
     def freeze(self, timeout: float = 5.0):
         """Stop admitting writes for the moving slots and wait out the
         writes already past the gate — after this returns, the final
-        capture drain reads definitive row state."""
+        capture drain reads definitive row state. Idempotent: a
+        repeated freeze (retry after an ambiguous timeout) re-waits the
+        barrier, which is already empty."""
         with self._lock:
-            self.frozen = True
+            if not self.frozen:
+                self.frozen = True
+                self.frozen_at = time.monotonic()
             deadline = time.monotonic() + timeout
             while self.inflight > 0:
                 left = deadline - time.monotonic()
@@ -367,6 +399,11 @@ class PsService:
         # fleets that never reshard keep a byte-identical wire.
         self._reshard: Optional[_ReshardState] = None
         self._reshard_lock = threading.Lock()
+        # sticky fencing watermark: the highest (epoch, attempt) token
+        # any reshard RPC ever presented — survives the state it fenced
+        # (a thawed/finished migration must still fence out its dead
+        # controller's stragglers)
+        self._reshard_fence = (0, 0)
         self._routing_epoch = 0
         self._wgate = _WriteGate()
         s.register("reshard_begin", self._reshard_begin)
@@ -456,6 +493,24 @@ class PsService:
                     help_text="rows dropped with their packet when the "
                               "disk budget overflowed (monotone)"),
             }
+        # donor-side migration observables: the frozen-slot age gauge is
+        # what the reshard_frozen_slot_stuck SLO rule watches — a
+        # controller that dies POST-freeze never trips the controller-
+        # side reshard_stuck gauge, so the donor must report its own
+        # wedged state; the lease counter records every self-healing
+        # auto-thaw
+        self._g_frozen_age = reg.gauge(
+            "ps_frozen_slot_age_sec", {"server": port_label},
+            help_text="seconds this replica's moving slots have been "
+                      "write-frozen by an in-flight migration (0 when "
+                      "not frozen) — a stuck value means the reshard "
+                      "controller died post-freeze; the freeze lease "
+                      "auto-thaws it")
+        self._c_lease_expired = reg.counter(
+            "ps_reshard_lease_expired_total", {"server": port_label},
+            help_text="migrations this donor auto-thawed because the "
+                      "controller stopped heartbeating within the "
+                      "freeze lease")
         from persia_tpu.metrics import STEP_BUCKETS
 
         self._h_staleness = reg.histogram(
@@ -475,6 +530,11 @@ class PsService:
                                          hotness_fn=self._hotness_snapshot)
 
     def _refresh_mem_gauges(self):
+        self._maybe_expire_reshard()
+        rs = self._reshard
+        self._g_frozen_age.set(
+            round(time.monotonic() - rs.frozen_at, 3)
+            if rs is not None and rs.frozen else 0)
         if self._mem_gauges:
             for g, b in zip(self._mem_gauges,
                             self.holder.resident_bytes_per_shard()):
@@ -543,12 +603,18 @@ class PsService:
         # what /fleet/routing aggregates and the stuck-migration SLO
         # rule watches
         doc["routing_epoch"] = self._routing_epoch
+        self._maybe_expire_reshard()
         rs = self._reshard
         if rs is not None:
             with rs._lock:
                 doc["reshard"] = {
                     "frozen": rs.frozen,
+                    "frozen_age_sec": (
+                        round(time.monotonic() - rs.frozen_at, 3)
+                        if rs.frozen else 0.0),
                     "pending_epoch": rs.epoch,
+                    "mig_id": rs.mig_id,
+                    "lease_sec": rs.lease_sec,
                     "captured": len(rs.captured),
                     "captured_total": rs.captured_total,
                     "snapshot_rows_left": len(rs.snapshot_rows),
@@ -773,6 +839,86 @@ class PsService:
 
     # --- live resharding (donor/target surface) --------------------------
 
+    def _maybe_expire_reshard(self):
+        """Donor self-healing: when the controller's lease on the
+        in-flight migration state has expired (no reshard RPC renewed
+        it), auto-thaw — discard capture state and unfreeze the moving
+        slots, bouncing this replica back to the old epoch. Bounced
+        writers' existing routing_stale retry path then settles at the
+        CURRENT epoch transparently. Checked from the write guard, the
+        health doc, and reshard_status, so both trafficked and idle
+        donors recover. The fencing watermark stays: a zombie
+        controller of the thawed migration is still refused."""
+        rs = self._reshard
+        if rs is None or not rs.lease_expired():
+            return
+        with self._reshard_lock:
+            rs = self._reshard
+            if rs is None or not rs.lease_expired():
+                return
+            self._reshard = None
+        self._c_lease_expired.inc()
+        if self._routing_epoch >= rs.epoch:
+            # the migration's epoch already published to this replica:
+            # the thaw is a self-finalize (exactly what reshard_finish
+            # would have done) — moved rows stay as unreachable stale
+            # copies
+            _logger.warning(
+                "reshard lease expired (%.1fs without a controller "
+                "heartbeat): self-finalized migration %s — epoch %d "
+                "already published, capture disarmed", rs.lease_sec,
+                rs.mig_id, rs.epoch)
+            return
+        _logger.warning(
+            "reshard lease expired (%.1fs without a controller "
+            "heartbeat): auto-thawed migration %s pending epoch %d — "
+            "capture discarded, %d slots unfrozen, serving the old "
+            "epoch again. If the controller died MID-PUBLISH (some "
+            "workers already on epoch %d), resume() from its journal "
+            "promptly: old-epoch writers can now land on moved slots",
+            rs.lease_sec, rs.mig_id, rs.epoch, int(rs.mask.sum()),
+            rs.epoch)
+
+    def _check_fence(self, fence, renew: bool = True):
+        """Order a reshard RPC against the fencing watermark: tokens
+        below it are refused (superseded controller), higher tokens
+        advance it and DISCARD any state an older attempt left behind.
+        ``fence=None`` (legacy unfenced controller) passes through.
+        Returns the current state (possibly None) with its lease
+        renewed."""
+        from persia_tpu.reshard import FENCED_PREFIX
+
+        from persia_tpu.rpc import RpcError
+
+        if fence is None:
+            rs = self._reshard
+            if rs is not None and renew:
+                rs.touch()
+            return rs
+        token = (int(fence[0]), int(fence[1]))
+        with self._reshard_lock:
+            if token < self._reshard_fence:
+                raise RpcError(
+                    f"{FENCED_PREFIX}{self._reshard_fence[0]}."
+                    f"{self._reshard_fence[1]}")
+            if token > self._reshard_fence:
+                self._reshard_fence = token
+                rs = self._reshard
+                if rs is not None and rs.token is not None \
+                        and rs.token < token:
+                    # a newer attempt took over: the old attempt's
+                    # capture/freeze state is dead weight — discard it
+                    # (the new attempt re-begins from scratch)
+                    self._reshard = None
+                    _logger.warning(
+                        "reshard state of superseded attempt %s/%s "
+                        "discarded by newer token %s",
+                        rs.mig_id, rs.token, token)
+            rs = self._reshard
+        if rs is not None and renew:
+            rs.touch()
+        return rs
+
     def _reshard_guard(self, signs: np.ndarray, meta: Optional[dict] = None):
         """Write-path gate: one None test when no migration runs. With
         a migration in flight, writes touching moving slots register
@@ -781,6 +927,11 @@ class PsService:
         rs = self._reshard
         if rs is None:
             return None, None
+        if rs.lease_expired():
+            self._maybe_expire_reshard()
+            rs = self._reshard
+            if rs is None:
+                return None, None
         if rs.frozen and meta is not None:
             ce = meta.get("re")
             if ce is not None and int(ce) < rs.epoch:
@@ -803,12 +954,34 @@ class PsService:
         from persia_tpu.ps.store import iter_psd_records, read_psd_header
 
         req = msgpack.unpackb(payload, raw=False)
-        rs = _ReshardState(req["slots"], req["num_slots"], req["epoch"])
+        if faults._active:
+            faults.fire("ps.reshard.begin", epoch=req.get("epoch"),
+                        mig_id=req.get("mig_id"))
+        self._maybe_expire_reshard()
+        fence = req.get("fence")
+        self._check_fence(fence, renew=False)
+        rs = _ReshardState(req["slots"], req["num_slots"], req["epoch"],
+                           mig_id=req.get("mig_id"), token=fence,
+                           lease_sec=req.get("lease_sec"))
         with self._reshard_lock:
-            if self._reshard is not None:
-                raise RuntimeError(
-                    "a slot migration is already in flight on this "
-                    "replica")
+            cur = self._reshard
+            if cur is not None:
+                if (fence is not None and cur.token is not None
+                        and tuple(cur.token) <= (int(fence[0]),
+                                                 int(fence[1]))):
+                    # idempotent re-begin: the same (or a newer) attempt
+                    # re-arms from scratch — a retry after an ambiguous
+                    # timeout, or a resumed controller whose
+                    # fenced_finish raced this replica. The stale
+                    # capture set is worthless (its rows re-snapshot
+                    # below), so discarding it loses nothing.
+                    _logger.warning(
+                        "reshard_begin: re-arming over attempt %s/%s "
+                        "with token %s", cur.mig_id, cur.token, fence)
+                else:
+                    raise RuntimeError(
+                        "a slot migration is already in flight on this "
+                        "replica")
             self._reshard = rs
             # barrier: writes already past the (then-absent) capture
             # gate must finish applying BEFORE the snapshot reads the
@@ -853,7 +1026,10 @@ class PsService:
         from persia_tpu.reshard import pack_rows
 
         req = msgpack.unpackb(payload, raw=False)
-        rs = self._reshard
+        if faults._active:
+            faults.fire("ps.reshard.extract",
+                        max_rows=req.get("max_rows"))
+        rs = self._check_fence(req.get("fence"))
         if rs is None:
             raise RuntimeError("no migration in flight")
         a = rs.extract_pos
@@ -879,6 +1055,15 @@ class PsService:
         from persia_tpu.reshard import unpack_rows
 
         meta, (blob,) = unpack_arrays(payload)
+        if faults._active:
+            faults.fire("ps.reshard.install", nbytes=len(blob),
+                        mig_id=meta.get("mig_id"))
+        # target-side fencing: an install from a superseded controller
+        # (stale retry still in flight after a resume took over) must
+        # not overwrite rows the new attempt already re-installed.
+        # Repeated installs from the LIVE attempt are idempotent —
+        # full-row set_entries writes.
+        self._check_fence(meta.get("fence"), renew=False)
         by_shape: dict = {}
         for sign, dim, vec in unpack_rows(bytes(blob)):
             by_shape.setdefault((int(dim), len(vec)), []).append(
@@ -900,7 +1085,12 @@ class PsService:
         Frozen, this read is definitive — the cutover's final drain."""
         from persia_tpu.reshard import pack_rows
 
-        rs = self._reshard
+        req = (msgpack.unpackb(payload, raw=False) if payload else {})
+        if faults._active:
+            faults.fire("ps.reshard.drain",
+                        frozen=bool(self._reshard
+                                    and self._reshard.frozen))
+        rs = self._check_fence(req.get("fence"))
         if rs is None:
             raise RuntimeError("no migration in flight")
         rows = []
@@ -914,7 +1104,9 @@ class PsService:
 
     def _reshard_freeze(self, payload: bytes) -> bytes:
         req = msgpack.unpackb(payload, raw=False)
-        rs = self._reshard
+        if faults._active:
+            faults.fire("ps.reshard.freeze", epoch=req.get("epoch"))
+        rs = self._check_fence(req.get("fence"))
         if rs is None:
             raise RuntimeError("no migration in flight")
         if req.get("epoch") is not None:
@@ -928,22 +1120,42 @@ class PsService:
         """Disarm capture (cutover published + double-read window
         closed). Moved rows stay resident and simply age out of the
         LRU/arena like any cold row — they are unreachable under the
-        new table, so correctness never depends on deleting them."""
+        new table, so correctness never depends on deleting them.
+        Idempotent (a finished/never-armed replica answers
+        ``was_active: False``) and fenced (a superseded controller's
+        late finish must not disarm the newer attempt's capture)."""
+        req = (msgpack.unpackb(payload, raw=False) if payload else {})
+        if faults._active:
+            faults.fire("ps.reshard.finish", mig_id=req.get("mig_id"))
+        self._check_fence(req.get("fence"), renew=False)
         with self._reshard_lock:
             rs, self._reshard = self._reshard, None
         return msgpack.packb(
             {"was_active": rs is not None,
-             "captured_total": rs.captured_total if rs else 0})
+             "captured_total": rs.captured_total if rs else 0,
+             "mig_id": rs.mig_id if rs else None})
 
     def _reshard_status(self, payload: bytes) -> bytes:
-        rs = self._reshard
+        req = (msgpack.unpackb(payload, raw=False) if payload else {})
+        self._maybe_expire_reshard()
+        # a fenced status doubles as the controller heartbeat (renews
+        # the lease); unfenced status is a read-only observer probe
+        rs = (self._check_fence(req["fence"]) if req.get("fence")
+              else self._reshard)
         doc = {"active": rs is not None,
-               "routing_epoch": self._routing_epoch}
+               "routing_epoch": self._routing_epoch,
+               "fence": list(self._reshard_fence)}
         if rs is not None:
             with rs._lock:
                 doc.update({
                     "frozen": rs.frozen,
+                    "frozen_age_sec": (
+                        round(time.monotonic() - rs.frozen_at, 3)
+                        if rs.frozen else 0.0),
                     "pending_epoch": rs.epoch,
+                    "mig_id": rs.mig_id,
+                    "token": list(rs.token) if rs.token else None,
+                    "lease_sec": rs.lease_sec,
                     "captured": len(rs.captured),
                     "captured_total": rs.captured_total,
                     "snapshot_rows_left": len(rs.snapshot_rows),
@@ -1428,52 +1640,130 @@ class PsClient:
         self._guarded(lambda: self.client.call("clear"))
 
     # --- live-resharding surface (persia_tpu.reshard drives these) -------
+    #
+    # Every method takes an optional ``fence`` token ((epoch, attempt),
+    # see reshard.py) the server orders against its watermark, and rides
+    # the PERSIA_RESHARD_RPC_TIMEOUT_SEC deadline once
+    # :meth:`enable_reshard_deadline` armed the connection — so a
+    # wedged replica sheds the expired call instead of hanging the
+    # migration. ``fence=None`` keeps the legacy unfenced protocol.
 
-    def reshard_begin(self, slots, num_slots: int, epoch: int) -> int:
+    def enable_reshard_deadline(self):
+        """Arm PERSIA_RESHARD_RPC_TIMEOUT_SEC on this client: future
+        reshard RPCs carry the negotiated ``__deadline__`` envelope
+        slot. The calling thread's pooled connection is dropped so the
+        next call re-dials WITH the probe; called by the controller at
+        migration start, so fleets that never reshard never send it —
+        their wire stays byte-identical."""
+        timeout = float(knobs.get("PERSIA_RESHARD_RPC_TIMEOUT_SEC"))
+        if timeout <= 0:
+            return
+        self._reshard_rpc_deadline = timeout
+        if not self.client.enable_deadline:
+            self.client.enable_deadline = True
+            self.client.renegotiate()
+
+    def _reshard_call_kw(self) -> dict:
+        dl = getattr(self, "_reshard_rpc_deadline", None)
+        return {"deadline": dl} if dl else {}
+
+    def reshard_begin(self, slots, num_slots: int, epoch: int,
+                      fence=None, mig_id: Optional[str] = None,
+                      lease_sec: Optional[float] = None) -> int:
         """Donor: arm write capture for ``slots`` and snapshot their
-        rows; returns the snapshot row count."""
-        rep = self._guarded(lambda: self.client.call_msg(
-            "reshard_begin", slots=[int(s) for s in slots],
-            num_slots=int(num_slots), epoch=int(epoch)))
+        rows; returns the snapshot row count. Fenced re-begins with the
+        same (or a newer) token re-arm idempotently — the retry path of
+        a resumed controller."""
+        payload = {"slots": [int(s) for s in slots],
+                   "num_slots": int(num_slots), "epoch": int(epoch)}
+        if fence is not None:
+            payload.update(fence=[int(fence[0]), int(fence[1])],
+                           mig_id=mig_id)
+        if lease_sec is not None:
+            payload["lease_sec"] = float(lease_sec)
+        rep = msgpack.unpackb(self._guarded(
+            lambda: self.client.call(
+                "reshard_begin",
+                msgpack.packb(payload, use_bin_type=True),
+                **self._reshard_call_kw())), raw=False)
         return int(rep["rows"])
 
-    def reshard_extract(self, max_rows: int):
+    def reshard_extract(self, max_rows: int, fence=None):
         """Donor: next snapshot chunk. Returns (row_blob, done)."""
+        req = {"max_rows": int(max_rows)}
+        if fence is not None:
+            req["fence"] = [int(fence[0]), int(fence[1])]
         meta, (blob,) = unpack_arrays(self._guarded(
             lambda: self.client.call(
                 "reshard_extract",
-                msgpack.packb({"max_rows": int(max_rows)},
-                              use_bin_type=True))))
+                msgpack.packb(req, use_bin_type=True),
+                **self._reshard_call_kw())))
         return bytes(blob), bool(meta["done"])
 
-    def reshard_install(self, row_blob: bytes) -> int:
-        """Target: install a row chunk (value + optimizer state)."""
+    def reshard_install(self, row_blob: bytes, fence=None,
+                        mig_id: Optional[str] = None) -> int:
+        """Target: install a row chunk (value + optimizer state).
+        Idempotent by construction (full-row writes) and fenced, so
+        retry-after-timeout and resume-re-copy are both safe."""
+        meta = {}
+        if fence is not None:
+            meta = {"fence": [int(fence[0]), int(fence[1])],
+                    "mig_id": mig_id}
         rep = msgpack.unpackb(self._guarded(
             lambda: self.client.call("reshard_install", pack_arrays(
-                {}, [np.frombuffer(row_blob, np.uint8)]), dedup=True)),
+                meta, [np.frombuffer(row_blob, np.uint8)]), dedup=True,
+                **self._reshard_call_kw())),
             raw=False)
         return int(rep["installed"])
 
-    def reshard_drain(self) -> bytes:
+    def reshard_drain(self, fence=None) -> bytes:
         """Donor: current rows of the captured writes (clears the
         capture set)."""
+        payload = (msgpack.packb(
+            {"fence": [int(fence[0]), int(fence[1])]},
+            use_bin_type=True) if fence is not None else b"")
         _meta, (blob,) = unpack_arrays(self._guarded(
-            lambda: self.client.call("reshard_drain")))
+            lambda: self.client.call("reshard_drain", payload,
+                                     **self._reshard_call_kw())))
         return bytes(blob)
 
-    def reshard_freeze(self, epoch: Optional[int] = None):
+    def reshard_freeze(self, epoch: Optional[int] = None, fence=None,
+                       mig_id: Optional[str] = None):
         """Donor: stop admitting writes for the moving slots (bounces
-        carry ``epoch`` as the demanded successor epoch)."""
-        self._guarded(lambda: self.client.call_msg(
-            "reshard_freeze", epoch=epoch))
+        carry ``epoch`` as the demanded successor epoch). Idempotent:
+        an already-frozen state re-waits its (empty) barrier."""
+        payload = {"epoch": epoch}
+        if fence is not None:
+            payload.update(fence=[int(fence[0]), int(fence[1])],
+                           mig_id=mig_id)
+        self._guarded(lambda: self.client.call(
+            "reshard_freeze", msgpack.packb(payload, use_bin_type=True),
+            **self._reshard_call_kw()))
 
-    def reshard_finish(self) -> dict:
+    def reshard_finish(self, fence=None,
+                       mig_id: Optional[str] = None) -> dict:
+        payload = b""
+        if fence is not None:
+            payload = msgpack.packb(
+                {"fence": [int(fence[0]), int(fence[1])],
+                 "mig_id": mig_id}, use_bin_type=True)
         return msgpack.unpackb(self._guarded(
-            lambda: self.client.call("reshard_finish")), raw=False)
+            lambda: self.client.call("reshard_finish", payload,
+                                     **self._reshard_call_kw())),
+            raw=False)
 
-    def reshard_status(self) -> dict:
+    def reshard_status(self, fence=None) -> dict:
+        """Migration state probe; with ``fence`` it doubles as the
+        controller's lease heartbeat."""
+        payload = b""
+        if fence is not None:
+            payload = msgpack.packb(
+                {"fence": [int(fence[0]), int(fence[1])]},
+                use_bin_type=True)
         return msgpack.unpackb(self._guarded(
-            lambda: self.client.call("reshard_status")), raw=False)
+            lambda: self.client.call("reshard_status", payload,
+                                     **self._reshard_call_kw())),
+            raw=False)
 
     def set_routing_epoch(self, epoch: int):
         """Record the published routing epoch on the replica (rides

@@ -206,6 +206,17 @@ def default_rules() -> List[SloRule]:
                             "settling, or the controller died "
                             "mid-freeze); check /fleet/routing for the "
                             "frozen donor"),
+        SloRule("reshard_frozen_slot_stuck", "ps_frozen_slot_age_sec",
+                ">", 120.0, window_sec=60.0, for_sec=60.0,
+                description="a donor PS has held write-frozen slots for "
+                            "over 2 minutes — its reshard controller "
+                            "died post-freeze (the controller-side "
+                            "reshard_stuck gauge cannot see this) or "
+                            "the cutover wedged; the freeze lease "
+                            "(PERSIA_RESHARD_FREEZE_LEASE_SEC) will "
+                            "auto-thaw the donor, then resume() the "
+                            "migration from its journal or abort it "
+                            "(docs/DEPLOY.md runbook)"),
         SloRule("reshard_replay_runaway",
                 "rate(reshard_replayed_rows_total)", ">", 100000.0,
                 window_sec=120.0, severity="ticket",

@@ -417,7 +417,8 @@ class EmbeddingWorker:
             raise KeyError(f"ref_id {ref_id} not in forward buffer")
         feats, enter_time = item
         try:
-            result, groups = self._lookup_feats(feats, training)
+            result, groups, fwd_epoch = self._lookup_feats(feats,
+                                                           training)
         except BaseException:
             # restore the entry so a retry after PS recovery can still
             # find its batch (the client's lookup retry contract,
@@ -429,9 +430,12 @@ class EmbeddingWorker:
         if training:
             with self._lock:
                 # cache the shard groups so the gradient path reuses the
-                # forward split instead of re-hashing every sign
+                # forward split instead of re-hashing every sign; the
+                # epoch stamp lets the update path detect a reshard
+                # that landed mid-pipeline and re-split instead of
+                # shipping by a stale table (see _update_gradients_inner)
                 self._post_forward_buffer[ref_id] = (
-                    feats, groups, time.monotonic())
+                    feats, (groups, fwd_epoch), time.monotonic())
                 self.staleness += 1
                 self._sync_gauges_locked()
         return result
@@ -441,6 +445,7 @@ class EmbeddingWorker:
     ) -> Dict[str, object]:
         """One-shot preprocess+lookup without buffers — the inference/eval
         path (reference: forward_batched_direct, mod.rs:1076-1107)."""
+        # (result only; the shard split and its epoch are discarded)
         feats = mw.preprocess_batch(id_type_features, self.schema)
         return self._lookup_feats(feats, training)[0]
 
@@ -452,7 +457,11 @@ class EmbeddingWorker:
         ref_id = self.put_batch(id_type_features)
         return ref_id, self.lookup(ref_id, training=True)
 
-    def _lookup_feats(self, feats, training: bool) -> Dict[str, object]:
+    def _lookup_feats(self, feats, training: bool
+                      ) -> Tuple[Dict[str, object], list, int]:
+        """Preprocess + fan-out lookup; returns (per-feature results,
+        the shard groups, and the routing epoch the split used — the
+        update path re-splits when the epoch moved)."""
         if self.monitor is not None:
             for f in feats:
                 self.monitor.observe(f.name, f.distinct_signs)
@@ -558,7 +567,7 @@ class EmbeddingWorker:
             for feat, mat in zip(feats, mats):
                 slot = self.schema.get_slot(feat.name)
                 out[feat.name] = mw.postprocess_feature(feat, slot, mat)
-        return out, groups
+        return out, groups, routing.epoch
 
     def update_gradients(
         self, ref_id: int, grads: Dict[str, np.ndarray],
@@ -587,7 +596,22 @@ class EmbeddingWorker:
             raise
 
     def _update_gradients_inner(self, ref_id, item, grads, loss_scale):
-        feats, fwd_groups, _ = item
+        feats, fwd, _ = item
+        fwd_groups, fwd_epoch = (fwd if isinstance(fwd, tuple)
+                                 else (fwd, self._routing.epoch))
+        if fwd_groups is not None and fwd_epoch != self._routing.epoch:
+            # a reshard cut over between this batch's forward and its
+            # gradient return: the cached forward split routes by a
+            # RETIRED table. Shipping by it would land moved signs on a
+            # donor whose capture already disarmed (post-finalize, or a
+            # restarted donor that lost its freeze state with the
+            # process) — silently unreachable under the live table,
+            # i.e. lost updates. Drop the cache and re-split below.
+            _logger.info(
+                "gradient return for ref %d crosses routing epochs "
+                "(%d -> %d); re-splitting by the live table", ref_id,
+                fwd_epoch, self._routing.epoch)
+            fwd_groups = None
         # validate up front: a missing gradient must fail BEFORE any
         # group ships (the streaming path ships incrementally)
         for feat in feats:
@@ -658,7 +682,7 @@ class EmbeddingWorker:
 
     # --- reshard cutover settlement --------------------------------------
 
-    def _settle_stale(self, signs, exc, ship_fn):
+    def _settle_stale(self, signs, exc, ship_fn, prepare_fn=None):
         """The one bounce-retry protocol behind every write path: a
         shipment bounced with routing_stale (its slots froze for
         migration) re-splits ONLY ITSELF by the current table and
@@ -672,16 +696,48 @@ class EmbeddingWorker:
         retry at the current epoch. ``ship_fn(replica, sel)`` issues
         the per-replica RPC for the selected sign indices; chained
         bounces (a second reshard mid-retry) loop until the deadline.
-        Re-raises anything that is not a stale bounce."""
+
+        A CONNECTION failure mid-settle (a replica SIGKILLed while the
+        bounce waited out a cutover — the chaos-reshard matrix's
+        donor-kill cells) is handled HERE, not re-raised: the failed
+        portion stays pending, the client tier recovers (re-resolve /
+        re-arm), and the next round re-splits it by the then-current
+        table. Propagating it instead hands control to the caller's
+        whole-fan-out retry, which re-ships its PRE-RESHARD shard
+        groups — the moved signs would land on the restarted donor's
+        stale, no-longer-routed copies (the restart cleared its freeze
+        state) and read back as lost updates, while the portions that
+        already applied double-apply. The same applies when the
+        ORIGINAL failure is a transport loss (the donor died with its
+        freeze state, so nothing ever bounced): the portion settles
+        here at the current epoch. Re-raises anything that is neither
+        a stale bounce nor a transport loss; a portion that never
+        settles because its replica stays down re-raises the LAST
+        transport error at the deadline, so legacy catch clauses
+        (ConnectionError) still hold for a permanently dead fleet."""
         from persia_tpu.routing import is_routing_stale
 
+        last_conn_exc = None
         min_epoch = is_routing_stale(exc)
         if min_epoch is None:
-            raise exc
+            if not isinstance(exc, (ConnectionError, OSError)):
+                raise exc
+            last_conn_exc = exc
+            min_epoch = self._routing.epoch
         deadline = self._stale_deadline()
+        # ``prepare_fn(replica, sel)`` runs before ship_fn ONLY once a
+        # replica restart is in play (the original failure was a
+        # transport loss, or a round hit one / re-armed a blank
+        # replica): the restored store lacks rows that were created but
+        # never durably updated, and the update path must re-create
+        # them first. Ordinary stale bounces skip it — one RPC per
+        # round, and deliberately evicted rows are not resurrected.
+        need_prepare = last_conn_exc is not None
         pending = np.arange(len(signs), dtype=np.int64)
         while len(pending):
             if time.monotonic() > deadline:
+                if last_conn_exc is not None:
+                    raise last_conn_exc
                 raise RuntimeError(
                     "routing_stale bounces did not settle within the "
                     "stale-retry budget (a replica is refusing writes "
@@ -689,21 +745,55 @@ class EmbeddingWorker:
             self._await_epoch(min_epoch, deadline)
             shards = self._routing.table.replica_of(signs[pending])
             bounced = []
+            conn_failed = False
             for r in np.unique(shards):
                 sel = pending[np.nonzero(shards == r)[0]]
                 try:
+                    if need_prepare and prepare_fn is not None:
+                        prepare_fn(int(r), sel)
                     ship_fn(int(r), sel)
                 except Exception as e:
                     me = is_routing_stale(e)
-                    if me is None:
-                        raise
-                    min_epoch = max(min_epoch, me)
-                    bounced.append(sel)
+                    if me is not None:
+                        min_epoch = max(min_epoch, me)
+                        bounced.append(sel)
+                        continue
+                    if isinstance(e, (ConnectionError, OSError)):
+                        conn_failed = True
+                        last_conn_exc = e
+                        bounced.append(sel)
+                        continue
+                    from persia_tpu.rpc import RpcError
+
+                    if (isinstance(e, RpcError)
+                            and self._rearm_unready_clients()):
+                        # application error from a restored-but-blank
+                        # replica (restore loads rows, not the
+                        # optimizer): re-armed in place — retry the
+                        # portion here for the same reason as the
+                        # transport case (the caller's whole-fan-out
+                        # retry ships stale groups)
+                        need_prepare = True
+                        bounced.append(sel)
+                        continue
+                    raise
+            if conn_failed:
+                need_prepare = True
+                # restart recovery scoped to the failed portion only
+                try:
+                    if self._ps_resolver is not None:
+                        self._refresh_ps_clients()
+                    else:
+                        self._rearm_unready_clients()
+                except Exception:
+                    pass  # replica still down; the deadline bounds us
             pending = (np.concatenate(bounced) if bounced
                        else pending[:0])
             if len(pending):
-                time.sleep(0.005)  # a bounce at the CURRENT epoch
-                # means the freeze window is still closing — back off
+                # a bounce at the CURRENT epoch means the freeze window
+                # is still closing — back off briefly; a downed replica
+                # needs its supervisor's restart window
+                time.sleep(0.2 if conn_failed else 0.005)
 
     def _settle_stale_lookup(self, group, training: bool, exc):
         signs, dim = group.signs, group.dim
@@ -717,10 +807,19 @@ class EmbeddingWorker:
         return res
 
     def _settle_stale_update(self, signs, gmat, dim, exc):
+        # prepare (recovery rounds only): a restarted replica restored
+        # only its DURABLE rows — one this batch's forward created but
+        # never updated died with the old process, and the PS silently
+        # drops gradients for missing rows (the eviction-race miss
+        # counter's designed behavior), so the retried update would ack
+        # without applying. Re-create through the sanctioned path (a
+        # training lookup honors admission) before the gradient.
         self._settle_stale(
             signs, exc,
             lambda r, sel: self.ps_clients[r].update_gradients(
-                signs[sel], gmat[sel], dim))
+                signs[sel], gmat[sel], dim),
+            prepare_fn=lambda r, sel: self.ps_clients[r].lookup(
+                signs[sel], dim, True))
 
     def _update_gradients_serialized(self, feats, fwd_groups, grads,
                                      loss_scale):

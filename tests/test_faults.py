@@ -729,3 +729,98 @@ def test_serving_degradation_opt_out():
             cl.predict(_infer_request(4, seed=4))
     finally:
         server.stop()
+
+
+# --- reshard-protocol injection sites (PR 12 satellite) ----------------------
+
+
+def test_reshard_fault_sites_targetable_by_spec():
+    """PERSIA_FAULTS-style specs can target the migration protocol
+    directly: a rule on ps.reshard.extract fails the donor's copy
+    stream; a rule on ps.reshard.drain with frozen=True hits only the
+    definitive cutover drain, not the replay rounds."""
+    import numpy as np
+
+    from persia_tpu.ps.store import EmbeddingHolder
+    from persia_tpu.routing import RoutingTable
+    from persia_tpu.service.ps_service import PsClient, PsService
+
+    holder = EmbeddingHolder(capacity=10_000)
+    svc = PsService(holder, port=0)
+    svc.server.serve_background()
+    client = PsClient(svc.addr, circuit_breaker=False)
+    client.configure("bounded_uniform", {"lower": 0.0, "upper": 0.0},
+                     admit_probability=1.0, weight_bound=1e9,
+                     enable_weight_bound=False)
+    client.register_optimizer({"type": "sgd", "lr": 1.0, "wd": 0.0})
+    t = RoutingTable.uniform(1, slots_per_replica=4)
+    client.lookup(np.arange(64, dtype=np.uint64), 8, True)
+    try:
+        faults.install("ps.reshard.extract:error")
+        client.reshard_begin([0, 1], t.num_slots, epoch=2,
+                             fence=(2, 0), mig_id="m")
+        with pytest.raises(RpcError):
+            client.reshard_extract(16, fence=(2, 0))
+        faults.reset_faults()
+        # frozen= kwarg filter: replay drains (frozen=False) pass, the
+        # cutover drain (frozen=True) trips the rule
+        faults.install("ps.reshard.drain:error@frozen=True")
+        client.reshard_drain(fence=(2, 0))  # replay round: unharmed
+        client.reshard_freeze(epoch=2, fence=(2, 0))
+        with pytest.raises(RpcError):
+            client.reshard_drain(fence=(2, 0))
+        faults.reset_faults()
+        client.reshard_finish(fence=(2, 0))
+        # controller-side site: the driver's --die-at maps to a `die`
+        # rule here; an `error` rule aborts the phase the same way
+        faults.install("reshard.controller:error@state=freeze")
+        from persia_tpu.reshard import ReshardController
+
+        ctrl = ReshardController([client], t)
+        with pytest.raises(faults.InjectedFault):
+            ctrl._phase("freeze", donor=0)
+        ctrl._phase("copy", donor=0)  # other states unharmed
+    finally:
+        faults.reset_faults()
+        svc.stop()
+
+
+def test_reshard_sites_zero_overhead_when_disarmed(monkeypatch):
+    """The disabled path pin: with no rule armed (faults._active
+    False), the reshard handlers and the controller's phase
+    transitions must never reach faults.fire at all — the guard is a
+    single module-global test."""
+    import numpy as np
+
+    from persia_tpu.ps.store import EmbeddingHolder
+    from persia_tpu.reshard import ReshardController
+    from persia_tpu.routing import RoutingTable
+    from persia_tpu.service.ps_service import PsClient, PsService
+
+    assert faults._active is False
+
+    def boom(*a, **kw):  # noqa: ARG001
+        raise AssertionError("faults.fire reached on the disabled path")
+
+    monkeypatch.setattr(faults, "fire", boom)
+    holder = EmbeddingHolder(capacity=1_000)
+    svc = PsService(holder, port=0)
+    svc.server.serve_background()
+    try:
+        client = PsClient(svc.addr, circuit_breaker=False)
+        client.configure("bounded_uniform", {"lower": 0.0, "upper": 0.0},
+                         admit_probability=1.0, weight_bound=1e9,
+                         enable_weight_bound=False)
+        client.register_optimizer({"type": "sgd", "lr": 1.0, "wd": 0.0})
+        t = RoutingTable.uniform(1, slots_per_replica=4)
+        client.lookup(np.arange(16, dtype=np.uint64), 8, True)
+        client.reshard_begin([0], t.num_slots, epoch=2, fence=(2, 0),
+                             mig_id="m")
+        client.reshard_extract(8, fence=(2, 0))
+        client.reshard_drain(fence=(2, 0))
+        client.reshard_freeze(epoch=2, fence=(2, 0))
+        client.reshard_status()
+        client.reshard_finish(fence=(2, 0))
+        ReshardController([client], t)._phase("copy", donor=0)
+    finally:
+        svc.stop()

@@ -533,3 +533,99 @@ def test_coordinator_topology_and_fleet_discovery():
         assert not [m for m in cl.topology() if m["replica"] == 0]
     finally:
         coord.server.stop()
+
+
+# --- donor-side frozen-slot observability (PR 12 satellite) ------------------
+
+
+def test_frozen_slot_gauge_and_stuck_rule():
+    """A controller that dies post-freeze is invisible to the
+    controller-side reshard_stuck gauge — the DONOR must report its own
+    wedged state: ps_frozen_slot_age_sec climbs while frozen, resets on
+    finish, and the default reshard_frozen_slot_stuck rule fires on
+    it."""
+    import numpy as np
+
+    from persia_tpu.ps.store import EmbeddingHolder
+    from persia_tpu.routing import RoutingTable
+    from persia_tpu.service.ps_service import PsClient, PsService
+
+    holder = EmbeddingHolder(capacity=10_000)
+    svc = PsService(holder, port=0)
+    svc.server.serve_background()
+    try:
+        client = PsClient(svc.addr, circuit_breaker=False)
+        client.configure("bounded_uniform", {"lower": 0.0, "upper": 0.0},
+                         admit_probability=1.0, weight_bound=1e9,
+                         enable_weight_bound=False)
+        client.register_optimizer({"type": "sgd", "lr": 1.0, "wd": 0.0})
+        t = RoutingTable.uniform(1, slots_per_replica=4)
+        client.lookup(np.arange(32, dtype=np.uint64), 8, True)
+        h = client.health()
+        assert "reshard" not in h
+        svc._refresh_mem_gauges()
+        assert svc._g_frozen_age.value == 0
+        client.reshard_begin([0], t.num_slots, epoch=2, fence=(2, 0),
+                             mig_id="m", lease_sec=60.0)
+        svc._refresh_mem_gauges()
+        assert svc._g_frozen_age.value == 0  # armed but not frozen
+        client.reshard_freeze(epoch=2, fence=(2, 0))
+        time.sleep(0.05)
+        h = client.health()
+        assert h["reshard"]["frozen"] is True
+        assert h["reshard"]["frozen_age_sec"] > 0
+        assert h["reshard"]["mig_id"] == "m"
+        svc._refresh_mem_gauges()
+        age = svc._g_frozen_age.value
+        assert age > 0
+        # the default rule fires once the age passes its threshold
+        rule = [r for r in default_rules()
+                if r.name == "reshard_frozen_slot_stuck"][0]
+        eng = SloEngine([rule])
+        t0 = 1000.0
+        eng.ingest("ps0", [("ps_frozen_slot_age_sec", {}, 300.0)], t=t0)
+        assert not [a for a in eng.evaluate(now=t0) if a["firing"]]
+        eng.ingest("ps0", [("ps_frozen_slot_age_sec", {}, 340.0)],
+                   t=t0 + rule.for_sec / 2)
+        eng.evaluate(now=t0 + rule.for_sec / 2)
+        eng.ingest("ps0", [("ps_frozen_slot_age_sec", {}, 370.0)],
+                   t=t0 + rule.for_sec + 1)
+        assert [a for a in eng.evaluate(now=t0 + rule.for_sec + 1)
+                if a["firing"] and a["rule"] == rule.name]
+        # silent on healthy (zero) data
+        eng2 = SloEngine([rule])
+        eng2.ingest("ps0", [("ps_frozen_slot_age_sec", {}, 0.0)], t=t0)
+        assert not [a for a in eng2.evaluate(now=t0) if a["firing"]]
+        client.reshard_finish(fence=(2, 0))
+        svc._refresh_mem_gauges()
+        assert svc._g_frozen_age.value == 0
+    finally:
+        svc.stop()
+
+
+def test_fleet_routing_reports_frozen_donors():
+    """/fleet/routing surfaces the wedged-donor shortlist (service,
+    frozen age, pending epoch, mig id) the DEPLOY.md runbook keys
+    on."""
+    reg0, ps0 = _mk_sidecar("ps0", extra_health={
+        "routing_epoch": 2,
+        "reshard": {"frozen": True, "frozen_age_sec": 12.5,
+                    "pending_epoch": 3, "mig_id": "m3-abc",
+                    "captured": 0, "captured_total": 9,
+                    "lease_sec": 30.0, "snapshot_rows_left": 0}})
+    reg1, ps1 = _mk_sidecar("ps1", extra_health={"routing_epoch": 2})
+    mon = FleetMonitor(targets=[
+        {"service": "ps0", "http_addr": ps0.addr, "role": "ps"},
+        {"service": "ps1", "http_addr": ps1.addr, "role": "ps"},
+    ], scrape_interval=0.1)
+    try:
+        mon.scrape_once()
+        doc = mon.fleet_routing()
+        assert doc["migrating"] == ["ps0"]
+        assert doc["frozen_donors"] == [
+            {"service": "ps0", "frozen_age_sec": 12.5,
+             "pending_epoch": 3, "mig_id": "m3-abc"}]
+        assert doc["epoch_skew"] is False
+    finally:
+        ps0.stop()
+        ps1.stop()

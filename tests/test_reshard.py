@@ -2,11 +2,18 @@
 contract over a live 2→3 reshard under traffic, the freeze/bounce
 protocol, the ownership-filtered incremental replay across a
 shard-count change, hotness-balanced placement beating hash-even under
-zipf(1.05), routing-aware checkpoints, and the operator's scale
-sequencing."""
+zipf(1.05), routing-aware checkpoints, the operator's scale sequencing
+— and the crash-safety layer: the durable migration journal +
+resume-after-SIGKILL (pre- and post-publish), fencing tokens and
+idempotent retries on the reshard RPC surface, the donor freeze lease,
+bounded reshard RPC deadlines, and the routing-edge races."""
 
+import json
 import os
+import subprocess
+import sys
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -14,7 +21,9 @@ import pytest
 from persia_tpu.config import EmbeddingSchema, uniform_slots
 from persia_tpu.data.batch import IDTypeFeature
 from persia_tpu.reshard import (
+    MigrationJournal,
     ReshardController,
+    is_reshard_fenced,
     pack_rows,
     plan_assignment,
     unpack_rows,
@@ -411,3 +420,538 @@ def test_operator_scale_sequences_reshard_around_pods():
     ev = op2.scale_ps("j", 1)
     assert ev["status"] == "pending_drain"
     assert len(ps_pods(op2.api)) == 2  # nothing deleted
+
+
+# --- crash safety: journal, fencing, lease, resume --------------------------
+
+
+def test_migration_journal_records_and_state(tmp_path):
+    j = MigrationJournal(str(tmp_path / "jr"))
+    assert j.state() is None
+    t = RoutingTable.uniform(2, slots_per_replica=4)
+    t2 = t.derive((t.replica_of_slot + 1) % 2, 2)
+    j.append("plan", mig_id="m1", attempt=0, epoch=t2.epoch,
+             old_table=t.to_doc(), new_table=t2.to_doc(),
+             moves=[{"donor": 0, "target": 1, "slots": [0]}])
+    j.append("copy_done", mig_id="m1", attempt=0, donor=0)
+    st = j.state()
+    assert st["phase"] == "copying" and st["copied"] == [0]
+    j.append("frozen", mig_id="m1", attempt=0, donor=0, slots=[0])
+    j.append("publish_start", mig_id="m1", attempt=0, epoch=t2.epoch)
+    assert j.state()["phase"] == "publishing"
+    j.append("published", mig_id="m1", attempt=0, epoch=t2.epoch)
+    assert j.state()["phase"] == "published"
+    j.append("finalized", mig_id="m1", attempt=0)
+    st = j.state()
+    assert st["phase"] == "finalized"
+    # a second journal over the same dir resumes the seq counter and
+    # replays identically (the restart path)
+    j2 = MigrationJournal(str(tmp_path / "jr"))
+    assert j2.state() == st
+    rec = j2.append("plan", mig_id="m2", attempt=0, epoch=t2.epoch + 1,
+                    old_table=t2.to_doc(), new_table=t2.to_doc(),
+                    moves=[])
+    assert rec["seq"] > 6
+    assert j2.state()["mig_id"] == "m2"
+    # a torn write (leftover .tmp) is invisible
+    open(str(tmp_path / "jr" / "rec_000099_plan.json.tmp"), "w").close()
+    assert j2.state()["mig_id"] == "m2"
+    # zombie fencing: a superseded attempt's straggler records (a
+    # fenced-out controller still journals its rollback) must not
+    # poison the live attempt's state
+    j2.append("resume", mig_id="m2", attempt=1, from_phase="planned")
+    j2.append("plan", mig_id="m2", attempt=1, epoch=t2.epoch + 1,
+              old_table=t2.to_doc(), new_table=t2.to_doc(), moves=[])
+    j2.append("published", mig_id="m2", attempt=1, epoch=t2.epoch + 1)
+    j2.append("aborted", mig_id="m2", attempt=0)  # zombie's rollback
+    st = j2.state()
+    assert st["phase"] == "published" and st["attempt"] == 1
+
+
+def _drive_subprocess(journal, addrs, table, to, die_at=None,
+                      env_extra=None):
+    """Run the migration controller as a real subprocess (the chaos
+    harness's controller actor); returns the completed process."""
+    os.makedirs(journal, exist_ok=True)
+    table_path = os.path.join(journal, "current_table.json")
+    with open(table_path, "w") as f:
+        json.dump(table.to_doc(), f)
+    cmd = [sys.executable, "-m", "persia_tpu.reshard",
+           "--journal", journal, "--ps", ",".join(addrs),
+           "--table", table_path, "--to", str(to)]
+    if die_at:
+        cmd += ["--die-at", die_at]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.update(env_extra or {})
+    return subprocess.run(cmd, env=env, capture_output=True, timeout=120)
+
+
+@pytest.mark.parametrize("die_at,expect_action", [
+    ("freeze", "resumed"),        # pre-publish: fence out + re-execute
+    ("drain", "republished"),     # post-publish: roll forward
+])
+def test_controller_killed_mid_migration_resumes_from_journal(
+        tmp_path, die_at, expect_action):
+    """The tentpole acceptance pin: a REAL controller process SIGKILLs
+    itself (faults `die` at the reshard.controller site) at a protocol
+    state, and a fresh controller resumes the SAME migration from the
+    durable journal — completing it, disarming every donor, and
+    preserving the counting identity."""
+    holders = [_holder() for _ in range(3)]
+    services = [_service(h) for h in holders]
+    from persia_tpu.service.ps_service import PsClient
+
+    clients = [PsClient(s.addr, circuit_breaker=False) for s in services]
+    for c in clients:
+        _arm(c)
+    table = RoutingTable.uniform(2, slots_per_replica=8)
+    worker = EmbeddingWorker(schema=_schema(2), ps_clients=clients[:2],
+                             routing=table)
+    journal = str(tmp_path / "journal")
+    rng = np.random.default_rng(5)
+    signs = rng.integers(0, 1 << 30, 2048, dtype=np.uint64)
+    ships = 0
+    feats = [_feature(f"slot_{i}", signs[i * 1024:(i + 1) * 1024])
+             for i in range(2)]
+    ref, out = worker.lookup_direct_training(feats)
+    worker.update_gradients(ref, {k: np.ones_like(v.embeddings)
+                                  for k, v in out.items()})
+    ships += 2 * 1024
+
+    proc = _drive_subprocess(journal, [c.addr for c in clients], table,
+                             to=3, die_at=die_at)
+    assert proc.returncode != 0, "driver should have died mid-migration"
+    st = MigrationJournal(journal).state()
+    assert st is not None and st["phase"] not in ("finalized", "aborted")
+
+    ctrl, action = ReshardController.resume(journal, clients,
+                                            workers=[worker])
+    assert action == expect_action
+    ctrl.finalize(drain_sec=0)
+    new_table = ctrl.table
+    assert new_table.epoch == table.epoch + 1
+    assert new_table.num_replicas == 3
+    assert worker.routing_epoch == new_table.epoch
+    assert MigrationJournal(journal).state()["phase"] == "finalized"
+    # every donor disarmed (no frozen-forever shard)
+    for c in clients:
+        assert c.reshard_status()["active"] is False
+    # counting identity at the new owners: no update lost across the
+    # kill + resume
+    applied = 0.0
+    for i, h in enumerate(holders):
+        rows = [(s, -float(vec[:d].sum()) / DIM)
+                for shard in h._shards
+                for s, (d, vec) in shard._map.items()]
+        if not rows:
+            continue
+        owners = new_table.replica_of(
+            np.array([s for s, _ in rows], np.uint64))
+        applied += sum(v for (_s, v), o in zip(rows, owners) if o == i)
+    assert abs(applied - ships) < 1e-3, (applied, ships)
+    # and training continues on the new topology
+    ref, out = worker.lookup_direct_training(feats)
+    worker.update_gradients(ref, {k: np.ones_like(v.embeddings)
+                                  for k, v in out.items()})
+    worker.close()
+    for s in services:
+        s.stop()
+
+
+def test_resume_noop_on_terminal_journal(tmp_path):
+    holders = [_holder() for _ in range(2)]
+    services = [_service(h) for h in holders]
+    from persia_tpu.service.ps_service import PsClient
+
+    clients = [PsClient(s.addr, circuit_breaker=False) for s in services]
+    for c in clients:
+        _arm(c)
+    table = RoutingTable.uniform(2, slots_per_replica=4)
+    worker = EmbeddingWorker(schema=_schema(2), ps_clients=clients,
+                             routing=table)
+    journal = str(tmp_path / "jr")
+    ctrl = ReshardController(clients, table, workers=[worker],
+                             journal_dir=journal)
+    new = ctrl.execute(table.derive(
+        (table.replica_of_slot + 1) % 2, 2))
+    ctrl.finalize(drain_sec=0)
+    ctrl2, action = ReshardController.resume(journal, clients,
+                                             workers=[worker])
+    assert action == "noop"
+    assert ctrl2.table == new
+    with pytest.raises(Exception):
+        ReshardController.resume(str(journal) + "_empty", clients)
+    worker.close()
+    for s in services:
+        s.stop()
+
+
+def test_fencing_rejects_superseded_controller():
+    """Fenced stale-controller calls arriving after a newer migration
+    began must be rejected — finish most critically (a late disarm
+    from a dead attempt would drop the live attempt's capture set)."""
+    holder = _holder()
+    svc = _service(holder)
+    from persia_tpu.rpc import RpcError
+    from persia_tpu.service.ps_service import PsClient
+
+    client = PsClient(svc.addr, circuit_breaker=False)
+    _arm(client)
+    t = RoutingTable.uniform(1, slots_per_replica=8)
+    client.lookup(np.arange(64, dtype=np.uint64), DIM, True)
+    # attempt (2, 0) arms; newer attempt (2, 1) takes over
+    client.reshard_begin([0], t.num_slots, epoch=2, fence=(2, 0),
+                         mig_id="mA")
+    client.reshard_begin([0], t.num_slots, epoch=2, fence=(2, 1),
+                         mig_id="mA")
+    st = client.reshard_status()
+    assert st["token"] == [2, 1]
+    # every verb of the superseded attempt bounces with the typed error
+    for call in (
+        lambda: client.reshard_finish(fence=(2, 0), mig_id="mA"),
+        lambda: client.reshard_freeze(epoch=2, fence=(2, 0)),
+        lambda: client.reshard_drain(fence=(2, 0)),
+        lambda: client.reshard_extract(16, fence=(2, 0)),
+        lambda: client.reshard_begin([0], t.num_slots, epoch=2,
+                                     fence=(2, 0), mig_id="mA"),
+        lambda: client.reshard_install(pack_rows([]), fence=(2, 0)),
+    ):
+        with pytest.raises(RpcError) as ei:
+            call()
+        assert is_reshard_fenced(ei.value) == (2, 1), ei.value
+    # the live attempt is untouched and still disarmable
+    assert client.reshard_status()["active"] is True
+    fin = client.reshard_finish(fence=(2, 1), mig_id="mA")
+    assert fin["was_active"] is True
+    # a NEWER epoch's migration (3, 0) fences out everything from 2
+    client.reshard_begin([1], t.num_slots, epoch=3, fence=(3, 0),
+                         mig_id="mB")
+    with pytest.raises(RpcError) as ei:
+        client.reshard_finish(fence=(2, 1))
+    assert is_reshard_fenced(ei.value) == (3, 0)
+    client.reshard_finish(fence=(3, 0))
+    svc.stop()
+
+
+def test_reshard_retries_are_idempotent():
+    """Retry-after-ambiguous-timeout safety: repeated begin (same
+    token) re-arms, repeated freeze is a no-op, repeated install
+    converges to the same rows, repeated finish answers
+    was_active=False."""
+    holder = _holder()
+    svc = _service(holder)
+    from persia_tpu.service.ps_service import PsClient
+
+    client = PsClient(svc.addr, circuit_breaker=False)
+    _arm(client)
+    t = RoutingTable.uniform(1, slots_per_replica=8)
+    signs = np.arange(256, dtype=np.uint64)
+    client.lookup(signs, DIM, True)
+    n1 = client.reshard_begin([0, 1], t.num_slots, epoch=2,
+                              fence=(2, 0), mig_id="m")
+    n2 = client.reshard_begin([0, 1], t.num_slots, epoch=2,
+                              fence=(2, 0), mig_id="m")
+    assert n1 == n2  # re-arm re-snapshots the same moving rows
+    client.reshard_freeze(epoch=2, fence=(2, 0))
+    client.reshard_freeze(epoch=2, fence=(2, 0))  # no-op, no error
+    assert client.reshard_status()["frozen"] is True
+    rows = [(int(s), DIM, np.full(2 * DIM, -3.0, np.float32))
+            for s in signs[:4]]
+    assert client.reshard_install(pack_rows(rows), fence=(2, 0)) == 4
+    assert client.reshard_install(pack_rows(rows), fence=(2, 0)) == 4
+    got = holder.get_entry(int(signs[0]))
+    np.testing.assert_array_equal(got[1], rows[0][2])
+    assert client.reshard_finish(fence=(2, 0))["was_active"] is True
+    assert client.reshard_finish(fence=(2, 0))["was_active"] is False
+    svc.stop()
+
+
+def test_freeze_lease_auto_thaws_dead_controllers_donor(monkeypatch):
+    """Donor self-healing: a controller that freezes and then vanishes
+    must not leave a frozen-forever shard — the lease expires, the
+    donor discards capture and serves the OLD epoch again, and the
+    metrics record the thaw."""
+    holder = _holder()
+    svc = _service(holder)
+    from persia_tpu.rpc import RpcError
+    from persia_tpu.service.ps_service import PsClient
+
+    client = PsClient(svc.addr, circuit_breaker=False)
+    _arm(client)
+    t = RoutingTable.uniform(1, slots_per_replica=4)
+    signs = np.arange(128, dtype=np.uint64)
+    client.lookup(signs, DIM, True)
+    moving = [int(s) for s in np.unique(t.slot_of(signs))]  # all slots
+    client.reshard_begin(moving, t.num_slots, epoch=2, fence=(2, 0),
+                         mig_id="m", lease_sec=0.4)
+    client.reshard_freeze(epoch=2, fence=(2, 0))
+    with pytest.raises(RpcError) as ei:
+        client.update_gradients(signs[:8], np.ones((8, DIM), np.float32),
+                                DIM)
+    assert is_routing_stale(ei.value) == 2
+    before = svc._c_lease_expired.value
+    deadline = time.monotonic() + 5.0
+    # no heartbeat arrives; the guard on the next write (the bounced
+    # writer's retry) trips the expiry
+    while time.monotonic() < deadline:
+        try:
+            client.update_gradients(signs[:8],
+                                    np.ones((8, DIM), np.float32), DIM)
+            break
+        except RpcError:
+            time.sleep(0.05)
+    else:
+        pytest.fail("donor never auto-thawed within 5s of lease expiry")
+    st = client.reshard_status()
+    assert st["active"] is False
+    assert svc._c_lease_expired.value == before + 1
+    # the dead controller's stragglers stay fenced out even after thaw
+    with pytest.raises(RpcError) as ei:
+        client.reshard_drain(fence=(1, 9))
+    assert is_reshard_fenced(ei.value) == (2, 0)
+    # ...and a resumed attempt (higher token) can re-begin
+    assert client.reshard_begin(moving, t.num_slots, epoch=2,
+                                fence=(2, 1), mig_id="m",
+                                lease_sec=30.0) >= 0
+    client.reshard_finish(fence=(2, 1))
+    svc.stop()
+
+
+def test_reshard_rpc_deadline_bounds_wedged_donor(monkeypatch):
+    """The __deadline__ satellite: once the controller arms
+    PERSIA_RESHARD_RPC_TIMEOUT_SEC, a wedged replica sheds the expired
+    reshard RPC (typed RpcDeadlineExceeded) instead of hanging the
+    migration; the knob off (0) keeps the legacy unbounded behavior
+    and an unarmed client never negotiates the probe."""
+    from persia_tpu import faults
+    from persia_tpu.rpc import RpcDeadlineExceeded
+    from persia_tpu.service.ps_service import PsClient, PsService
+
+    holder = _holder()
+    # serial dispatch: the injected recv delay must land AFTER the
+    # deadline slot is parsed for the shed check to see it expired
+    svc = PsService(holder, port=0, concurrent_streams=1)
+    svc.server.serve_background()
+    client = PsClient(svc.addr, circuit_breaker=False)
+    assert client.client.enable_deadline is False  # idle wire: no probe
+    monkeypatch.setenv("PERSIA_RESHARD_RPC_TIMEOUT_SEC", "0.05")
+    client.enable_reshard_deadline()
+    assert client.client.enable_deadline is True
+    try:
+        faults.add("rpc.server.recv", "delay", arg=0.25,
+                   method="reshard_status")
+        with pytest.raises(RpcDeadlineExceeded):
+            client.reshard_status(fence=(1, 0))
+    finally:
+        faults.reset_faults()
+    # non-reshard calls stay deadline-free (no default deadline)
+    assert client.lookup(np.arange(4, dtype=np.uint64), DIM,
+                         False).shape == (4, DIM)
+    svc.stop()
+
+
+# --- routing-edge races ------------------------------------------------------
+
+
+def test_double_epoch_bounce_settles_on_skipped_epoch():
+    """A writer bounced with min_epoch=N must settle when the fleet
+    publishes N+1 directly (two derive()s while it waited) — the wait
+    condition is >=, never ==."""
+    holders = [_holder() for _ in range(2)]
+    services = [_service(h) for h in holders]
+    from persia_tpu.service.ps_service import PsClient
+
+    clients = [PsClient(s.addr, circuit_breaker=False) for s in services]
+    for c in clients:
+        _arm(c)
+    t1 = RoutingTable.uniform(1, slots_per_replica=8)
+    worker = EmbeddingWorker(schema=_schema(1), ps_clients=clients[:1],
+                             routing=t1)
+    signs = np.arange(512, dtype=np.uint64)
+    feats = [_feature("slot_0", signs)]
+    ref, out = worker.lookup_direct_training(feats)
+    # freeze EVERY slot on donor 0 demanding epoch 2
+    clients[0].reshard_begin(list(range(t1.num_slots)), t1.num_slots,
+                             epoch=2, fence=(2, 0), mig_id="m")
+    # copy all rows over to replica 1 so the post-swap writes land on
+    # a replica that owns them
+    rows = []
+    for shard in holders[0]._shards:
+        for s, (d, vec) in list(shard._map.items()):
+            rows.append((int(s), d, vec.copy()))
+    clients[1].reshard_install(pack_rows(rows), fence=(2, 0))
+    clients[0].reshard_freeze(epoch=2, fence=(2, 0))
+
+    t2 = t1.derive(t1.replica_of_slot, 1)                    # epoch 2
+    t3 = t2.derive(np.ones(t1.num_slots, np.int32) * 0 + 1, 2)  # epoch 3
+
+    def publish_skipping():
+        time.sleep(0.3)
+        # the fleet jumps straight to epoch 3 (slots -> replica 1)
+        worker.apply_routing(t3, ps_clients=clients)
+        clients[0].reshard_finish(fence=(2, 0))
+
+    pub = threading.Thread(target=publish_skipping)
+    pub.start()
+    # bounced update: demands epoch 2, must settle under epoch 3
+    worker.update_gradients(ref, {"slot_0": np.ones(
+        (len(signs), DIM), np.float32)})
+    pub.join(timeout=10)
+    assert worker.routing_epoch == 3
+    # the update landed exactly once, on the NEW owner
+    applied = -sum(float(vec[:d].sum()) / DIM
+                   for shard in holders[1]._shards
+                   for _s, (d, vec) in shard._map.items())
+    assert abs(applied - len(signs)) < 1e-3, applied
+    worker.close()
+    for s in services:
+        s.stop()
+
+
+def test_gradient_return_across_epoch_resplits_by_live_table():
+    """A reshard cutting over between a batch's forward and its
+    gradient return must not ship by the cached forward split — the
+    moved signs would land on a donor whose capture already disarmed
+    and read back as lost updates (the chaos matrix's donor:cutover
+    forensic). The update path detects the epoch crossing and
+    re-splits by the live table."""
+    holders = [_holder() for _ in range(3)]
+    services = [_service(h) for h in holders]
+    from persia_tpu.service.ps_service import PsClient
+
+    clients = [PsClient(s.addr, circuit_breaker=False) for s in services]
+    for c in clients:
+        _arm(c)
+    t2 = RoutingTable.uniform(2, slots_per_replica=8)
+    worker = EmbeddingWorker(schema=_schema(2), ps_clients=clients[:2],
+                             routing=t2)
+    signs = np.arange(1024, dtype=np.uint64)
+    feats = [_feature(f"slot_{i}", signs[i * 512:(i + 1) * 512])
+             for i in range(2)]
+    ref, out = worker.lookup_direct_training(feats)  # split at epoch 1
+    # cutover lands mid-pipeline: move every slot to replica 2, and
+    # copy the rows over so the re-split update finds them there
+    rows = []
+    for h in holders[:2]:
+        for shard in h._shards:
+            for s, (d, vec) in list(shard._map.items()):
+                rows.append((int(s), d, vec.copy()))
+    clients[2].reshard_install(pack_rows(rows))
+    t3 = t2.derive(np.full(t2.num_slots, 2, np.int32), 3)
+    assert worker.apply_routing(t3, ps_clients=clients)
+    worker.update_gradients(ref, {k: np.ones_like(v.embeddings)
+                                  for k, v in out.items()})
+    # every update landed on the LIVE owner (replica 2), none on the
+    # disarmed donors' stale copies
+    applied_target = -sum(float(vec[:d].sum()) / DIM
+                          for shard in holders[2]._shards
+                          for _s, (d, vec) in shard._map.items())
+    assert abs(applied_target - 1024) < 1e-3, applied_target
+    for h in holders[:2]:
+        stale = -sum(float(vec[:d].sum()) / DIM
+                     for shard in h._shards
+                     for _s, (d, vec) in shard._map.items())
+        assert abs(stale) < 1e-3, stale
+    worker.close()
+    for s in services:
+        s.stop()
+
+
+def test_routing_holder_swap_under_reader_load():
+    """RoutingHolder hammer: concurrent table/prev reads, applies, and
+    window closes must never tear (prev must always be a table or None,
+    epochs monotone from the readers' view)."""
+    from persia_tpu.routing import RoutingHolder
+
+    t = RoutingTable.uniform(2, slots_per_replica=8)
+    holder = RoutingHolder(t)
+    stop = threading.Event()
+    errors = []
+
+    def reader():
+        last = 0
+        while not stop.is_set():
+            try:
+                tab = holder.table
+                assert tab.epoch >= last
+                last = tab.epoch
+                prev = holder.prev
+                if prev is not None:
+                    # (no ordering claim vs `tab`: two swaps may land
+                    # between the two unsynchronized reads)
+                    assert prev.num_slots == tab.num_slots
+                    assert prev.epoch < holder.table.epoch
+                _ = tab.replica_of(np.arange(16, dtype=np.uint64))
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                return
+
+    def closer():
+        while not stop.is_set():
+            holder.close_window()
+            time.sleep(0.001)
+
+    threads = [threading.Thread(target=reader) for _ in range(4)]
+    threads.append(threading.Thread(target=closer))
+    for th in threads:
+        th.start()
+    cur = t
+    rng = np.random.default_rng(0)
+    for _ in range(200):
+        cur = cur.derive(
+            rng.integers(0, 2, cur.num_slots).astype(np.int32), 2)
+        assert holder.apply(cur)
+        # duplicate + stale publishes are no-ops
+        assert holder.apply(cur) is False
+        assert holder.apply(t) is False
+    stop.set()
+    for th in threads:
+        th.join(timeout=10)
+    assert not errors, errors[:2]
+    assert holder.epoch == cur.epoch
+
+
+def test_operator_resumes_journaled_migration_on_restart(tmp_path):
+    """Operator-crash recovery: a restarted operator's first reconcile
+    scans the per-job migration journals and hands in-flight ones to
+    the driver under phase 'resume' (or records resume_pending without
+    a driver)."""
+    from persia_tpu.k8s_operator import FakeKubeApi, Operator
+
+    spec = {"jobName": "j", "image": "persia:latest",
+            "embeddingConfigPath": "/config/embedding_config.yml",
+            "roles": {"embeddingParameterServer": {"replicas": 2},
+                      "embeddingWorker": {"replicas": 1}}}
+    jdir = str(tmp_path / "journals")
+    t = RoutingTable.uniform(2, slots_per_replica=4)
+    t2 = t.derive(np.zeros(t.num_slots, np.int32), 1)
+    j = MigrationJournal(os.path.join(jdir, "j"))
+    j.append("plan", mig_id="m1", attempt=0, epoch=t2.epoch,
+             old_table=t.to_doc(), new_table=t2.to_doc(),
+             moves=[{"donor": 1, "target": 0, "slots": [1]}])
+    j.append("frozen", mig_id="m1", attempt=0, donor=1, slots=[1])
+
+    calls = []
+    op = Operator(FakeKubeApi(), [dict(spec, roles={
+        k: dict(v) for k, v in spec["roles"].items()})],
+        reshard_driver=lambda *a: calls.append(a),
+        reshard_journal_dir=jdir)
+    op.reconcile_all()
+    assert calls and calls[0][3] == "resume" and calls[0][2] == 1
+    assert op.reshard_events()[0]["status"] == "resumed"
+    # second pass does not re-fire the scan
+    op.reconcile_all()
+    assert len(calls) == 1
+    # driverless operator surfaces the wedged migration instead
+    op2 = Operator(FakeKubeApi(), [dict(spec, roles={
+        k: dict(v) for k, v in spec["roles"].items()})],
+        reshard_journal_dir=jdir)
+    op2.reconcile_all()
+    assert op2.reshard_events()[0]["status"] == "resume_pending"
+    # a finalized journal is quiet
+    j.append("finalized", mig_id="m1", attempt=0)
+    op3 = Operator(FakeKubeApi(), [dict(spec, roles={
+        k: dict(v) for k, v in spec["roles"].items()})],
+        reshard_journal_dir=jdir)
+    op3.reconcile_all()
+    assert op3.reshard_events() == []

@@ -96,6 +96,17 @@ while [ "$(date +%s)" -lt "$END" ]; do
       #     BENCH_reshard.json lands next to this log
       step "bench reshard (elastic PS tier)" python bench.py \
         --mode reshard --max-seconds 900
+      # 4i. crash-safe resharding (PR 12): the FULL actor×state kill
+      #     matrix — controller/donor/target SIGKILLed at copy/replay/
+      #     freeze/cutover/drain (journal resume, supervised-fleet
+      #     abort+retry, lease auto-thaw timing) — host-only; the
+      #     supervisor restart + inc-replay latencies on production-
+      #     class cores are the recovery numbers the runbook quotes;
+      #     BENCH_chaos_reshard.json lands next to this log
+      step "bench chaos-reshard (kill matrix)" python bench.py \
+        --mode chaos --chaos-reshard-only \
+        --chaos-reshard-out /root/repo/BENCH_chaos_reshard.json \
+        --max-seconds 1100
       # 5. re-capture the headline near the end of the window
       step "re-capture: python bench.py" python bench.py
       echo "$(date -u +%FT%TZ) chip sequence complete — see BENCH_CAPTURE_r05.log" >> "$LOG"
