@@ -20,6 +20,9 @@ federated view:
 - ``GET /fleet/alerts`` — the SLO engine's judgement
   (:mod:`persia_tpu.slos`): every rule, per service, with firing state.
 - ``GET /fleet/breaches`` — the bounded breach-event log.
+- ``GET /fleet/variants`` — the serving tier's variant topology merged
+  per variant (fleet-wide request totals, weight/status/default skew
+  detection — a half-landed variant_admin broadcast shows up here).
 
 **Resilience contract**: scraping is PULL-ONLY (a fleet monitor that is
 absent, down, or slow changes nothing about the services — no new wire
@@ -648,6 +651,64 @@ class FleetMonitor:
             "targets": targets,
         }
 
+    def fleet_variants(self) -> Dict:
+        """The multi-variant serving tier's control-plane view: every
+        serving replica's variant topology (ridden on its health doc),
+        merged per variant name with fleet-wide request totals —
+        plus skew detection: replicas disagreeing on a variant's
+        weight, status, or the default marker means a variant_admin
+        broadcast only half-landed (the operator's re-push signal,
+        like /fleet/routing's epoch_skew)."""
+        per_variant: Dict[str, Dict] = {}
+        replicas = []
+        for t in self.targets():
+            h = t.last_health or {}
+            variants = h.get("variants")
+            if variants is None:
+                continue
+            replicas.append({"service": t.service, "up": t.up,
+                             "variants": [v["name"] for v in variants],
+                             "default": next(
+                                 (v["name"] for v in variants
+                                  if v.get("default")), None)})
+            if not t.up:
+                continue
+            for v in variants:
+                agg = per_variant.setdefault(v["name"], {
+                    "name": v["name"], "replicas": 0, "requests": 0,
+                    "degraded": 0, "weights": set(), "statuses": set(),
+                    "default_on": 0})
+                agg["replicas"] += 1
+                agg["requests"] += int(v.get("requests", 0))
+                agg["degraded"] += int(v.get("degraded", 0))
+                agg["weights"].add(float(v.get("weight", 0.0)))
+                agg["statuses"].add(v.get("status", "live"))
+                agg["default_on"] += 1 if v.get("default") else 0
+        out = []
+        skew = False
+        n_serving = sum(1 for r in replicas if r["up"])
+        for name in sorted(per_variant):
+            agg = per_variant[name]
+            v_skew = (len(agg["weights"]) > 1
+                      or len(agg["statuses"]) > 1
+                      or agg["replicas"] != n_serving
+                      or agg["default_on"] not in (0, agg["replicas"]))
+            skew = skew or v_skew
+            out.append({
+                "name": name,
+                "replicas": agg["replicas"],
+                "requests": agg["requests"],
+                "degraded": agg["degraded"],
+                "weight": (sorted(agg["weights"])
+                           if len(agg["weights"]) > 1
+                           else next(iter(agg["weights"]))),
+                "status": sorted(agg["statuses"]),
+                "default": agg["default_on"] > 0,
+                "skew": v_skew,
+            })
+        return {"variants": out, "skew": skew,
+                "serving_replicas": replicas}
+
     def alerts(self, firing_only: bool = False) -> List[Dict]:
         return self.engine.alerts(firing_only=firing_only)
 
@@ -697,6 +758,8 @@ class FleetHttpServer:
                             mon.engine.breach_events()).encode()
                     elif url.path == "/fleet/routing":
                         body = json.dumps(mon.fleet_routing()).encode()
+                    elif url.path == "/fleet/variants":
+                        body = json.dumps(mon.fleet_variants()).encode()
                     elif url.path == "/fleet/hotness":
                         # ?hbm_gb= names the device-tier budget the
                         # capacity planner sizes against

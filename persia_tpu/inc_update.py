@@ -10,6 +10,13 @@ Re-design of rust/persia-incremental-update-manager/src/lib.rs:
 - **Infer side** (lib.rs:314-364): a scanner thread polls the directory,
   loads packets newer than the last applied one into the store, and
   tracks the sync delay.
+
+The packet-discovery conventions (done-marker visibility, name-sorted
+order, per-replica ``.inc`` files) live in :func:`ready_packets` /
+:func:`packet_files`, shared with the serving tier's online delta
+subscriber (:mod:`persia_tpu.online`) — one stream, two consumers:
+the infer PS hot-loads whole rows, the serving cache upserts resident
+hot rows directly.
 """
 
 import json
@@ -25,6 +32,45 @@ from persia_tpu.logger import get_default_logger
 _logger = get_default_logger(__name__)
 
 DONE_MARKER = "inc_update_done"
+
+
+def ready_packets(inc_dir: str, applied: Set[str]):
+    """Yield ``(name, pkt_dir, marker_info)`` for every COMPLETE packet
+    under ``inc_dir`` not already in ``applied``, in name order (names
+    sort by dump timestamp). The one packet-discovery convention shared
+    by the PS-side :class:`IncrementalUpdateLoader` and the serving-side
+    delta subscriber (:mod:`persia_tpu.online`) — a packet is visible
+    only once its done-marker exists (the dumper renames the whole
+    directory into place, so a partially-written packet is never
+    listed)."""
+    if not os.path.isdir(inc_dir):
+        return
+    for name in sorted(os.listdir(inc_dir)):
+        pkt_dir = os.path.join(inc_dir, name)
+        marker = os.path.join(pkt_dir, DONE_MARKER)
+        if (name in applied or not name.startswith("inc_")
+                or not os.path.exists(marker)):
+            continue
+        with open(marker) as f:
+            info = json.load(f)
+        yield name, pkt_dir, info
+
+
+def packet_files(pkt_dir: str):
+    """The ``(source_replica, path)`` pairs of one packet's ``.inc``
+    files, in replica order. The file stem IS the dumping replica's
+    index (the packet-name ``_r<replica>`` suffix repeats it) — the
+    routing-aware consumers key ownership filtering on it."""
+    out = []
+    for fn in sorted(os.listdir(pkt_dir)):
+        if not fn.endswith(".inc"):
+            continue
+        try:
+            replica = int(fn[:-len(".inc")])
+        except ValueError:
+            continue
+        out.append((replica, os.path.join(pkt_dir, fn)))
+    return out
 
 
 class IncrementalUpdateDumper:
@@ -202,23 +248,13 @@ class IncrementalUpdateLoader:
         """Apply any unapplied complete packets; returns entries loaded."""
         from persia_tpu.checkpoint import iter_psd_entries
 
-        if not os.path.isdir(self.inc_dir):
-            return 0
         loaded = 0
-        for name in sorted(os.listdir(self.inc_dir)):
-            pkt_dir = os.path.join(self.inc_dir, name)
-            marker = os.path.join(pkt_dir, DONE_MARKER)
-            if (name in self._applied or not name.startswith("inc_")
-                    or not os.path.exists(marker)):
-                continue
-            with open(marker) as f:
-                info = json.load(f)
+        for name, pkt_dir, info in ready_packets(self.inc_dir,
+                                                 self._applied):
             pkt_loaded = 0
-            for fn in sorted(os.listdir(pkt_dir)):
-                if not fn.endswith(".inc"):
-                    continue
+            for src, path in packet_files(pkt_dir):
                 if (self.routing is None and self.replica_index is not None
-                        and fn != f"{self.replica_index}.inc"):
+                        and src != self.replica_index):
                     continue
                 if self.routing is not None:
                     # ownership replay: read EVERY replica's file,
@@ -226,8 +262,7 @@ class IncrementalUpdateLoader:
                     # NEW table routes here — the filename filter
                     # encodes the old fleet's shard count and is
                     # wrong the moment it changes
-                    batch = list(iter_psd_entries(
-                        os.path.join(pkt_dir, fn)))
+                    batch = list(iter_psd_entries(path))
                     if not batch:
                         continue
                     owners = self.routing.replica_of(np.array(
@@ -238,8 +273,7 @@ class IncrementalUpdateLoader:
                         self.holder.set_entry(sign, dim, vec)
                         pkt_loaded += 1
                     continue
-                for sign, dim, vec in iter_psd_entries(
-                        os.path.join(pkt_dir, fn)):
+                for sign, dim, vec in iter_psd_entries(path):
                     self.holder.set_entry(sign, dim, vec)
                     pkt_loaded += 1
             loaded += pkt_loaded

@@ -146,7 +146,8 @@ class Operator:
 
     def __init__(self, api, job_specs: Optional[List[dict]] = None,
                  interval: float = 10.0, reshard_driver=None,
-                 reshard_journal_dir: Optional[str] = None):
+                 reshard_journal_dir: Optional[str] = None,
+                 variant_driver=None):
         self.api = api
         self.interval = interval
         # elastic-tier hook: ``reshard_driver(job_name, old, new,
@@ -172,6 +173,14 @@ class Operator:
         # attempt is handled once
         self._resumed_migs: set = set()
         self._reshard_events: List[dict] = []
+        # multi-variant serving hook: ``variant_driver(job_name, op,
+        # payload, spec)`` forwards a variant operation (add / remove /
+        # promote / weight / drain / resume) to the job's serving
+        # replicas — typically a variant_admin RPC broadcast. Without a
+        # driver the intent is recorded for an external controller,
+        # mirroring the reshard_driver convention.
+        self._variant_driver = variant_driver
+        self._variant_events: List[dict] = []
         self._jobs: Dict[str, dict] = {}
         # serializes reconcile passes against track/untrack (the REST
         # API mutates job state while the loop runs; without this a
@@ -336,6 +345,45 @@ class Operator:
             self._reshard_events.append(event)
         _logger.info("scale_ps %s: %d -> %d (%s)", job_name, old,
                      replicas, event["status"])
+        return event
+
+    # --- multi-variant serving (promote / rollback a variant) -----------
+
+    def variant_events(self) -> List[dict]:
+        with self._lock:
+            return list(self._variant_events)
+
+    def variant_op(self, job_name: str, op: str, payload: dict) -> dict:
+        """Forward a live variant operation to a job's serving tier
+        through the variant driver (``POST /variants`` lands here).
+        ``payload`` carries at least ``name`` (except for ``list``);
+        ``add`` additionally the model/dense-checkpoint fields the
+        serving ``variant_admin`` RPC expects. The event log is the
+        operator's audit trail — the promote/rollback runbook
+        (docs/DEPLOY.md) reads it back via ``GET /variants``."""
+        import time as _time
+
+        with self._lock:
+            spec = self._jobs.get(job_name)
+            if spec is None:
+                raise KeyError(f"job {job_name!r} is not tracked")
+        if op not in ("add", "remove", "promote", "weight", "drain",
+                      "resume", "list"):
+            raise ValueError(f"unknown variant op {op!r}")
+        event = {"job": job_name, "op": op,
+                 "variant": payload.get("name"),
+                 "time": _time.strftime("%Y-%m-%dT%H:%M:%S"),
+                 "status": "pending"}
+        if self._variant_driver is not None:
+            result = self._variant_driver(job_name, op, dict(payload),
+                                          spec)
+            event["status"] = "done"
+            if result is not None:
+                event["result"] = result
+        with self._lock:
+            self._variant_events.append(event)
+        _logger.info("variant_op %s: %s %s (%s)", job_name, op,
+                     payload.get("name"), event["status"])
         return event
 
     def resume_pending_reshards(self) -> List[dict]:
@@ -541,6 +589,8 @@ class SchedulingServer:
                         self._send(404, {"error": f"pod {pod!r} not found"})
                     elif route == "/reshards":
                         self._send(200, {"events": op.reshard_events()})
+                    elif route == "/variants":
+                        self._send(200, {"events": op.variant_events()})
                     else:
                         self._send(404, {"error": f"no route {route!r}"})
                 except Exception as e:  # surface as HTTP, keep serving
@@ -581,6 +631,24 @@ class SchedulingServer:
                         try:
                             event = op.scale_ps(req["jobName"],
                                                 int(req["psReplicas"]))
+                        except KeyError as e:
+                            self._send(404, {"error": repr(e)})
+                            return
+                        except ValueError as e:
+                            self._send(400, {"error": repr(e)})
+                            return
+                        self._send(200, event)
+                    elif route == "/variants":
+                        # multi-variant serving control: forward a live
+                        # add/remove/promote/weight/drain to the job's
+                        # serving replicas (see Operator.variant_op)
+                        n = int(self.headers.get("Content-Length", 0))
+                        req = json.loads(self.rfile.read(n))
+                        try:
+                            event = op.variant_op(
+                                req["jobName"], req["op"],
+                                {k: v for k, v in req.items()
+                                 if k not in ("jobName", "op")})
                         except KeyError as e:
                             self._send(404, {"error": repr(e)})
                             return

@@ -141,6 +141,23 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
        "Parameter-server replica count the worker binary expects."),
     _k("PERSIA_NUM_WORKERS", "int", 1,
        "Embedding-worker replica count (k8s manifests, examples)."),
+    _k("PERSIA_ONLINE_APPLY_BATCH_ROWS", "int", 8192,
+       "Rows per hot-row-cache delta-apply batch of the serving "
+       "online subscriber: each batch takes the cache lock once and "
+       "checks the write-rate governor once. Smaller batches bound "
+       "the per-apply predict stall; larger ones amortize the lock."),
+    _k("PERSIA_ONLINE_APPLY_ROWS_PER_SEC", "int", 500_000,
+       "Write-rate governor of the serving delta subscriber: a token "
+       "bucket (1s burst) over rows upserted into the hot-row cache, "
+       "so a training-tier flush burst spreads its applies instead of "
+       "convoying the predict path (the --mode online bench gates "
+       "serving p99 inflation at <= 3% with this armed). 0 = "
+       "unthrottled."),
+    _k("PERSIA_ONLINE_SCAN_SEC", "float", 2.0,
+       "Scan interval of the serving delta subscriber over the "
+       "incremental-update packet directory. Together with the "
+       "trainer's flush cadence this bounds sign-to-servable lag; "
+       "scans of an unchanged directory cost one listdir."),
     _k("PERSIA_POSTMORTEM_DIR", "str", None,
        "Where the fleet monitor / PS supervisor write breach and crash "
        "flight-recorder bundles. Unset = recorder disabled."),
@@ -287,6 +304,18 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
        "disabled path must cost nothing, so the gate is a module "
        "constant; tests toggle via subprocess env.",
        import_time_safe=True),
+    _k("PERSIA_VARIANT_ROUTE_FEATURE", "str", None,
+       "Field-based A/B routing for the serving tier: when set, a "
+       "plain predict derives its variant route key from this id "
+       "feature's first sign (e.g. the user-id slot — per-user-sticky "
+       "assignment with no client change). Unset keeps plain predicts "
+       "on the default variant. Read once at server construction."),
+    _k("PERSIA_VARIANT_SPLIT_BUCKETS", "int", 10000,
+       "Resolution of the deterministic weighted variant split: route "
+       "keys hash into this many buckets and variants own contiguous "
+       "weight-proportional ranges. 10000 buckets = 0.01% split "
+       "granularity; every serving replica computes the same "
+       "assignment for the same key."),
     _k("PERSIA_WORKER_STREAMING", "bool", True,
        "Embedding worker streaming data plane (scatter-per-completion "
        "lookups, ship-as-aggregated updates). `0` restores the "

@@ -75,7 +75,8 @@ class ObservabilityServer:
                  health_fn: Optional[Callable[[], Dict]] = None,
                  service: str = "persia",
                  refresh_fn: Optional[Callable[[], None]] = None,
-                 hotness_fn: Optional[Callable[[], Dict]] = None):
+                 hotness_fn: Optional[Callable[[], Dict]] = None,
+                 variants_fn: Optional[Callable[[], list]] = None):
         if registry is None:
             from persia_tpu.metrics import default_registry
 
@@ -96,6 +97,11 @@ class ObservabilityServer:
         # (persia_tpu.hotness format); None = this service has no
         # hotness source and /hotness answers the disabled marker
         self.hotness_fn = hotness_fn
+        # returns the serving tier's variant topology (the
+        # InferenceServer's per-variant doc list); None = not a
+        # variant-serving process and GET /variants answers the
+        # disabled marker
+        self.variants_fn = variants_fn
         self.service = service
         self._t0 = time.monotonic()
         sidecar = self
@@ -160,6 +166,9 @@ class ObservabilityServer:
                         body = json.dumps(
                             sidecar._hotness(full)).encode()
                         ctype = "application/json"
+                    elif url.path == "/variants":
+                        body = json.dumps(sidecar._variants()).encode()
+                        ctype = "application/json"
                     else:
                         self.send_error(404, "unknown path")
                         return
@@ -221,6 +230,17 @@ class ObservabilityServer:
                 else _hotness.disabled_snapshot())
         return snap if full else _hotness.summary_view(snap)
 
+    def _variants(self) -> Dict:
+        """``GET /variants``: the serving replica's live variant
+        topology (names, weights, default, status, per-variant request
+        counts) — what the operator's promote/rollback runbook and the
+        fleet monitor's /fleet/variants merge read. Non-serving
+        processes answer the disabled marker, so a scraper needs no
+        negotiation."""
+        if self.variants_fn is None:
+            return {"enabled": False, "variants": []}
+        return {"enabled": True, "variants": self.variants_fn()}
+
     FLIGHT_SPANS = 2048
     _FLIGHT_ENV_PREFIXES = ("PERSIA_", "REPLICA_", "JAX_")
 
@@ -266,7 +286,7 @@ class ObservabilityServer:
 
 def maybe_start(host: str, http_port: Optional[int], health_fn,
                 service: Optional[str] = None, refresh_fn=None,
-                hotness_fn=None):
+                hotness_fn=None, variants_fn=None):
     """The one sidecar-construction convention every service shares:
     ``None`` keeps the sidecar off (in-process test instances), any port
     number starts one (0 = ephemeral). Returns the started server or
@@ -280,7 +300,8 @@ def maybe_start(host: str, http_port: Optional[int], health_fn,
     return ObservabilityServer(host, http_port, health_fn=health_fn,
                                service=service,
                                refresh_fn=refresh_fn,
-                               hotness_fn=hotness_fn).start()
+                               hotness_fn=hotness_fn,
+                               variants_fn=variants_fn).start()
 
 
 def add_http_args(parser):

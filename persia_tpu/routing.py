@@ -299,6 +299,19 @@ class RoutingHolder:
             self._table = table
             return True
 
+    def window(self):
+        """``(table, prev)`` read atomically under the holder lock — a
+        concurrent :meth:`apply` swap can never hand out a torn pair
+        (e.g. the OLD table paired with itself as predecessor, which
+        would make an ownership filter reject the new owner's rows).
+        Same self-expiry rule as :attr:`prev`."""
+        with self._lock:
+            prev = self._prev
+            if prev is not None and _time.monotonic() >= self._prev_expiry:
+                self._prev = None
+                prev = None
+            return self._table, prev
+
     def close_window(self):
         """Drop the double-read predecessor (migration drain done)."""
         with self._lock:

@@ -43,6 +43,22 @@ fp16 on the serving->worker hop and the worker->PS lookups ride the
 negotiated PS codec — roughly half the row bytes per cache miss, with
 the decode keyed on response metadata so any legacy peer keeps fp32.
 
+Two further opt-in layers (both byte-identical-off, see
+docs/ARCHITECTURE.md "Online learning loop & variant serving"):
+
+- **Online delta subscription** (:meth:`InferenceServer.attach_delta_subscriber`
+  / ``--inc-dir``): the hot-row cache subscribes to the trainer's
+  incremental-update packet stream (:mod:`persia_tpu.online`) and
+  upserts resident rows in place — versioned, TTL-independent,
+  governed — making sign-to-servable latency a measured property
+  (``serving_sign_to_servable_lag_sec``) instead of a TTL bound.
+- **Multi-model variants** (:mod:`persia_tpu.variants`): N dense
+  models over ONE worker/cache/PS fleet, per-request routing
+  (explicit pin, route key, or a request field) through a
+  deterministic weighted split, per-variant metrics/health/SLO
+  isolation, and live add/remove/promote via the ``variant_admin``
+  RPC (the k8s operator's ``POST /variants`` fans it out).
+
 Serving counters use the reference's ``*_time_cost_sec`` metric style
 and are exported through :mod:`persia_tpu.metrics` (labeled per server
 port) plus a ``stats`` RPC for scrapers and ``bench.py --mode infer``.
@@ -199,15 +215,35 @@ def default_buckets(max_rows: int) -> Tuple[int, ...]:
 
 
 class HotRowCache:
-    """Read-only LRU of (dim, sign) -> embedding row with a TTL.
+    """LRU of (dim, sign) -> embedding row with a TTL and a version.
 
-    The serving path NEVER writes rows back, so the only consistency
-    question is staleness vs the training tier's incremental updates
-    (:mod:`persia_tpu.inc_update` hot-loads packets into the infer PS):
-    every entry expires ``ttl_sec`` after it was fetched, so a PS-side
-    update becomes visible after at most one TTL. Absent signs cache as
-    zero rows under the same TTL (the PS eval lookup's zero-fill),
-    which also bounds how long a not-yet-admitted sign serves zeros.
+    The predict path NEVER writes rows back; its only writer besides
+    the miss-fetch ``put`` is the online delta subscriber
+    (:mod:`persia_tpu.online`), which upserts RESIDENT rows in place
+    via :meth:`apply_delta`. Consistency contract:
+
+    - Every entry is a ``(row, expires, ver)`` tuple replaced
+      WHOLESALE under the cache lock — a concurrent :meth:`gather`
+      copies either the whole old row or the whole new row, never a
+      half-applied one (the row array itself is never mutated after
+      insertion).
+    - ``ver`` is stamped from a cache-wide counter bumped per delta
+      batch. A miss fetch snapshots :attr:`version` BEFORE its RPC and
+      hands it back to :meth:`put`: an entry whose ``ver`` advanced
+      past that snapshot was delta-upserted while the fetch was in
+      flight, and the (older) fetched row is discarded — a stale PS
+      read can never resurrect the pre-delta value.
+    - :meth:`apply_delta` refreshes the TTL stamp atomically with the
+      row (same tuple), so a delta-fresh row stays servable without
+      any TTL round trip, and it never inserts or promotes — no
+      eviction storms, no recency pollution from training bursts.
+
+    Without a subscriber, ``ver`` stays 0 everywhere and behavior is
+    exactly the PR-1 TTL cache: entries expire ``ttl_sec`` after their
+    fetch, bounding staleness vs the training tier at one TTL. Absent
+    signs cache as zero rows under the same TTL (the PS eval lookup's
+    zero-fill), which also bounds how long a not-yet-admitted sign
+    serves zeros.
     """
 
     def __init__(self, capacity: int, ttl_sec: float):
@@ -217,9 +253,18 @@ class HotRowCache:
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
+        self._ver = 0
+        self.delta_rows_applied = 0
 
     def __len__(self) -> int:
         return len(self._od)
+
+    @property
+    def version(self) -> int:
+        """The delta-apply counter (atomic int read). Miss paths
+        snapshot it BEFORE fetching so :meth:`put` can refuse to
+        overwrite rows a delta refreshed mid-flight."""
+        return self._ver
 
     def gather(self, signs: np.ndarray, dim: int,
                out: np.ndarray) -> np.ndarray:
@@ -240,18 +285,60 @@ class HotRowCache:
             self.misses += len(miss)
         return np.asarray(miss, np.int64)
 
-    def put(self, signs: np.ndarray, dim: int, rows: np.ndarray):
+    def put(self, signs: np.ndarray, dim: int, rows: np.ndarray,
+            seen_ver: Optional[int] = None):
+        """Install fetched rows. ``seen_ver`` (the :attr:`version`
+        snapshot taken before the fetch RPC) guards the fetch-vs-delta
+        race: any entry whose version advanced past the snapshot keeps
+        its delta-applied row — the fetch read the PS before the delta
+        landed and would roll the row back."""
         if self.capacity <= 0:
             return
         expires = time.monotonic() + self.ttl_sec
+        stamp = self._ver if seen_ver is None else int(seen_ver)
         with self._lock:
             od = self._od
             for s, row in zip(signs, rows):
                 key = (dim, int(s))
-                od[key] = (np.array(row, np.float32), expires)
+                if seen_ver is not None:
+                    cur = od.get(key)
+                    if cur is not None and cur[2] > seen_ver:
+                        # a delta upsert landed while this fetch was in
+                        # flight: the fetched row predates it
+                        od.move_to_end(key)
+                        continue
+                od[key] = (np.array(row, np.float32), expires, stamp)
                 od.move_to_end(key)
             while len(od) > self.capacity:
                 od.popitem(last=False)
+
+    def apply_delta(self, signs: np.ndarray, dim: int,
+                    rows: np.ndarray) -> int:
+        """Versioned in-place upsert of RESIDENT rows (the online
+        subscriber's entry point): each resident (dim, sign) entry is
+        replaced with a fresh ``(row, ttl-refreshed, new ver)`` tuple;
+        non-resident signs are ignored (a later miss fetches the fresh
+        row from the PS anyway). Never inserts, never evicts, never
+        changes recency order — a training burst cannot churn the hot
+        set. Returns rows applied."""
+        if self.capacity <= 0:
+            return 0
+        expires = time.monotonic() + self.ttl_sec
+        applied = 0
+        with self._lock:
+            self._ver += 1
+            ver = self._ver
+            od = self._od
+            for s, row in zip(signs, rows):
+                key = (dim, int(s))
+                if key in od:
+                    # assignment to an existing key keeps its LRU
+                    # position; the tuple swap (not an in-place array
+                    # write) is what makes concurrent gathers torn-free
+                    od[key] = (np.array(row, np.float32), expires, ver)
+                    applied += 1
+            self.delta_rows_applied += applied
+        return applied
 
     @property
     def hit_rate(self) -> float:
@@ -263,10 +350,15 @@ class HotRowCache:
 
 
 class _PendingRequest:
-    __slots__ = ("batch", "done", "pred", "error", "t_enqueue", "tctx")
+    __slots__ = ("batch", "done", "pred", "error", "t_enqueue", "tctx",
+                 "variant")
 
-    def __init__(self, batch: PersiaBatch):
+    def __init__(self, batch: PersiaBatch, variant: Optional[str] = None):
         self.batch = batch
+        # multi-variant serving: merged forwards are single-variant
+        # (the dense models differ), so the variant name joins the
+        # coalescing group key. None = the default variant.
+        self.variant = variant
         self.done = threading.Event()
         self.pred: Optional[np.ndarray] = None
         self.error: Optional[BaseException] = None
@@ -302,9 +394,9 @@ class _MicroBatcher:
             target=self._loop, daemon=True, name="infer-microbatcher")
         self._thread.start()
 
-    def submit(self, batch: PersiaBatch,
-               timeout: float = 120.0) -> np.ndarray:
-        req = _PendingRequest(batch)
+    def submit(self, batch: PersiaBatch, timeout: float = 120.0,
+               variant: Optional[str] = None) -> np.ndarray:
+        req = _PendingRequest(batch, variant)
         with self._cond:
             if not self._running:
                 raise RpcError("inference server is shutting down")
@@ -344,14 +436,18 @@ class _MicroBatcher:
                 # the linger released the lock; a timed-out submit()
                 # may have shed the last pending request meanwhile
                 return []
-            sig0 = _batch_signature(self._queue[0].batch)
+            # group key = (schema signature, variant): different dense
+            # models must never share one merged forward
+            sig0 = (_batch_signature(self._queue[0].batch),
+                    self._queue[0].variant)
             reqs: List[_PendingRequest] = []
             rows = 0
             while self._queue:
                 r = self._queue[0]
                 rb = r.batch.batch_size
                 if reqs and (rows + rb > min(self.max_rows, MAX_BATCH_SIZE)
-                             or _batch_signature(r.batch) != sig0):
+                             or (_batch_signature(r.batch),
+                                 r.variant) != sig0):
                     break  # stays queued for the next dispatch
                 reqs.append(self._queue.popleft())
                 rows += rb
@@ -400,6 +496,49 @@ _SERVER_SEQ = 0
 _SERVER_SEQ_LOCK = threading.Lock()
 
 
+def _model_zoo() -> dict:
+    """Name -> model class map shared by main() and the variant_admin
+    RPC's checkpoint-loading ``add``. Resolved lazily — the model
+    classes pull in flax/jax, which an RPC-only importer of this
+    module must not pay for."""
+    from persia_tpu.models import DCNv2, DLRM, DNN, DeepFM, WideAndDeep
+
+    return {"dnn": DNN, "dlrm": DLRM, "dcnv2": DCNv2, "deepfm": DeepFM,
+            "wide_deep": WideAndDeep}
+
+
+class _ServedVariant:
+    """Data-plane state of one registered variant: its InferCtx (own
+    jitted eval step + compiled-bucket set) and its isolated metric
+    series. The registry (``persia_tpu.variants``) holds the routing
+    truth; this holds what it takes to actually serve."""
+
+    __slots__ = ("name", "ctx", "m_requests", "m_rows", "t_e2e",
+                 "m_degraded", "m_zero_rows")
+
+    def __init__(self, name: str, ctx, reg, base_labels: dict):
+        self.name = name
+        self.ctx = ctx
+        labels = dict(base_labels, variant=name)
+        self.m_requests = reg.counter(
+            "inference_variant_requests_total", labels,
+            help_text="predict requests served per model variant")
+        self.m_rows = reg.counter(
+            "inference_variant_rows_total", labels,
+            help_text="prediction rows served per model variant")
+        self.t_e2e = reg.histogram(
+            "inference_variant_request_time_cost_sec", labels,
+            help_text="end-to-end predict latency per model variant")
+        self.m_degraded = reg.counter(
+            "inference_variant_degraded_total", labels,
+            help_text="predicts of this variant that served zero-vector "
+                      "embedding fallback for some signs")
+        self.m_zero_rows = reg.counter(
+            "inference_variant_zero_rows_total", labels,
+            help_text="embedding rows zero-filled for this variant's "
+                      "predicts while the embedding tier was degraded")
+
+
 class InferenceServer:
     """RPC predict server over an InferCtx.
 
@@ -427,6 +566,7 @@ class InferenceServer:
         concurrent_streams: Optional[int] = None,
         http_port: Optional[int] = None,
         degraded_fallback: bool = True,
+        variant_name: str = "default",
     ):
         # Opt-in contract: a default (serialized) server keeps the
         # legacy thread-per-connection RPC loop with NO shared-pool cap
@@ -449,6 +589,7 @@ class InferenceServer:
             worker.schema = schema
         self.worker = worker
         self.schema = schema
+        self.model = model
         self.ctx = InferCtx(model, state, schema, worker)
         # concurrent_streams lets ONE pipelined client connection keep
         # many predicts in flight (rpc.py read-ahead) — without it the
@@ -458,6 +599,12 @@ class InferenceServer:
         self.server.register("predict", self._predict)
         self.server.register("health", lambda p: b"ok")
         self.server.register("stats", self._stats)
+        # multi-variant surface: plain methods on the request plane —
+        # nothing rides the envelope, so a fleet that never registers a
+        # second variant keeps a byte-identical wire (nobody calls
+        # these; pinned via served-request counts in --mode online)
+        self.server.register("predict_variant", self._predict_variant)
+        self.server.register("variant_admin", self._variant_admin)
 
         self.max_batch_rows = min(int(max_batch_rows), MAX_BATCH_SIZE)
         if self.max_batch_rows > 0:
@@ -511,10 +658,40 @@ class InferenceServer:
                                        labels)
         self._m_zero_rows = reg.counter(
             "inference_zero_fallback_rows_total", labels)
+        # --- multi-variant layer (persia_tpu.variants): the boot model
+        # is the first — and default — variant; plain `predict` serves
+        # it through exactly the pre-variant path, so a server nobody
+        # registers a second variant on behaves (and speaks) like the
+        # single-model server it replaces.
+        from persia_tpu.variants import VariantRegistry
+
+        self._reg = reg
+        self._metric_labels = labels
+        self.variants = VariantRegistry()
+        self.variants.add(variant_name, weight=1.0, default=True,
+                          meta={"source": "boot"})
+        # name -> _ServedVariant; mutated only under _variants_lock
+        # (admin RPCs), read lock-free on the predict path (dict get is
+        # atomic; a racing remove surfaces as a clean request error)
+        self._variants_lock = threading.Lock()
+        self._served_variants: Dict[str, _ServedVariant] = {
+            variant_name: _ServedVariant(variant_name, self.ctx, reg,
+                                         labels)}
+        # request-field variant routing: when set, a plain predict
+        # derives its A/B route key from this id feature's first sign
+        # (frozen at server construction — per-request env reads have
+        # no place on the predict hot path)
+        self._route_feature = knobs.get("PERSIA_VARIANT_ROUTE_FEATURE")
+        # online delta subscriber (persia_tpu.online), armed explicitly
+        # via attach_delta_subscriber — None means the PR-13 TTL-only
+        # freshness contract
+        self.online = None
         # observability sidecar (see PsService): /metrics /healthz /trace
         from persia_tpu import obs_http
 
-        self.http = obs_http.maybe_start(host, http_port, self._healthz)
+        self.http = obs_http.maybe_start(
+            host, http_port, self._healthz,
+            variants_fn=self._variants_doc)
 
     def _healthz(self) -> dict:
         doc = self.server.health()
@@ -532,6 +709,15 @@ class InferenceServer:
             doc["cache_ttl_sec"] = self.cache.ttl_sec
         doc["requests_total"] = self._m_requests.value
         doc["degraded_lookups_total"] = self._m_degraded.value
+        # online-learning freshness, PER SERVING REPLICA (the satellite
+        # contract): the attached subscriber's stall clock + last
+        # packet seq let serving_freshness_stale fire for THIS replica,
+        # not just for a PS loader somewhere else in the fleet
+        if self.online is not None:
+            doc["online"] = self.online.health()
+        # the variant topology rides every health doc (fleet.py's
+        # /fleet/variants merges these across the serving tier)
+        doc["variants"] = self._variants_doc()
         # elastic-tier observable: which routing epoch the embedding
         # fetch path splits by (an in-process EmbeddingWorker exposes
         # it; a RemoteEmbeddingWorker's replicas report their own)
@@ -548,21 +734,184 @@ class InferenceServer:
     def addr(self) -> str:
         return self.server.addr
 
+    # --- variant control plane -------------------------------------------
+
+    def add_variant(self, name: str, model=None, state=None,
+                    weight: float = 0.0, default: bool = False,
+                    meta: Optional[dict] = None):
+        """Register a live variant: its own dense model/state (and
+        jitted eval step), the SAME worker/cache/PS fleet. ``model``
+        defaults to the boot model class instance (A/B of two dense
+        checkpoints over one architecture, the common case)."""
+        if state is None:
+            raise ValueError("a variant needs its own dense state")
+        ctx = InferCtx(model if model is not None else self.model,
+                       state, self.schema, self.worker)
+        with self._variants_lock:
+            self.variants.add(name, weight=weight, default=default,
+                              meta=meta)
+            self._served_variants[name] = _ServedVariant(
+                name, ctx, self._reg, self._metric_labels)
+        _logger.info("variant %r registered (weight=%s default=%s)",
+                     name, weight, default)
+
+    def add_variant_from_checkpoint(self, name: str, model_name: str,
+                                    dense_checkpoint: str,
+                                    num_dense: int = 5,
+                                    weight: float = 0.0,
+                                    default: bool = False):
+        """The operator-facing add: model zoo name + dense checkpoint
+        path (what ``variant_admin`` / ``POST /variants`` carry)."""
+        model = _model_zoo()[model_name]()
+        state = load_dense_state(model, self.schema, num_dense,
+                                 dense_checkpoint)
+        self.add_variant(name, model=model, state=state, weight=weight,
+                         default=default,
+                         meta={"model": model_name,
+                               "dense_checkpoint": dense_checkpoint})
+
+    def remove_variant(self, name: str):
+        with self._variants_lock:
+            self.variants.remove(name)  # validates (default protected)
+            self._served_variants.pop(name, None)
+        _logger.info("variant %r removed", name)
+
+    def promote_variant(self, name: str):
+        """Make ``name`` the default (what plain ``predict`` serves) —
+        the canary-promote / rollback primitive. The serving context
+        must exist; the registry flips atomically, so in-flight
+        requests finish on whichever variant they resolved."""
+        if name not in self._served_variants:
+            raise KeyError(f"variant {name!r} has no serving context")
+        self.variants.promote(name)
+        _logger.info("variant %r promoted to default", name)
+
+    def _variants_doc(self) -> list:
+        docs = self.variants.describe()
+        for d in docs:
+            sv = self._served_variants.get(d["name"])
+            if sv is not None:
+                d["requests"] = sv.m_requests.value
+                d["rows"] = sv.m_rows.value
+                d["degraded"] = sv.m_degraded.value
+                d["compiled_buckets"] = sorted(
+                    sv.ctx.eval_batch_rows_seen)
+        return docs
+
+    def _variant_admin(self, payload: bytes) -> bytes:
+        """Live variant add/remove/promote/weight/drain — the RPC the
+        k8s operator's ``POST /variants`` forwards to every serving
+        replica (docs/DEPLOY.md runbook)."""
+        req = msgpack.unpackb(payload, raw=False)
+        op = req.get("op")
+        if op == "list":
+            return msgpack.packb({"variants": self._variants_doc()})
+        name = req["name"]
+        if op == "add":
+            self.add_variant_from_checkpoint(
+                name, req.get("model", "dnn"), req["dense_checkpoint"],
+                num_dense=int(req.get("num_dense", 5)),
+                weight=float(req.get("weight", 0.0)),
+                default=bool(req.get("default", False)))
+        elif op == "remove":
+            self.remove_variant(name)
+        elif op == "promote":
+            self.promote_variant(name)
+        elif op == "weight":
+            self.variants.set_weight(name, float(req["weight"]))
+        elif op == "drain":
+            self.variants.set_status(name, "draining")
+        elif op == "resume":
+            self.variants.set_status(name, "live")
+        else:
+            raise RpcError(f"unknown variant_admin op {op!r}")
+        return msgpack.packb({"ok": True,
+                              "variants": self._variants_doc()})
+
+    # --- online delta subscription ---------------------------------------
+
+    def attach_delta_subscriber(self, inc_dir: str, **kw):
+        """Close the online-learning loop: subscribe this server's
+        hot-row cache to the trainer's incremental-update packet
+        stream (persia_tpu.online.DeltaSubscriber). Routing awareness
+        defaults to the in-process worker's live table when it has one
+        (reshard epochs re-route the ownership filter automatically);
+        a remote-worker server passes ``routing_fn`` explicitly or
+        runs unfiltered."""
+        from persia_tpu.online import DeltaSubscriber
+
+        if self.cache is None:
+            raise ValueError(
+                "delta subscription upserts the hot-row cache; start "
+                "the server with cache_rows > 0")
+        if self.online is not None:
+            raise RuntimeError("a delta subscriber is already attached")
+        if "routing_fn" not in kw and hasattr(self.worker,
+                                              "routing_window"):
+            kw["routing_fn"] = lambda: self.worker.routing_window
+        self.online = DeltaSubscriber(self.cache, inc_dir, **kw).start()
+        _logger.info("delta subscriber attached to %s (scan=%.2fs)",
+                     inc_dir, self.online.scan_interval_sec)
+        return self.online
+
     # --- predict paths ---------------------------------------------------
 
+    def _route_key_from_batch(self, batch: PersiaBatch) -> Optional[bytes]:
+        """Field-based A/B routing (PERSIA_VARIANT_ROUTE_FEATURE): the
+        named id feature's first sign is the request's route key — a
+        user-id slot gives per-user-sticky variant assignment without
+        any client change."""
+        if self._route_feature is None or len(self.variants) <= 1:
+            return None
+        for f in batch.id_type_features:
+            if f.name == self._route_feature and len(f.signs):
+                return int(f.signs[0]).to_bytes(8, "little")
+        return None
+
     def _predict(self, payload: bytes) -> bytes:
+        # the legacy single-model wire: request = PersiaBatch bytes,
+        # response = pack_arrays({}, [pred]) — BYTE-IDENTICAL to the
+        # pre-variant server (no meta, no routing work) unless the
+        # operator registers more variants / arms the route feature
+        return self._serve(payload, None, None, reply_variant=False)
+
+    def _predict_variant(self, payload: bytes) -> bytes:
+        """Variant-addressed predict: msgpack ``{v: explicit variant |
+        None, k: route key bytes | None, b: PersiaBatch bytes}``; the
+        response meta names the variant that served."""
+        req = msgpack.unpackb(payload, raw=False)
+        return self._serve(req["b"], req.get("v"), req.get("k"),
+                           reply_variant=True)
+
+    def _serve(self, payload: bytes, explicit: Optional[str],
+               key: Optional[bytes], reply_variant: bool) -> bytes:
         t0 = time.perf_counter()
         with tracing.span("serving/predict"):
             batch = PersiaBatch.from_bytes(payload)
+            if explicit is None and key is None:
+                key = self._route_key_from_batch(batch)
+            try:
+                vname = self.variants.route(key=key, explicit=explicit)
+            except KeyError as e:
+                raise RpcError(str(e))
+            sv = self._served_variants.get(vname)
+            if sv is None:
+                raise RpcError(
+                    f"variant {vname!r} has no serving context")
             self._m_requests.inc()
+            sv.m_requests.inc()
             if self._batcher is not None:
-                pred = self._batcher.submit(batch)
+                pred = self._batcher.submit(batch, variant=vname)
             else:
-                pred = self._forward(batch)
+                pred = self._forward(batch, sv)
                 self._m_batches.inc()
                 self._m_rows.inc(batch.batch_size)
-        self._t_e2e.observe(time.perf_counter() - t0)
-        return pack_arrays({}, [np.ascontiguousarray(pred)])
+                sv.m_rows.inc(batch.batch_size)
+        dt = time.perf_counter() - t0
+        self._t_e2e.observe(dt)
+        sv.t_e2e.observe(dt)
+        meta = {"variant": vname} if reply_variant else {}
+        return pack_arrays(meta, [np.ascontiguousarray(pred)])
 
     def _bucket_for(self, rows: int) -> int:
         for b in self.buckets:
@@ -572,10 +921,18 @@ class InferenceServer:
 
     def _run_merged(self, reqs: List[_PendingRequest]):
         """Dispatcher entry: merge -> pad to bucket -> one lookup + one
-        jitted forward -> scatter per-request row slices."""
+        jitted forward -> scatter per-request row slices. The collect
+        loop groups by (signature, variant), so a merged batch is
+        single-variant by construction."""
         now = time.perf_counter()
         for r in reqs:
             self._t_queue.observe(now - r.t_enqueue)
+        sv = None
+        if reqs[0].variant is not None:
+            sv = self._served_variants.get(reqs[0].variant)
+            if sv is None:
+                raise RpcError(
+                    f"variant {reqs[0].variant!r} removed mid-flight")
         tctx = next((r.tctx for r in reqs if r.tctx is not None), None)
         kw = {"ctx": tctx} if tctx is not None else {}
         with tracing.span("serving/merged_forward", n_reqs=len(reqs), **kw):
@@ -583,26 +940,32 @@ class InferenceServer:
             rows = merged.batch_size
             bucket = self._bucket_for(rows)
             padded = pad_batch(merged, bucket)
-            pred = self._forward(padded)
+            pred = self._forward(padded, sv)
         self._m_batches.inc()
         self._m_rows.inc(rows)
         self._m_padded.inc(bucket - rows)
+        if sv is not None:
+            sv.m_rows.inc(rows)
         off = 0
         for r, s in zip(reqs, sizes):
             r.pred = pred[off:off + s]
             off += s
             r.done.set()
 
-    def _forward(self, batch: PersiaBatch) -> np.ndarray:
+    def _forward(self, batch: PersiaBatch,
+                 sv: Optional[_ServedVariant] = None) -> np.ndarray:
+        if sv is None:
+            sv = self._served_variants[self.variants.default]
         with self._t_lookup.timer(), tracing.span("serving/lookup"):
-            lookup = self._lookup(batch.id_type_features)
+            lookup = self._lookup(batch.id_type_features, sv)
         with self._t_forward.timer(), tracing.span("serving/forward"):
-            pred, _labels = self.ctx.forward_prepared(batch, lookup)
+            pred, _labels = sv.ctx.forward_prepared(batch, lookup)
             return np.asarray(pred)
 
     # --- cached lookup path ----------------------------------------------
 
-    def _lookup(self, id_type_features: List[IDTypeFeature]):
+    def _lookup(self, id_type_features: List[IDTypeFeature],
+                sv: Optional[_ServedVariant] = None):
         if self.cache is None:
             try:
                 return self.worker.lookup_direct(id_type_features,
@@ -610,10 +973,21 @@ class InferenceServer:
             except DEGRADABLE_ERRORS as e:
                 if not self.degraded_fallback:
                     raise
-                return self._zero_lookup(id_type_features, e)
-        return self._lookup_cached(id_type_features)
+                return self._zero_lookup(id_type_features, e, sv)
+        return self._lookup_cached(id_type_features, sv)
 
-    def _zero_lookup(self, id_type_features: List[IDTypeFeature], cause):
+    def _count_degraded(self, sv: Optional[_ServedVariant], rows: int):
+        self._m_degraded.inc()
+        self._m_zero_rows.inc(rows)
+        if sv is not None:
+            # per-variant isolation: degraded service is attributed to
+            # the variant whose predict paid it (the by-variant SLO
+            # rule reads these)
+            sv.m_degraded.inc()
+            sv.m_zero_rows.inc(rows)
+
+    def _zero_lookup(self, id_type_features: List[IDTypeFeature], cause,
+                     sv: Optional[_ServedVariant] = None):
         """Whole-lookup degradation (no cache to salvage hits from):
         preprocess locally — the same transforms the worker would run,
         so shapes are identical — and zero-fill every embedding row.
@@ -629,14 +1003,14 @@ class InferenceServer:
             mat = np.zeros((f.num_distinct, slot.dim), np.float32)
             rows += f.num_distinct
             out[f.name] = mw.postprocess_feature(f, slot, mat)
-        self._m_degraded.inc()
-        self._m_zero_rows.inc(rows)
+        self._count_degraded(sv, rows)
         _logger.warning("degraded predict: embedding tier unreachable "
                         "(%s); %d rows served as zero vectors", cause,
                         rows)
         return out
 
-    def _lookup_cached(self, id_type_features: List[IDTypeFeature]):
+    def _lookup_cached(self, id_type_features: List[IDTypeFeature],
+                       sv: Optional[_ServedVariant] = None):
         """Preprocess locally (the same dedup/hashstack/prefix transforms
         the worker runs, so cache keys are post-transform signs — the
         exact PS keyspace inc_update writes), serve distinct signs from
@@ -646,6 +1020,11 @@ class InferenceServer:
         from persia_tpu.worker import middleware as mw
 
         feats = mw.preprocess_batch(id_type_features, self.schema)
+        # version snapshot BEFORE any miss fetch: a delta upsert landing
+        # while the RPC is in flight advances the cache version past
+        # this, and put() then refuses to roll the row back to the
+        # older PS read (the stale-slot resurrection guard)
+        seen_ver = self.cache.version
         mats: List[np.ndarray] = []
         misses: Dict[int, list] = {}
         for f in feats:
@@ -669,13 +1048,12 @@ class InferenceServer:
                 # keep their real embeddings — only the unreachable
                 # replica's share degrades. Zero rows are NOT cached,
                 # so the first post-recovery request refetches.
-                self._m_degraded.inc()
-                self._m_zero_rows.inc(len(all_signs))
+                self._count_degraded(sv, len(all_signs))
                 _logger.warning(
                     "degraded lookup (dim=%d): %d miss rows served as "
                     "zero vectors (%s)", dim, len(all_signs), e)
                 continue
-            self.cache.put(uniq, dim, rows)
+            self.cache.put(uniq, dim, rows, seen_ver=seen_ver)
             pos = 0
             for mat, miss_pos, s in parts:
                 mat[miss_pos] = rows[inverse[pos:pos + len(s)]]
@@ -713,7 +1091,13 @@ class InferenceServer:
             d.update(cache_hit_rate=self.cache.hit_rate,
                      cache_hits=self.cache.hits,
                      cache_misses=self.cache.misses,
-                     cache_rows_resident=len(self.cache))
+                     cache_rows_resident=len(self.cache),
+                     cache_delta_rows_applied=(
+                         self.cache.delta_rows_applied))
+        if len(self.variants) > 1:
+            d["variants"] = self._variants_doc()
+        if self.online is not None:
+            d["online"] = self.online.health()
         return msgpack.packb(d)
 
     # --- lifecycle -------------------------------------------------------
@@ -735,6 +1119,8 @@ class InferenceServer:
         self.server.stop()
         if self._batcher is not None:
             self._batcher.close()
+        if self.online is not None:
+            self.online.stop()
         if self.http is not None:
             self.http.stop()
 
@@ -749,6 +1135,26 @@ class InferenceClient:
     def predict_bytes(self, payload: bytes) -> np.ndarray:
         _, (pred,) = unpack_arrays(self.client.call("predict", payload))
         return pred
+
+    def predict_variant(self, batch, variant: Optional[str] = None,
+                        key: Optional[bytes] = None):
+        """Variant-addressed predict: pin a variant explicitly, or hand
+        a route key to the server's deterministic weighted split.
+        Returns ``(pred, served_variant_name)``."""
+        payload = batch if isinstance(batch, (bytes, bytearray)) \
+            else batch.to_bytes()
+        req = msgpack.packb(
+            {"v": variant, "k": bytes(key) if key is not None else None,
+             "b": bytes(payload)}, use_bin_type=True)
+        meta, (pred,) = unpack_arrays(
+            self.client.call("predict_variant", req))
+        return pred, meta.get("variant")
+
+    def variant_admin(self, op: str, **kw) -> dict:
+        """Live variant control: ``op`` in add | remove | promote |
+        weight | drain | resume | list (see InferenceServer
+        ``_variant_admin``)."""
+        return self.client.call_msg("variant_admin", op=op, **kw)
 
     def predict_many(self, batches: Sequence) -> List[np.ndarray]:
         """Pipelined predicts on one connection (rpc.py ``call_many``):
@@ -835,10 +1241,7 @@ def main(argv=None):
 
         jax.config.update("jax_platforms", forced)
 
-    from persia_tpu.models import DCNv2, DLRM, DNN, DeepFM, WideAndDeep
-
-    zoo = {"dnn": DNN, "dlrm": DLRM, "dcnv2": DCNv2, "deepfm": DeepFM,
-           "wide_deep": WideAndDeep}
+    zoo = _model_zoo()
     p = argparse.ArgumentParser(prog="persia-tpu-serving")
     p.add_argument("--model", choices=sorted(zoo), default="dnn")
     p.add_argument("--dense-checkpoint", required=True,
@@ -866,6 +1269,24 @@ def main(argv=None):
                    help="hot-row LRU capacity (0 = no cache)")
     p.add_argument("--cache-ttl-sec", type=float, default=30.0,
                    help="hot-row TTL; bounds staleness vs inc_update")
+    p.add_argument("--inc-dir", default=None,
+                   help="attach the online delta subscriber to this "
+                        "incremental-update packet directory (the "
+                        "trainer PS tier's inc_dir): trained rows "
+                        "upsert the hot-row cache in place instead of "
+                        "waiting out the TTL. Requires --cache-rows")
+    p.add_argument("--online-scan-sec", type=float, default=None,
+                   help="delta-subscriber scan interval "
+                        "(default PERSIA_ONLINE_SCAN_SEC)")
+    p.add_argument("--variant", action="append", default=[],
+                   metavar="NAME=WEIGHT:MODEL:DENSE_CKPT[:default]",
+                   help="register an extra serving variant at boot "
+                        "(repeatable); more can be added live via the "
+                        "variant_admin RPC / the operator's "
+                        "POST /variants")
+    p.add_argument("--variant-name", default="default",
+                   help="name of the boot model's variant (the default "
+                        "unless a --variant entry claims it)")
     p.add_argument("--no-degraded-fallback", action="store_true",
                    help="fail predicts when the embedding tier is "
                         "unreachable instead of serving zero-vector "
@@ -902,7 +1323,24 @@ def main(argv=None):
                              cache_rows=args.cache_rows,
                              cache_ttl_sec=args.cache_ttl_sec,
                              http_port=obs_http.port_from_args(args),
-                             degraded_fallback=not args.no_degraded_fallback)
+                             degraded_fallback=not args.no_degraded_fallback,
+                             variant_name=args.variant_name)
+    for spec in args.variant:
+        # NAME=WEIGHT:MODEL:DENSE_CKPT[:default]
+        name, _, rest = spec.partition("=")
+        parts = rest.split(":")
+        if len(parts) < 3:
+            p.error(f"--variant {spec!r}: expected "
+                    "NAME=WEIGHT:MODEL:DENSE_CKPT[:default]")
+        server.add_variant_from_checkpoint(
+            name, parts[1], parts[2], num_dense=args.num_dense,
+            weight=float(parts[0]),
+            default=len(parts) > 3 and parts[3] == "default")
+    if args.inc_dir:
+        kw = {}
+        if args.online_scan_sec is not None:
+            kw["scan_interval_sec"] = args.online_scan_sec
+        server.attach_delta_subscriber(args.inc_dir, **kw)
     obs_http.write_addr_file_from_args(server.http, args)
     if args.coordinator:
         from persia_tpu.service.coordinator import (

@@ -67,7 +67,8 @@ class SloRule:
     def __init__(self, name: str, expr: str, op: str, threshold: float,
                  window_sec: float = 60.0, for_sec: float = 0.0,
                  service: Optional[str] = None, scope: str = "service",
-                 severity: str = "page", description: str = ""):
+                 severity: str = "page", description: str = "",
+                 by_label: Optional[str] = None):
         m = _EXPR_RE.match(expr)
         if m is None:
             raise ValueError(f"rule {name!r}: bad expression {expr!r}")
@@ -92,6 +93,15 @@ class SloRule:
         self.scope = scope
         self.severity = severity
         self.description = description
+        # per-label-value evaluation (the multi-variant serving tier's
+        # isolation contract): the rule evaluates once PER VALUE of
+        # this label — e.g. by_label="variant" judges every model
+        # variant's series separately, so one broken canary fires its
+        # own alert instead of hiding inside the service aggregate
+        if by_label is not None and scope == "fleet":
+            raise ValueError(f"rule {name!r}: by_label needs "
+                             "service scope")
+        self.by_label = by_label
 
     @classmethod
     def from_dict(cls, d: Dict) -> "SloRule":
@@ -103,6 +113,7 @@ class SloRule:
             service=d.get("service"), scope=d.get("scope", "service"),
             severity=d.get("severity", "page"),
             description=d.get("description", ""),
+            by_label=d.get("by_label"),
         )
 
     def matches(self, service: str) -> bool:
@@ -115,7 +126,8 @@ class SloRule:
                 "window_sec": self.window_sec, "for_sec": self.for_sec,
                 "service": self.service, "scope": self.scope,
                 "severity": self.severity,
-                "description": self.description}
+                "description": self.description,
+                "by_label": self.by_label}
 
 
 def load_rules(path: str) -> List[SloRule]:
@@ -224,6 +236,31 @@ def default_rules() -> List[SloRule]:
                             "minutes — write traffic into the moving "
                             "slots outruns the drain; shrink the move "
                             "batch or reshard off-peak"),
+        # online-learning loop objectives (both no-data until a serving
+        # delta subscriber exports its series, so TTL-only fleets never
+        # page on them). The stall clock itself is covered by
+        # serving_freshness_stale above: the subscriber exports the
+        # SAME inc_update_sec_since_last_apply name, so that rule now
+        # fires per serving replica too.
+        SloRule("serving_sign_to_servable_slow",
+                "p99(serving_sign_to_servable_lag_sec)",
+                ">", 60.0, window_sec=300.0, severity="ticket",
+                description="online-learning freshness p99 above 60s — "
+                            "trained rows are taking over a minute to "
+                            "become servable (scan interval too slow, "
+                            "governor throttling hard, or the dumper's "
+                            "flush cadence collapsed)"),
+        # per-VARIANT isolation: by_label fans the judgement out per
+        # model variant, so one broken canary fires alone instead of
+        # averaging into the healthy default's traffic
+        SloRule("variant_degraded",
+                "ratio(inference_variant_degraded_total,"
+                " inference_variant_requests_total)",
+                ">", 0.05, window_sec=120.0, by_label="variant",
+                description="more than 5% of ONE model variant's "
+                            "predicts served zero-vector embedding "
+                            "fallback — judged per variant, so an A/B "
+                            "arm degrading alone still pages"),
         SloRule("device_cache_hit_collapse",
                 "ratio(device_cache_misses_total,"
                 " device_cache_probes_total)",
@@ -418,6 +455,37 @@ class SloEngine:
         q = {"p50": 0.50, "p90": 0.90, "p95": 0.95, "p99": 0.99}[rule.fn]
         return self._quantile(w, rule.arg1, q, rule.window_sec, now)
 
+    # --- per-label evaluation (by_label rules) ---------------------------
+
+    @staticmethod
+    def _label_values(w, rule: SloRule) -> set:
+        """Values of ``rule.by_label`` present on the rule's own series
+        in the latest snapshot (restricting to the rule's metric names
+        keeps an unrelated metric that happens to carry the label from
+        minting phantom groups)."""
+        if not w.snaps:
+            return set()
+        names = {rule.arg1, rule.arg1 + "_bucket"}
+        if rule.arg2:
+            names.add(rule.arg2)
+        _, series = w.snaps[-1]
+        out = set()
+        for (name, lbl) in series:
+            if name in names:
+                val = dict(lbl).get(rule.by_label)
+                if val is not None:
+                    out.add(val)
+        return out
+
+    @staticmethod
+    def _filter_label(w, label: str, value: str):
+        """A window view holding only series whose ``label`` equals
+        ``value`` — what a by_label rule evaluates per group."""
+        snaps = [(t, {k: v for k, v in series.items()
+                      if dict(k[1]).get(label) == value})
+                 for t, series in w.snaps]
+        return _Frozen(snaps, w.up)
+
     # --- evaluation ------------------------------------------------------
 
     def evaluate(self, now: Optional[float] = None) -> List[Dict]:
@@ -440,6 +508,22 @@ class SloEngine:
                 value = sum(vals) if vals else None
                 alerts.append(self._judge(rule, "fleet", value, now,
                                           fired))
+            elif rule.by_label is not None:
+                # per-label-value isolation: one judgement per value of
+                # the label (e.g. per model variant), keyed
+                # service[label=value] so alert/breach state never
+                # blends across values — a healthy default cannot mask
+                # (or be masked by) a broken canary
+                for service in sorted(matched):
+                    w = matched[service]
+                    for val in sorted(self._label_values(w, rule)):
+                        value = self._eval_expr(
+                            rule, self._filter_label(w, rule.by_label,
+                                                     val), now)
+                        alerts.append(self._judge(
+                            rule,
+                            f"{service}[{rule.by_label}={val}]",
+                            value, now, fired))
             else:
                 for service in sorted(matched):
                     value = self._eval_expr(rule, matched[service], now)

@@ -211,6 +211,17 @@ class EmbeddingWorker:
     def routing_epoch(self) -> int:
         return self._routing.epoch
 
+    @property
+    def routing_window(self):
+        """``(table, prev)`` — the live table plus the double-read
+        predecessor while a migration window is open (None once
+        drained). Read atomically under the routing holder's lock
+        (``RoutingHolder.window``): consumers that must agree with
+        this worker's shard view across reshard epochs (the serving
+        tier's online delta subscriber) would otherwise race a cutover
+        swap into a torn pair."""
+        return self._routing.window()
+
     def apply_routing(self, table, ps_clients=None) -> bool:
         """Atomically swap in a successor routing table (and, on
         scale-out/in, the replica client list) mid-traffic. Epoch-

@@ -107,6 +107,18 @@ while [ "$(date +%s)" -lt "$END" ]; do
         --mode chaos --chaos-reshard-only \
         --chaos-reshard-out /root/repo/BENCH_chaos_reshard.json \
         --max-seconds 1100
+      # 4j. online serving loop (PR 14): sign-to-servable freshness of
+      #     the delta subscriber vs the TTL-only baseline under live
+      #     training (>= 5x gate), serving p99 inflation <= 3% paired
+      #     interleaved, the two-variant weighted A/B split pinned
+      #     exactly, and the subsystem-off idle-wire pin — host-only,
+      #     but the p99-inflation number on production-class cores is
+      #     the one the serving runbook quotes (the 2-core dev box
+      #     contends the subscriber against the predict path);
+      #     BENCH_online.json lands next to this log
+      step "bench online (serving loop + variants)" python bench.py \
+        --mode online --online-out /root/repo/BENCH_online.json \
+        --max-seconds 900
       # 5. re-capture the headline near the end of the window
       step "re-capture: python bench.py" python bench.py
       echo "$(date -u +%FT%TZ) chip sequence complete — see BENCH_CAPTURE_r05.log" >> "$LOG"
