@@ -3185,6 +3185,318 @@ def bench_tier(batch_size, steps, n_ps=2, smoke=False):
         shutil.rmtree(tmp_root, ignore_errors=True)
 
 
+E2E_PLANNER_TOL = 0.20  # |predicted - measured| device hit rate, points
+
+
+def _e2e_stack(scenario, n_ps=2, hotness=False):
+    """One in-process hybrid stack (holders + worker + ctx) for a zoo
+    scenario. Optimizers are the zoo's calibrated pair (adam dense,
+    Adagrad(0.1) sparse) — every scenario's convergence gate was tuned
+    against them."""
+    import optax
+
+    from persia_tpu.ctx import TrainCtx
+    from persia_tpu.embedding import EmbeddingConfig
+    from persia_tpu.embedding.optim import Adagrad
+    from persia_tpu.ps.native import make_holder
+    from persia_tpu.worker.worker import EmbeddingWorker
+
+    holders = [make_holder(2_000_000, 8, hotness=hotness)
+               for _ in range(n_ps)]
+    worker = EmbeddingWorker(scenario.schema, holders)
+    ctx = TrainCtx(
+        model=scenario.model(),
+        dense_optimizer=optax.adam(2e-3),
+        embedding_optimizer=Adagrad(lr=0.1),
+        schema=scenario.schema,
+        worker=worker,
+        embedding_config=EmbeddingConfig(emb_initialization=(-0.05, 0.05)),
+        loss_fn=scenario.loss_fn,
+        seed=scenario.seed,
+    )
+    return ctx, worker, holders
+
+
+def _e2e_planner_validation(scenario, holders, smoke):
+    """Close the ROADMAP loop: the /fleet/hotness planner's predicted
+    device-cache hit rate, fitted from telemetry the TRAINING traffic
+    produced, validated against the hit rate the frequency-admitted
+    device mapper actually measures on FRESH traffic from the same
+    generator (a seed the sketches never saw). Hard gate:
+    |predicted - measured| <= E2E_PLANNER_TOL."""
+    from persia_tpu import hotness as hot
+    from persia_tpu.worker.device_cache import TieredSignSlotMap
+
+    snap = hot.merge_snapshots([h.hotness_snapshot() for h in holders])
+    if not snap.get("enabled"):
+        raise AssertionError("e2e: hotness sketches never armed — the "
+                             "planner has nothing to plan from")
+    # budget ~35% of the estimated unique fp32 rows: deep enough that
+    # the zipf head fits, shallow enough that the hit rate is a real
+    # number (not 1.0) the prediction could get wrong
+    full_bytes = sum(
+        float(t.get("unique_est") or 1.0) * int(tbl) * 4
+        for tbl, t in snap["tables"].items())
+    hbm_bytes = max(1 << 12, int(0.35 * full_bytes))
+    plan = hot.planner_report(snap, hbm_bytes=hbm_bytes)
+    pred = plan["expected_overall_hit_rate"]
+
+    # measured arm: one frequency-admitted mapper per planner table
+    # (PS tables are keyed by dim), sized at the PLAN's hot_rows
+    mappers = {
+        t["table"]: TieredSignSlotMap(max(int(t["hot_rows"]), 1))
+        for t in plan["tables"]
+    }
+    warm_passes, measure_passes = (2, 2) if smoke else (3, 3)
+    n_batches = 8 if smoke else 16
+    bs = scenario.bench_batch_size
+
+    def replay(count_window):
+        for p in range(count_window):
+            for b in scenario.batches(n_batches * bs, bs,
+                                      seed=scenario.seed + 5000 + p,
+                                      requires_grad=False):
+                by_dim = {}
+                for f in b.id_type_features:
+                    d = str(scenario.schema.get_slot(f.name).dim)
+                    by_dim.setdefault(d, []).append(f.signs)
+                for d, signs in by_dim.items():
+                    if d in mappers:
+                        mappers[d].assign(np.concatenate(signs))
+
+    replay(warm_passes)
+    c0 = {d: (m.hits, m.misses) for d, m in mappers.items()}
+    replay(measure_passes)
+    dh = sum(m.hits - c0[d][0] for d, m in mappers.items())
+    dm = sum(m.misses - c0[d][1] for d, m in mappers.items())
+    meas = dh / max(dh + dm, 1)
+    plan = hot.planner_report(snap, hbm_bytes=hbm_bytes,
+                              measured_hit_rate=meas)
+    delta = plan["hit_rate_delta"]
+    log(f"e2e[{scenario.name}]: planner predicted "
+        f"{pred * 100:.1f}% device hits from training telemetry, "
+        f"measured {meas * 100:.1f}% on fresh zipf traffic "
+        f"(delta {delta * 100:+.1f} points, tolerance "
+        f"{E2E_PLANNER_TOL * 100:.0f})")
+    if abs(delta) > E2E_PLANNER_TOL:
+        raise AssertionError(
+            f"e2e[{scenario.name}]: planner hit-rate delta "
+            f"{delta:+.3f} exceeds {E2E_PLANNER_TOL} — the telemetry-"
+            f"driven capacity plan does not survive workload traffic "
+            f"it did not generate")
+    return {
+        "hbm_bytes": hbm_bytes,
+        "predicted_hit_rate": round(pred, 4),
+        "measured_hit_rate": round(meas, 4),
+        "hit_rate_delta": round(delta, 4),
+        "tolerance": E2E_PLANNER_TOL,
+    }
+
+
+def _e2e_wire_pin(scenario, smoke):
+    """Ragged-free traffic keeps the wire byte-identical: a schema that
+    spells the new ``pooling`` field out (all-"sum") and the same
+    schema as a pre-zoo config would build it (no pooling keys at all)
+    must produce byte-identical lookup framing AND serve identical RPC
+    counts for identical cycles over real PS services — the served-
+    request-count pin."""
+    from persia_tpu.config import EmbeddingSchema
+    from persia_tpu.ps.store import EmbeddingHolder
+    from persia_tpu.rpc import pack_arrays_sg
+    from persia_tpu.service.ps_service import PsClient, PsService
+    from persia_tpu.service.serialization import pack_id_features
+    from persia_tpu.worker.worker import EmbeddingWorker
+
+    if scenario.ragged_features:
+        raise AssertionError("the wire pin runs on the ragged-free "
+                             "scenario only")
+
+    def join_sg(b):
+        return b if isinstance(b, (bytes, bytearray)) else b"".join(
+            bytes(x) for x in b)
+
+    # (a) structural pin on the loader wire: the id-feature framing of
+    # ragged-free zoo traffic carries exactly the legacy meta (names
+    # only) — no pooling rider crept into the batch wire
+    from persia_tpu.service.serialization import unpack_id_features
+
+    legacy_raw = {
+        "slots_config": {
+            name: {"dim": s.dim,
+                   "sample_fixed_size": s.sample_fixed_size,
+                   "embedding_summation": s.embedding_summation}
+            for name, s in scenario.schema.slots_config.items()
+        },
+    }
+    legacy_schema = EmbeddingSchema.from_dict(legacy_raw)
+    batch = next(iter(scenario.batches(64, 64, requires_grad=False)))
+    meta, _feats = unpack_id_features(
+        pack_id_features(batch.id_type_features))
+    if set(meta) != {"names"}:
+        raise AssertionError(
+            f"e2e wire pin: id-feature framing grew meta keys "
+            f"{sorted(set(meta) - {'names'})} beyond the legacy wire")
+
+    # (b) served-request-count pin over a real PS service: identical
+    # cycles through a pooling-spelled schema and the legacy-built one
+    svcs, stacks = [], {}
+    try:
+        for name, schema in (("zoo", scenario.schema),
+                             ("legacy", legacy_schema)):
+            svc = PsService(EmbeddingHolder(200_000, 4), port=0)
+            svc.server.serve_background()
+            svcs.append(svc)
+            cli = PsClient(svc.addr)
+            cli.configure("bounded_uniform", {"lower": -0.1, "upper": 0.1})
+            cli.register_optimizer({
+                "type": "adagrad", "lr": 0.05, "initialization": 0.01,
+                "g_square_momentum": 1.0, "vectorwise_shared": False})
+            stacks[name] = (EmbeddingWorker(schema, [cli]), cli)
+        n = 2 if smoke else 4
+        bs = min(scenario.bench_batch_size, 256)
+        served0 = {k: cli.health()["served_rpcs"]
+                   for k, (_w, cli) in stacks.items()}
+        first_req = {}
+        for k, (w, cli) in stacks.items():
+            for b in scenario.batches(n * bs, bs, requires_grad=True):
+                ref, lookup = w.lookup_direct_training(b.id_type_features)
+                grads = {f.name: np.ones_like(lookup[f.name].embeddings)
+                         for f in b.id_type_features}
+                w.update_gradients(ref, grads)
+            # structural pin: the client's REAL lookup framing (its
+            # own _lookup_meta, not a hand-built dict — a future meta
+            # rider must show up here) is byte-identical to the
+            # legacy pack
+            g_signs = np.sort(np.unique(
+                batch.id_type_features[0].signs))[:32].astype(np.uint64)
+            dim = scenario.schema.get_slot(
+                batch.id_type_features[0].name).dim
+            first_req[k] = join_sg(cli._pack(
+                cli._lookup_meta(dim, True), [g_signs]))
+        served1 = {k: cli.health()["served_rpcs"]
+                   for k, (_w, cli) in stacks.items()}
+        deltas = {k: served1[k] - served0[k] for k in stacks}
+        if deltas["zoo"] != deltas["legacy"]:
+            raise AssertionError(
+                f"e2e wire pin: pooling-capable schema changed the "
+                f"served RPC count for identical ragged-free work "
+                f"(zoo {deltas['zoo']} vs legacy {deltas['legacy']})")
+        legacy_bytes = join_sg(pack_arrays_sg(
+            {"dim": dim, "training": True},
+            [np.sort(np.unique(
+                batch.id_type_features[0].signs))[:32].astype(np.uint64)]))
+        if first_req["zoo"] != first_req["legacy"] \
+                or first_req["zoo"] != legacy_bytes:
+            raise AssertionError(
+                "e2e wire pin: lookup framing differs from the legacy "
+                "wire for ragged-free traffic")
+        log(f"e2e[{scenario.name}]: ragged-free wire pin OK — "
+            f"served counts equal ({deltas['zoo']}), lookup framing "
+            f"byte-identical to the legacy pack")
+        return {"served_rpcs": deltas["zoo"]}
+    finally:
+        for _w, cli in stacks.values():
+            try:
+                cli.shutdown()
+            except Exception:
+                pass
+        for s in svcs:
+            s.stop()
+
+
+def bench_e2e(batch_size, steps, smoke=False, scenario="all"):
+    """Workload-zoo end-to-end bench (`--mode e2e`): every registered
+    scenario trains through the full hybrid stack (generator -> worker
+    middleware -> PS holders -> jitted dense step -> sparse update),
+    reporting per-scenario samples/s plus three hard gates:
+
+    1. **Convergence smoke**: held-out AUC (disjoint seed, same hidden
+       task) must clear the scenario's floor and the loss must actually
+       fall — catches "the pipeline runs but nothing learns".
+    2. **Planner validation** (dlrm): the hotness planner's predicted
+       device-cache hit rate, fitted from the telemetry this training
+       run produced, matches the measured mapper hit rate on fresh
+       generator traffic within E2E_PLANNER_TOL.
+    3. **Ragged-free wire pin** (dlrm): pooling-capable schemas leave
+       the wire byte-identical and the served-request counts unchanged
+       when no ragged feature is present.
+    """
+    import jax
+
+    from persia_tpu.workloads import evaluate_auc, get_scenario
+    from persia_tpu.workloads import scenario_names as _scenario_names
+
+    names = (_scenario_names() if scenario in ("all", None, "")
+             else tuple(scenario.split(",")))
+    train_steps = 120 if smoke else max(steps, 200)
+    detail = {}
+    worst_headroom = None
+    for name in names:
+        sc = get_scenario(name, smoke=smoke)
+        bs = sc.bench_batch_size
+        ctx, worker, holders = _e2e_stack(
+            sc, hotness=(name == "dlrm"))
+        losses = []
+        t_steady = None
+        steady_from = max(2, train_steps // 5)
+        with ctx:
+            t0 = time.perf_counter()
+            for i, b in enumerate(sc.batches(train_steps * bs, bs)):
+                loss, _ = ctx.train_step(b)
+                losses.append(float(loss))
+                if i + 1 == steady_from:
+                    jax.block_until_ready(loss)
+                    t_steady = time.perf_counter()
+            jax.block_until_ready(loss)
+            wall = time.perf_counter() - t_steady
+            sps = (len(losses) - steady_from) * bs / max(wall, 1e-9)
+            aucs = evaluate_auc(
+                ctx, sc,
+                num_samples=2048 if smoke else 8192,
+                batch_size=min(bs, 512))
+        first5 = float(np.mean(losses[:5]))
+        last5 = float(np.mean(losses[-5:]))
+        min_auc = min(aucs.values())
+        log(f"e2e[{name}]: {sps:,.0f} samples/s "
+            f"({len(losses)} steps x bs={bs}), loss "
+            f"{first5:.4f} -> {last5:.4f}, held-out AUC "
+            f"{', '.join(f'{t}={v:.4f}' for t, v in aucs.items())} "
+            f"(gate >= {sc.auc_gate})")
+        if last5 >= first5:
+            raise AssertionError(
+                f"e2e[{name}]: loss did not fall "
+                f"({first5:.4f} -> {last5:.4f}) — the scenario is not "
+                f"training")
+        if min_auc < sc.auc_gate:
+            raise AssertionError(
+                f"e2e[{name}]: held-out AUC {min_auc:.4f} below the "
+                f"convergence gate {sc.auc_gate} "
+                f"(per task: {aucs})")
+        row = {
+            "samples_per_sec": round(sps, 1),
+            "batch_size": bs,
+            "steps": len(losses),
+            "loss_first5": round(first5, 5),
+            "loss_last5": round(last5, 5),
+            "auc": {t: round(v, 4) for t, v in aucs.items()},
+            "auc_gate": sc.auc_gate,
+            "ragged_features": list(sc.ragged_features),
+        }
+        if name == "dlrm":
+            row["planner"] = _e2e_planner_validation(sc, holders, smoke)
+            row["wire_pin"] = _e2e_wire_pin(sc, smoke)
+        detail[name] = row
+        worker.close()
+        headroom = min_auc / sc.auc_gate
+        if worst_headroom is None or headroom < worst_headroom:
+            worst_headroom = headroom
+    total_sps = sum(r["samples_per_sec"] for r in detail.values())
+    detail["scenarios_run"] = sorted(
+        k for k in detail if isinstance(detail[k], dict)
+        and "samples_per_sec" in detail[k])
+    return total_sps, worst_headroom or 1.0, detail
+
+
 def make_infer_requests(num, rows, n_slots, num_dense, vocab=1 << 18,
                         a=1.2, seed=0):
     """Pre-serialized label-less PersiaBatch blobs with Zipf-skewed signs
@@ -4645,8 +4957,18 @@ def main():
                             "worker", "worker-svc", "store", "roofline",
                             "infer", "rpc", "trace", "chaos", "mem",
                             "fleet", "telemetry", "tier", "reshard",
-                            "online"],
+                            "online", "e2e"],
                    default="device")
+    p.add_argument("--scenario", default="all",
+                   help="e2e mode: workload-zoo scenario(s) to run — "
+                        "a registry name (dlrm|seqrec|multitask), a "
+                        "comma-joined list, or 'all'")
+    p.add_argument("--e2e-out",
+                   default=os.path.join(
+                       os.path.dirname(os.path.abspath(__file__)),
+                       "BENCH_e2e.json"),
+                   help="e2e mode: machine-readable summary path "
+                        "(like BENCH_tier.json)")
     p.add_argument("--online-out",
                    default=os.path.join(
                        os.path.dirname(os.path.abspath(__file__)),
@@ -4729,6 +5051,7 @@ def main():
         "tier": ("tier_ladder_speedup_vs_flat_x", "x"),
         "reshard": ("reshard_skew_balance_gain_x", "x"),
         "online": ("online_freshness_speedup_vs_ttl_x", "x"),
+        "e2e": ("e2e_scenarios_samples_per_sec_total", "samples/sec"),
     }[args.mode]
 
     # Shared two-tier watchdog (persia_tpu.utils.arm_watchdog — the same
@@ -4958,6 +5281,33 @@ def main():
             json.dump(summary, f, indent=1, sort_keys=True)
             f.write("\n")
         log(f"reshard: summary written to {args.reshard_out}")
+    elif args.mode == "e2e":
+        value, headroom, detail = bench_e2e(
+            args.batch_size, args.steps, smoke=args.smoke,
+            scenario=args.scenario)
+        # the hard gates (per-scenario convergence smoke, the DLRM
+        # planner predicted-vs-measured hit-rate tolerance, the
+        # ragged-free wire pin) fail inside bench_e2e; vs_baseline =
+        # the worst scenario's AUC headroom over its convergence gate
+        vs_baseline = headroom
+        extra["detail"] = detail
+        summary = {
+            "mode": "e2e",
+            "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                         time.gmtime()),
+            "metric": metric,
+            "value": round(value, 1),
+            "unit": unit,
+            "smoke": bool(args.smoke),
+            "scenarios": {
+                k: v for k, v in detail.items()
+                if isinstance(v, dict) and "samples_per_sec" in v
+            },
+        }
+        with open(args.e2e_out, "w") as f:
+            json.dump(summary, f, indent=1, sort_keys=True)
+            f.write("\n")
+        log(f"e2e: summary written to {args.e2e_out}")
     elif args.mode == "online":
         value, detail = bench_online(smoke=args.smoke)
         # the hard gates (freshness >= 5x vs TTL-only, serving p99

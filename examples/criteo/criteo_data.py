@@ -130,97 +130,17 @@ def criteo_batches(
         yield flush()
 
 
-def synthetic_batches(
-    num_samples: int,
-    batch_size: int = 4096,
-    seed: int = 0,
-    vocab_per_slot: int = 1 << 20,
-    requires_grad: bool = True,
-) -> Iterator[PersiaBatch]:
-    """Criteo-shaped synthetic stream (13 dense + 26 single-id slots)
-    for smoke runs and tests without the dataset."""
-    rng = np.random.default_rng(seed)
-    for batch_id, start in enumerate(range(0, num_samples, batch_size)):
-        n = min(batch_size, num_samples - start)
-        signs = rng.integers(1, vocab_per_slot, size=(n, NUM_SLOTS),
-                             dtype=np.uint64)
-        dense = rng.normal(size=(n, NUM_DENSE)).astype(np.float32)
-        label = (rng.random((n, 1)) < 0.25).astype(np.float32)
-        yield PersiaBatch(
-            [IDTypeFeatureWithSingleID(
-                SLOT_NAMES[i], np.ascontiguousarray(signs[:, i]))
-             for i in range(NUM_SLOTS)],
-            non_id_type_features=[NonIDTypeFeature(dense)],
-            labels=[Label(label)],
-            requires_grad=requires_grad,
-            batch_id=batch_id,
-        )
-
-
-def _hidden_weight(slot_idx: np.ndarray, ids: np.ndarray) -> np.ndarray:
-    """Deterministic ~N(0,1) hidden weight per (slot, id), computed by
-    hashing on the fly: materializing a (NUM_SLOTS, vocab) matrix costs
-    218 MB of float64 per loader replica at the default vocab of 2**20,
-    all for rows that are mostly never drawn. splitmix64-style mixing +
-    Box-Muller, vectorized over the batch."""
-    x = (ids.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15)
-         + (slot_idx + np.uint64(1)) * np.uint64(0xBF58476D1CE4E5B9))
-
-    def mix(v):
-        v = v ^ (v >> np.uint64(30))
-        v = v * np.uint64(0xBF58476D1CE4E5B9)
-        v = v ^ (v >> np.uint64(27))
-        v = v * np.uint64(0x94D049BB133111EB)
-        return v ^ (v >> np.uint64(31))
-
-    h1 = mix(x)
-    h2 = mix(x ^ np.uint64(0xD6E8FEB86659FD93))
-    u1 = ((h1 >> np.uint64(11)).astype(np.float64) + 1.0) / (2.0**53 + 2)
-    u2 = (h2 >> np.uint64(11)).astype(np.float64) / 2.0**53
-    return np.sqrt(-2.0 * np.log(u1)) * np.cos(2.0 * np.pi * u2)
-
-
-def learnable_batches(
-    num_samples: int,
-    batch_size: int = 4096,
-    seed: int = 0,
-    vocab_per_slot: int = 1000,
-    noise: float = 0.25,
-    requires_grad: bool = True,
-) -> Iterator[PersiaBatch]:
-    """Criteo-shaped stream with a *recoverable* signal: labels come from
-    hidden per-id weights + a dense linear term, so training must learn
-    per-sign embeddings to beat AUC 0.5. The hidden weights are fixed
-    (independent of ``seed``), so different seeds give disjoint draws
-    from the SAME task — train on one seed, evaluate on another. Used by
-    the flagship service-mode e2e where ``synthetic_batches`` (pure
-    noise labels) can't demonstrate learning."""
-    rng = np.random.default_rng(seed)
-    hidden = np.random.default_rng(424242)
-    dense_w = hidden.normal(0.0, 0.5, size=NUM_DENSE)
-    slot_idx = np.arange(NUM_SLOTS, dtype=np.uint64)[None, :]
-    for batch_id, start in enumerate(range(0, num_samples, batch_size)):
-        n = min(batch_size, num_samples - start)
-        ids = rng.integers(0, vocab_per_slot, size=(n, NUM_SLOTS))
-        dense = rng.normal(size=(n, NUM_DENSE)).astype(np.float32)
-        logits = _hidden_weight(slot_idx, ids).sum(axis=1)
-        logits += dense @ dense_w
-        std = float(logits.std()) or 1.0  # n==1 tail batch: std is 0
-        logits += rng.normal(0.0, noise * std, size=n)
-        prob = 1.0 / (1.0 + np.exp(-2.5 * logits / std))
-        label = (rng.random(n) < prob).astype(np.float32)[:, None]
-        # distinct sign ranges per slot; +1 keeps sign 0 = "missing"
-        signs = (ids + np.arange(NUM_SLOTS)[None, :] * vocab_per_slot
-                 + 1).astype(np.uint64)
-        yield PersiaBatch(
-            [IDTypeFeatureWithSingleID(
-                SLOT_NAMES[i], np.ascontiguousarray(signs[:, i]))
-             for i in range(NUM_SLOTS)],
-            non_id_type_features=[NonIDTypeFeature(dense)],
-            labels=[Label(label)],
-            requires_grad=requires_grad,
-            batch_id=batch_id,
-        )
+# Synthetic Criteo-shaped streams live in the workload zoo now
+# (persia_tpu/workloads/generator.py) — the examples, tests and the e2e
+# bench all train the ONE shared definition. The historical names stay
+# importable here, draw-order bit-compatible with the old local
+# implementations; `persia_tpu.workloads.generator.dlrm_batches` is the
+# production-shaped (zipf, mixed-dim) variant the e2e bench drives.
+from persia_tpu.workloads.generator import (  # noqa: E402,F401
+    criteo_learnable_batches as learnable_batches,
+    criteo_uniform_batches as synthetic_batches,
+    hidden_weight as _hidden_weight,
+)
 
 
 def write_synthetic_tsv(path: str, num_samples: int, seed: int = 0):

@@ -40,6 +40,7 @@ from persia_tpu.embedding import EmbeddingConfig
 from persia_tpu.embedding.optim import Adagrad
 from persia_tpu.logger import get_default_logger
 from persia_tpu.models import DCNv2, DeepFM, DLRM
+from persia_tpu.workloads.models import ZooDLRM
 from persia_tpu.ps.native import make_holder
 from persia_tpu.utils import roc_auc, setup_seed
 from persia_tpu.worker.worker import EmbeddingWorker
@@ -52,7 +53,11 @@ from criteo_data import (  # unique module name: examples share sys.path
 
 logger = get_default_logger("criteo")
 
-ZOO = {"dlrm": DLRM, "dcnv2": DCNv2, "deepfm": DeepFM}
+# "zoo-dlrm" is the workload zoo's mixed-dim tower (per-field projection
+# before the interaction): the one to pick when the schema YAML ladders
+# dims by table cardinality instead of using one uniform width
+ZOO = {"dlrm": DLRM, "dcnv2": DCNv2, "deepfm": DeepFM,
+       "zoo-dlrm": ZooDLRM}
 
 
 def load_schema(args) -> EmbeddingSchema:
@@ -82,7 +87,8 @@ def build_ctx(args, schema: EmbeddingSchema, worker=None):
         shape = tuple(int(x) for x in args.mesh.split(","))
         mesh = make_mesh(shape)
     dim = schema.get_slot(SLOT_NAMES[0]).dim
-    model_kw = {"embedding_dim": dim} if args.model == "dlrm" else {}
+    model_kw = {"embedding_dim": dim} if args.model == "dlrm" else (
+        {"proj_dim": dim} if args.model == "zoo-dlrm" else {})
     return TrainCtx(
         model=ZOO[args.model](**model_kw),
         dense_optimizer=optax.adagrad(args.lr),

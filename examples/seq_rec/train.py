@@ -44,13 +44,6 @@ import optax
 
 from persia_tpu.config import EmbeddingSchema, SlotConfig, uniform_slots
 from persia_tpu.ctx import TrainCtx, eval_ctx
-from persia_tpu.data.batch import (
-    IDTypeFeature,
-    IDTypeFeatureWithSingleID,
-    Label,
-    NonIDTypeFeature,
-    PersiaBatch,
-)
 from persia_tpu.embedding import EmbeddingConfig
 from persia_tpu.embedding.optim import Adagrad
 from persia_tpu.logger import get_default_logger
@@ -58,69 +51,45 @@ from persia_tpu.models import SequenceTower
 from persia_tpu.ps.native import make_holder
 from persia_tpu.utils import roc_auc, setup_seed
 from persia_tpu.worker.worker import EmbeddingWorker
+from persia_tpu.workloads.generator import (
+    SEQ_CLICKS_SLOT,
+    SEQ_HISTORY_SLOT,
+    SEQ_PROFILE_SLOTS,
+    SEQ_TARGET_SLOT,
+    SeqRecSpec,
+    seqrec_batches,
+)
 
 logger = get_default_logger("seq_rec")
 
 DIM = 16
-NUM_PROFILE_SLOTS = 3
 
 
-
-def make_batches(num_samples, batch_size, t_hist, vocab=50_000,
-                 n_clusters=16, seed=0, requires_grad=True):
-    """Synthetic sessions with the label hidden in the history.
-
-    Every item id belongs to a hidden cluster (id % n_clusters — opaque
-    to the model, which only sees hashed signs). "Engaged" sessions
-    draw their whole history from one cluster and click with p=0.85;
-    "browsing" sessions draw uniformly and click with p=0.15. The only
-    path to the signal is learning per-item cluster embeddings and
-    detecting history homogeneity through the attention tower — summed
-    profile slots and the dense features carry nothing (AUC ceiling
-    ~0.85 from the label noise)."""
-    rng = np.random.default_rng(seed)
-
-    for start in range(0, num_samples, batch_size):
-        bs = min(batch_size, num_samples - start)
-        target = rng.integers(1, vocab, size=bs, dtype=np.uint64)
-        engaged = rng.random(bs) < 0.5
-        hist = rng.integers(1, vocab, size=(bs, t_hist), dtype=np.uint64)
-        cluster = rng.integers(0, n_clusters, size=bs)
-        same = (hist // np.uint64(n_clusters)) * np.uint64(n_clusters)
-        same = same + cluster[:, None].astype(np.uint64)
-        hist = np.where(engaged[:, None], same, hist)
-        np.clip(hist, 1, vocab - 1, out=hist)
-        # variable lengths: pad tail with 0 (the "missing" sign)
-        lengths = rng.integers(t_hist // 4, t_hist + 1, size=bs)
-        for i, ln in enumerate(lengths):
-            hist[i, ln:] = 0
-        label = np.where(
-            engaged, rng.random(bs) < 0.85, rng.random(bs) < 0.15
-        ).astype(np.float32)
-        # history as a LIL raw slot (per-sample variable length)
-        hist_rows = [row[row != 0] for row in hist]
-        dense = rng.normal(size=(bs, 4)).astype(np.float32)
-        yield PersiaBatch(
-            [IDTypeFeatureWithSingleID(
-                f"profile_{s}",
-                rng.integers(1, 5_000, size=bs, dtype=np.uint64))
-             for s in range(NUM_PROFILE_SLOTS)]
-            + [IDTypeFeature("history", hist_rows),
-               IDTypeFeatureWithSingleID("target", target)],
-            [NonIDTypeFeature(dense)],
-            [Label(label.reshape(-1, 1))],
-            requires_grad=requires_grad,
-        )
+def make_batches(args, num_samples, batch_size, seed=0,
+                 requires_grad=True):
+    """The workload zoo's shared session stream (the label hides in
+    history-cluster homogeneity; see
+    persia_tpu/workloads/generator.py:seqrec_batches). This example
+    reads the SAME stream through a different schema lens than
+    `bench.py --mode e2e --scenario seqrec`: recent_items stays a RAW
+    slot here so the attention tower sees the full sequence, while the
+    clicks slot exercises worker-tier last-N pooling."""
+    spec = SeqRecSpec(item_vocab=args.vocab, t_hist=args.t_hist)
+    return seqrec_batches(num_samples, batch_size, seed=seed, spec=spec,
+                          requires_grad=requires_grad)
 
 
 def build_ctx(args, mesh=None):
     setup_seed(args.seed)
     slots = uniform_slots(
-        [f"profile_{s}" for s in range(NUM_PROFILE_SLOTS)] + ["target"],
-        dim=DIM)
-    slots["history"] = SlotConfig(
-        name="history", dim=DIM, embedding_summation=False,
+        [*SEQ_PROFILE_SLOTS, SEQ_TARGET_SLOT], dim=DIM)
+    # attention wants the raw sequence; the clicks slot rides the
+    # worker-tier recency pooling (one (bs, dim) vector on the wire)
+    slots[SEQ_HISTORY_SLOT] = SlotConfig(
+        name=SEQ_HISTORY_SLOT, dim=DIM, embedding_summation=False,
         sample_fixed_size=args.t_hist)
+    slots[SEQ_CLICKS_SLOT] = SlotConfig(
+        name=SEQ_CLICKS_SLOT, dim=DIM, pooling="last4")
     schema = EmbeddingSchema(slots_config=slots)
     holders = [make_holder(2_000_000, 8) for _ in range(args.n_ps)]
     worker = EmbeddingWorker(schema, holders)
@@ -142,8 +111,8 @@ def build_ctx(args, mesh=None):
 def evaluate(ctx, args, num_samples=4096):
     preds, labels = [], []
     with eval_ctx(ctx) as ectx:
-        for batch in make_batches(num_samples, args.batch_size,
-                                  args.t_hist, seed=args.seed + 1000,
+        for batch in make_batches(args, num_samples, args.batch_size,
+                                  seed=args.seed + 1000,
                                   requires_grad=False):
             pred, lab = ectx.forward(batch)
             preds.append(np.asarray(pred).reshape(-1))
@@ -157,6 +126,8 @@ def main():
     p.add_argument("--batch-size", type=int, default=256)
     p.add_argument("--t-hist", type=int, default=64,
                    help="max history length (the sequence axis)")
+    p.add_argument("--vocab", type=int, default=50_000,
+                   help="item sign space of the shared zoo generator")
     p.add_argument("--heads", type=int, default=4)
     p.add_argument("--n-ps", type=int, default=2)
     p.add_argument("--seed", type=int, default=42)
@@ -183,8 +154,8 @@ def main():
     with ctx:
         n = 0
         for step, batch in enumerate(make_batches(
-                args.steps * args.batch_size, args.batch_size,
-                args.t_hist, seed=args.seed)):
+                args, args.steps * args.batch_size, args.batch_size,
+                seed=args.seed)):
             loss, _ = ctx.train_step(batch)
             n += 1
             if step % 50 == 0:

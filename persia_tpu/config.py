@@ -84,7 +84,26 @@ class HashStackConfig:
 
 @dataclass
 class SlotConfig:
-    """Schema of one sparse feature slot (reference: lib.rs:535-550)."""
+    """Schema of one sparse feature slot (reference: lib.rs:535-550).
+
+    ``pooling`` selects how a summed slot's ragged per-sample sign list
+    collapses to one (batch, dim) vector on the WORKER tier (the
+    sequence/session-feature capability the workload zoo drives):
+
+    - ``"sum"`` — the reference behavior (default; the only mode the
+      native kernels and the pre-zoo wire ever saw);
+    - ``"mean"`` — sum scaled by 1/n per sample (a session-embedding
+      average robust to history length);
+    - ``"last<k>"`` (e.g. ``"last4"``) — sum of the LAST k signs of
+      each sample (recency pooling; CSR order is arrival order).
+
+    Pooled results travel as the same (batch, dim) SumEmbedding the sum
+    mode always shipped, so a schema with no non-sum slot keeps the
+    lookup-result wire byte-identical. Non-sum pooling composes with
+    neither ``sqrt_scaling`` (it IS a scaling rule) nor hashstack
+    (rounds repeat elements, which would corrupt the per-sample counts
+    the weights derive from) nor raw slots (sequences stay sequences).
+    """
 
     name: str
     dim: int
@@ -93,6 +112,40 @@ class SlotConfig:
     sqrt_scaling: bool = False
     hash_stack_config: HashStackConfig = field(default_factory=HashStackConfig)
     index_prefix: int = 0  # assigned automatically from feature groups
+    pooling: str = "sum"
+
+    def __post_init__(self):
+        if self.pooling_last_n is None:
+            raise ValueError(
+                f"slot {self.name!r}: pooling must be 'sum', 'mean' or "
+                f"'last<k>' (k >= 1), got {self.pooling!r}")
+        if self.pooling == "sum":
+            return
+        if not self.embedding_summation:
+            raise ValueError(
+                f"slot {self.name!r}: pooling={self.pooling!r} applies to "
+                f"summed slots only; raw slots keep their sequences")
+        if self.sqrt_scaling:
+            raise ValueError(
+                f"slot {self.name!r}: sqrt_scaling composes only with "
+                f"pooling='sum' (non-sum pooling is itself the scaling "
+                f"rule)")
+        if self.hash_stack_config.hash_stack_rounds:
+            raise ValueError(
+                f"slot {self.name!r}: hashstack repeats every element "
+                f"per round, which would corrupt {self.pooling!r} "
+                f"pooling's per-sample counts; use pooling='sum'")
+
+    @property
+    def pooling_last_n(self):
+        """k for ``last<k>`` pooling; 0 for sum/mean; None when the
+        pooling string is malformed (the __post_init__ guard)."""
+        p = self.pooling
+        if p in ("sum", "mean"):
+            return 0
+        if p.startswith("last") and p[4:].isdigit() and int(p[4:]) > 0:
+            return int(p[4:])
+        return None
 
 
 @dataclass
@@ -206,6 +259,7 @@ class EmbeddingSchema:
                     hash_stack_rounds=int(hs.get("hash_stack_rounds", 0)),
                     embedding_size=int(hs.get("embedding_size", 0)),
                 ),
+                pooling=str(sc.get("pooling", "sum")),
             )
         init_raw = raw.get("initialization", {}) or {}
         init = InitializationConfig(
@@ -371,6 +425,7 @@ def uniform_slots(
     dim: int,
     embedding_summation: bool = True,
     sample_fixed_size: int = 10,
+    pooling: str = "sum",
 ) -> Dict[str, SlotConfig]:
     """Convenience builder: identical slots for a list of feature names."""
     return {
@@ -379,6 +434,7 @@ def uniform_slots(
             dim=dim,
             embedding_summation=embedding_summation,
             sample_fixed_size=sample_fixed_size,
+            pooling=pooling,
         )
         for n in names
     }

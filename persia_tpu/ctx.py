@@ -554,6 +554,14 @@ class TrainCtx(EmbeddingCtx):
                 raise NotImplementedError(
                     "device cache needs summed (pooled) slots; "
                     f"{f.name} is a raw slot")
+            if slot.pooling != "sum":
+                # the fused cached step segment-SUMS bags on device;
+                # running a mean/last-k slot through it would silently
+                # change the pooling semantics
+                raise NotImplementedError(
+                    "device cache supports pooling='sum' slots only; "
+                    f"{f.name} uses pooling={slot.pooling!r} (worker-"
+                    "tier pooling) — use the uncached hybrid path")
             dims.add(slot.dim)
         if len(dims) != 1:
             raise NotImplementedError(

@@ -562,7 +562,8 @@ class FleetMonitor:
         return doc
 
     def fleet_hotness(self, hbm_bytes: Optional[int] = None,
-                      num_replicas: Optional[int] = None) -> Dict:
+                      num_replicas: Optional[int] = None,
+                      measured_hit_rate: Optional[float] = None) -> Dict:
         """Cross-shard workload-hotness merge: pull every up target's
         ``/hotness?full=1`` snapshot (disabled/absent targets
         contribute nothing), merge them exactly — totals equal the sum
@@ -594,7 +595,8 @@ class FleetMonitor:
                                 "total": int(doc.get("total", 0))})
         merged = _hotness.merge_snapshots(snaps)
         report = _hotness.fleet_report(merged, hbm_bytes=hbm_bytes,
-                                       num_replicas=num_replicas)
+                                       num_replicas=num_replicas,
+                                       measured_hit_rate=measured_hit_rate)
         report["sources"] = scraped
         return report
 
@@ -765,13 +767,20 @@ class FleetHttpServer:
                         # capacity planner sizes against
                         # ?replicas= additionally renders the elastic
                         # tier's hotness-balanced placement plan
+                        # ?measured_hit_rate= pairs an externally-
+                        # measured device hit rate with the prediction
+                        # (the planner emits the signed delta)
                         hbm_gb = q.get("hbm_gb", [None])[0]
                         replicas = q.get("replicas", [None])[0]
+                        measured = q.get("measured_hit_rate", [None])[0]
                         body = json.dumps(mon.fleet_hotness(
                             hbm_bytes=(int(float(hbm_gb) * (1 << 30))
                                        if hbm_gb else None),
                             num_replicas=(int(replicas)
-                                          if replicas else None))).encode()
+                                          if replicas else None),
+                            measured_hit_rate=(float(measured)
+                                               if measured else None),
+                        )).encode()
                     elif url.path == "/healthz":
                         doc = mon.fleet_status()["fleet_monitor"]
                         doc.update({"status": "ok", "ready": True,

@@ -749,7 +749,8 @@ def table_report(table_snap: Dict,
 def planner_report(snapshot: Dict, hbm_bytes: int,
                    row_bytes: Optional[Dict[str, int]] = None,
                    fracs: Sequence[float] = DEFAULT_COVERAGE_FRACS,
-                   num_replicas: Optional[int] = None) -> Dict:
+                   num_replicas: Optional[int] = None,
+                   measured_hit_rate: Optional[float] = None) -> Dict:
     """HBM-capacity plan for the frequency-admitted device cache
     (ROADMAP item 2): split ``hbm_bytes`` across tables in proportion
     to their lookup traffic, size each table's hot set, and read the
@@ -762,7 +763,15 @@ def planner_report(snapshot: Dict, hbm_bytes: int,
     whatever the PS stores (cached_train.init_cache_arrays), so an
     fp16 PS tier must not seduce the plan into budgeting 2x the rows
     that actually fit in HBM. A wider-than-f32 stamp (future) is
-    honored; optimizer state is excluded by convention."""
+    honored; optimizer state is excluded by convention.
+
+    ``measured_hit_rate`` closes the prediction loop: when a caller has
+    MEASURED the device-cache hit rate under the planned budget (the
+    e2e bench's steady window, or an operator reading the cache
+    counters), the report carries it next to the prediction plus their
+    signed delta (``predicted - measured``) — the number the e2e gate
+    bounds and the first thing to look at when a capacity plan
+    disagrees with production."""
     tables = snapshot.get("tables", {})
     total = float(snapshot.get("total") or 0) or float(
         sum(t.get("total", 0) for t in tables.values())) or 1.0
@@ -794,6 +803,11 @@ def planner_report(snapshot: Dict, hbm_bytes: int,
         "expected_overall_hit_rate": round(overall, 6),
         "tables": plan,
     }
+    if measured_hit_rate is not None:
+        doc["measured_overall_hit_rate"] = round(
+            float(measured_hit_rate), 6)
+        doc["hit_rate_delta"] = round(
+            overall - float(measured_hit_rate), 6)
     if num_replicas:
         # elastic-tier placement: per-slot traffic shares -> replica
         # assignment, consumed by the reshard controller
@@ -879,11 +893,21 @@ def placement_plan(snapshot: Dict, num_replicas: int,
 
 def fleet_report(snapshot: Dict, hbm_bytes: Optional[int] = None,
                  fracs: Sequence[float] = DEFAULT_COVERAGE_FRACS,
-                 num_replicas: Optional[int] = None) -> Dict:
+                 num_replicas: Optional[int] = None,
+                 measured_hit_rate: Optional[float] = None) -> Dict:
     """The /fleet/hotness document: merged totals, per-table analysis,
     (when an HBM budget is named) the capacity plan, and (when a
     replica count is named) the elastic tier's hotness-balanced
     placement plan."""
+    if measured_hit_rate is not None and not (
+            hbm_bytes and snapshot.get("enabled")):
+        # a measured rate needs a prediction to delta against — that
+        # takes both a budget AND armed telemetry; silently dropping
+        # it would read as "no drift data"
+        raise ValueError(
+            "measured_hit_rate requires an HBM budget (hbm_bytes / "
+            "?hbm_gb=) and armed hotness telemetry — there is no "
+            "predicted hit rate to compare against without them")
     doc = {
         "enabled": bool(snapshot.get("enabled")),
         "total": int(snapshot.get("total") or 0),
@@ -892,7 +916,8 @@ def fleet_report(snapshot: Dict, hbm_bytes: Optional[int] = None,
     }
     if hbm_bytes and snapshot.get("enabled"):
         doc["planner"] = planner_report(snapshot, hbm_bytes, fracs=fracs,
-                                        num_replicas=num_replicas)
+                                        num_replicas=num_replicas,
+                                        measured_hit_rate=measured_hit_rate)
     elif num_replicas and snapshot.get("enabled"):
         doc["placement_plan"] = placement_plan(snapshot, num_replicas)
     return doc

@@ -316,6 +316,17 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
        "weight-proportional ranges. 10000 buckets = 0.01% split "
        "granularity; every serving replica computes the same "
        "assignment for the same key."),
+    _k("PERSIA_WORKLOAD_ALPHA", "float", 1.05,
+       "Default zipf skew of the workload-zoo scenario generators "
+       "(persia_tpu/workloads): every categorical table's sign draw "
+       "uses this alpha unless the scenario spec overrides it. The "
+       "e2e bench fits the hotness telemetry against traffic generated "
+       "at this skew."),
+    _k("PERSIA_WORKLOAD_SEED", "int", 0,
+       "Base seed of the workload-zoo generators. Scenario streams are "
+       "deterministic per seed (identical batches), and the hidden "
+       "label structure is seed-INDEPENDENT — train on one seed, "
+       "evaluate on another, same task."),
     _k("PERSIA_WORKER_STREAMING", "bool", True,
        "Embedding worker streaming data plane (scatter-per-completion "
        "lookups, ship-as-aggregated updates). `0` restores the "

@@ -45,6 +45,7 @@ def _schema_to_yaml_dict(schema: EmbeddingSchema) -> dict:
                 "sample_fixed_size": s.sample_fixed_size,
                 "embedding_summation": s.embedding_summation,
                 "sqrt_scaling": s.sqrt_scaling,
+                "pooling": s.pooling,
                 "hash_stack_config": {
                     "hash_stack_rounds": s.hash_stack_config.hash_stack_rounds,
                     "embedding_size": s.hash_stack_config.embedding_size,

@@ -452,8 +452,25 @@ def postprocess_feature(
     dim = slot.dim
     native = _mw_native()
     if slot.embedding_summation:
+        last_n = slot.pooling_last_n
+        if last_n:
+            # recency pooling: sum of each sample's LAST k signs (CSR
+            # order is arrival order). The native sum_post kernel has
+            # no element mask, so this mode stays on the numpy twin —
+            # still one (batch, dim) SumEmbedding on the wire.
+            keep = feat.elem_col >= (
+                feat.sample_num_signs - last_n)[feat.elem_sample]
+            out = _segment_sum(emb[feat.elem_distinct[keep]],
+                               feat.elem_sample[keep], bs)
+            return SumEmbedding(feat.name, out)
         scale = None
-        if slot.sqrt_scaling:
+        if slot.pooling == "mean":
+            # mean pooling rides the same post-sum scale lane the
+            # sqrt_scaling mode always used (native kernel included):
+            # sum first, one multiply per output row after
+            n = np.maximum(feat.sample_num_signs, 1).astype(np.float32)
+            scale = 1.0 / n
+        elif slot.sqrt_scaling:
             n = np.maximum(feat.sample_num_signs, 1).astype(np.float32)
             scale = 1.0 / np.sqrt(n)
         if native is not None:
@@ -503,12 +520,18 @@ def aggregate_gradients(
     """
     dim = slot.dim
     grad = np.ascontiguousarray(grad, dtype=np.float32)
-    native = _mw_native()
+    last_n = slot.pooling_last_n
+    # last-k pooling has no native kernel (no element mask in sum_grad):
+    # route it through the numpy twin whatever the build has
+    native = _mw_native() if not last_n else None
     if native is not None:
         inv_ls = np.float32(1.0 / loss_scale) if loss_scale != 1.0 else 1.0
         if slot.embedding_summation:
             scale = None
-            if slot.sqrt_scaling:
+            if slot.pooling == "mean":
+                n = np.maximum(feat.sample_num_signs, 1).astype(np.float32)
+                scale = 1.0 / n
+            elif slot.sqrt_scaling:
                 n = np.maximum(feat.sample_num_signs, 1).astype(np.float32)
                 scale = 1.0 / np.sqrt(n)
             return native.sum_grad(grad, feat.elem_sample,
@@ -530,7 +553,19 @@ def aggregate_gradients(
     if loss_scale != 1.0:
         grad = grad * (1.0 / loss_scale)
     if slot.embedding_summation:
-        if slot.sqrt_scaling:
+        if last_n:
+            # transpose of the masked forward sum: only the kept (last
+            # k per sample) elements receive gradient
+            keep = feat.elem_col >= (
+                feat.sample_num_signs - last_n)[feat.elem_sample]
+            return _segment_sum(
+                grad[feat.elem_sample[keep]], feat.elem_distinct[keep],
+                feat.num_distinct,
+            )
+        if slot.pooling == "mean":
+            n = np.maximum(feat.sample_num_signs, 1).astype(np.float32)
+            grad = grad * (1.0 / n)[:, None]
+        elif slot.sqrt_scaling:
             n = np.maximum(feat.sample_num_signs, 1).astype(np.float32)
             grad = grad * (1.0 / np.sqrt(n))[:, None]
         out = _segment_sum(

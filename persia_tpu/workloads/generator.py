@@ -1,0 +1,436 @@
+"""Production-shaped synthetic workload generators — the zoo's data layer.
+
+Every bench and convergence gate in the repo used to train ONE uniform
+synthetic table; the workload zoo replaces that with scenario streams
+shaped like traffic from millions of users:
+
+- **Criteo-schema DLRM traffic** (:func:`dlrm_batches`): 13 dense floats
+  + 26 categorical tables with a realistic log-spread vocab mix, each
+  table drawing signs from an EXACT truncated zipf (configurable alpha)
+  — the skew the hotness telemetry/planner stack (PR 8/9) was built to
+  measure but never met from a source it did not itself generate.
+- **Session/sequence traffic** (:func:`seqrec_batches`): variable-length
+  sign lists (ragged CSR features) pooled on the WORKER tier
+  (mean / last-N; see ``SlotConfig.pooling``), with the label signal
+  planted IN the session history.
+- **Multi-task traffic** (:func:`multitask_batches`): two objectives
+  (click + convert) over one shared set of embedding tables, labels
+  shipped as one (batch, 2) array.
+
+Determinism contract: every generator is a pure function of its
+arguments — the same ``seed`` yields byte-identical batch streams
+(paired A/Bs and convergence smokes depend on it), and the label
+structure (hidden per-sign weights) is FIXED independently of ``seed``,
+so different seeds are disjoint draws from the same task: train on one
+seed, evaluate on another.
+"""
+
+from dataclasses import dataclass
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from persia_tpu.data.batch import (
+    IDTypeFeature,
+    IDTypeFeatureWithSingleID,
+    Label,
+    NonIDTypeFeature,
+    PersiaBatch,
+)
+
+NUM_DENSE = 13
+NUM_TABLES = 26
+CRITEO_SLOT_NAMES = [f"C{i + 1}" for i in range(NUM_TABLES)]
+
+_U64 = np.uint64
+
+
+# --- exact truncated zipf ------------------------------------------------
+
+def zipf_cdf(vocab: int, alpha: float) -> np.ndarray:
+    """CDF of the truncated zipf(alpha) law over ranks 1..vocab.
+
+    Exact inverse-CDF sampling on purpose: ``rng.zipf`` folds an
+    unbounded tail back through ``%``, distorting the head that the
+    telemetry accuracy gates (and the planner validation) fit against.
+    """
+    p = np.arange(1, vocab + 1, dtype=np.float64) ** -float(alpha)
+    return np.cumsum(p / p.sum())
+
+
+def zipf_ranks(rng: np.random.Generator, cdf: np.ndarray,
+               size) -> np.ndarray:
+    """0-based zipf ranks drawn through a precomputed :func:`zipf_cdf`.
+    float cumsum can leave cdf[-1] a hair below 1 — clip so the sliver
+    cannot mint rank ``vocab``."""
+    return np.searchsorted(cdf, rng.random(size)).clip(
+        max=len(cdf) - 1).astype(np.int64)
+
+
+# --- deterministic hidden task structure ---------------------------------
+
+def hidden_weight(stream: np.ndarray, ids: np.ndarray) -> np.ndarray:
+    """Deterministic ~N(0,1) hidden weight per (stream, id), computed by
+    hashing on the fly (splitmix64 mixing + Box-Muller): materializing a
+    (streams, vocab) matrix costs hundreds of MB per loader replica at
+    production vocabs, all for rows that are mostly never drawn. The
+    weights do NOT depend on the generator seed — they define the task,
+    not the draw."""
+    x = (ids.astype(np.uint64) * _U64(0x9E3779B97F4A7C15)
+         + (np.asarray(stream, np.uint64) + _U64(1))
+         * _U64(0xBF58476D1CE4E5B9))
+
+    def mix(v):
+        v = v ^ (v >> _U64(30))
+        v = v * _U64(0xBF58476D1CE4E5B9)
+        v = v ^ (v >> _U64(27))
+        v = v * _U64(0x94D049BB133111EB)
+        return v ^ (v >> _U64(31))
+
+    h1 = mix(x)
+    h2 = mix(x ^ _U64(0xD6E8FEB86659FD93))
+    u1 = ((h1 >> _U64(11)).astype(np.float64) + 1.0) / (2.0**53 + 2)
+    u2 = (h2 >> _U64(11)).astype(np.float64) / 2.0**53
+    return np.sqrt(-2.0 * np.log(u1)) * np.cos(2.0 * np.pi * u2)
+
+
+def _labels_from_logits(rng: np.random.Generator, logits: np.ndarray,
+                        noise: float) -> np.ndarray:
+    """Std-normalized logistic draw: the label is recoverable (training
+    must learn the hidden weights to beat AUC 0.5) but never separable
+    (the ``noise`` fraction of the logit scale is irreducible)."""
+    std = float(logits.std()) or 1.0
+    noisy = logits + rng.normal(0.0, noise * std, size=logits.shape)
+    prob = 1.0 / (1.0 + np.exp(-2.5 * noisy / std))
+    return (rng.random(logits.shape) < prob).astype(np.float32)
+
+
+# --- Criteo-schema spec --------------------------------------------------
+
+@dataclass(frozen=True)
+class CriteoSpec:
+    """Shape of the synthetic Criteo-schema stream: per-table vocab
+    sizes (log-spread, like the real Criteo tables' wild cardinality
+    mix), per-table embedding dims (rank-laddered: bigger vocab, wider
+    embedding), and the zipf skew."""
+
+    vocabs: Tuple[int, ...]
+    dims: Tuple[int, ...]
+    alpha: float = 1.05
+    num_dense: int = NUM_DENSE
+    label_noise: float = 0.25
+
+    @property
+    def num_tables(self) -> int:
+        return len(self.vocabs)
+
+    @property
+    def sign_offsets(self) -> np.ndarray:
+        """Per-table base offsets keeping sign ranges disjoint in the
+        shared PS keyspace (+1 everywhere keeps sign 0 = "missing")."""
+        return np.concatenate(
+            [[0], np.cumsum(np.asarray(self.vocabs, np.int64))])[:-1]
+
+    @classmethod
+    def build(cls, scale: float = 1.0, alpha: float = 1.05,
+              num_tables: int = NUM_TABLES,
+              num_dense: int = NUM_DENSE) -> "CriteoSpec":
+        """Deterministic spec: vocabs log-spaced from ~100*scale to
+        ~200k*scale, shuffled by a fixed stride so neighboring columns
+        don't ramp monotonically; dims follow vocab rank (the realistic
+        big-table-wide-embedding mix)."""
+        lo, hi = max(50, int(100 * scale)), max(200, int(200_000 * scale))
+        v = np.logspace(np.log10(lo), np.log10(hi), num_tables)
+        stride = 11 if num_tables % 11 else 7
+        perm = (np.arange(num_tables) * stride) % num_tables
+        vocabs = tuple(int(x) for x in v[perm])
+        order = np.argsort(np.argsort(vocabs))  # rank of each table
+        third = max(1, num_tables // 3)
+        dims = tuple(
+            32 if r >= num_tables - third else (16 if r >= third else 8)
+            for r in order)
+        return cls(vocabs=vocabs, dims=dims, alpha=float(alpha),
+                   num_dense=num_dense)
+
+
+def _spec_cdfs(spec: CriteoSpec) -> list:
+    return [zipf_cdf(v, spec.alpha) for v in spec.vocabs]
+
+
+def dlrm_batches(
+    num_samples: int,
+    batch_size: int = 4096,
+    seed: int = 0,
+    spec: Optional[CriteoSpec] = None,
+    requires_grad: bool = True,
+) -> Iterator[PersiaBatch]:
+    """Criteo-schema DLRM stream: per-table zipf sign draws, 13 dense
+    floats (log1p of positive draws, like the real transform), and a
+    recoverable label from fixed hidden per-(table, id) weights + a
+    dense linear term."""
+    spec = spec or CriteoSpec.build()
+    rng = np.random.default_rng([seed, 0xD12])
+    cdfs = _spec_cdfs(spec)
+    offsets = spec.sign_offsets
+    dense_w = hidden_weight(
+        np.arange(spec.num_dense, dtype=np.uint64) + _U64(1 << 20),
+        np.full(spec.num_dense, 7, np.uint64)) * 0.5
+    for batch_id, start in enumerate(range(0, num_samples, batch_size)):
+        n = min(batch_size, num_samples - start)
+        ids = np.empty((n, spec.num_tables), dtype=np.int64)
+        for t in range(spec.num_tables):
+            ids[:, t] = zipf_ranks(rng, cdfs[t], n)
+        dense = np.log1p(np.abs(rng.normal(
+            size=(n, spec.num_dense)))).astype(np.float32)
+        logits = np.zeros(n, np.float64)
+        for t in range(spec.num_tables):
+            logits += hidden_weight(np.full(n, t, np.uint64),
+                                    ids[:, t].astype(np.uint64))
+        logits /= np.sqrt(spec.num_tables)
+        logits += dense.astype(np.float64) @ dense_w
+        label = _labels_from_logits(rng, logits, spec.label_noise)
+        signs = (ids + offsets[None, :] + 1).astype(np.uint64)
+        yield PersiaBatch(
+            [IDTypeFeatureWithSingleID(
+                CRITEO_SLOT_NAMES[t], np.ascontiguousarray(signs[:, t]))
+             for t in range(spec.num_tables)],
+            non_id_type_features=[NonIDTypeFeature(dense)],
+            labels=[Label(label.reshape(n, 1))],
+            requires_grad=requires_grad,
+            batch_id=batch_id,
+        )
+
+
+# --- Criteo-shaped legacy streams (the examples' shared path) ------------
+
+def criteo_uniform_batches(
+    num_samples: int,
+    batch_size: int = 4096,
+    seed: int = 0,
+    vocab_per_slot: int = 1 << 20,
+    requires_grad: bool = True,
+) -> Iterator[PersiaBatch]:
+    """Criteo-shaped stream with UNIFORM sign draws and noise labels —
+    the shape-only smoke stream (examples/criteo ``synthetic_batches``
+    now aliases this; draw order is bit-compatible with the historical
+    implementation, so existing goldens hold)."""
+    rng = np.random.default_rng(seed)
+    for batch_id, start in enumerate(range(0, num_samples, batch_size)):
+        n = min(batch_size, num_samples - start)
+        signs = rng.integers(1, vocab_per_slot, size=(n, NUM_TABLES),
+                             dtype=np.uint64)
+        dense = rng.normal(size=(n, NUM_DENSE)).astype(np.float32)
+        label = (rng.random((n, 1)) < 0.25).astype(np.float32)
+        yield PersiaBatch(
+            [IDTypeFeatureWithSingleID(
+                CRITEO_SLOT_NAMES[i], np.ascontiguousarray(signs[:, i]))
+             for i in range(NUM_TABLES)],
+            non_id_type_features=[NonIDTypeFeature(dense)],
+            labels=[Label(label)],
+            requires_grad=requires_grad,
+            batch_id=batch_id,
+        )
+
+
+def criteo_learnable_batches(
+    num_samples: int,
+    batch_size: int = 4096,
+    seed: int = 0,
+    vocab_per_slot: int = 1000,
+    noise: float = 0.25,
+    requires_grad: bool = True,
+) -> Iterator[PersiaBatch]:
+    """Criteo-shaped stream with a *recoverable* signal: labels come
+    from fixed hidden per-id weights (:func:`hidden_weight` — seed-
+    independent) + a dense linear term. Bit-compatible with the
+    historical examples/criteo ``learnable_batches`` (same splitmix64
+    weights, same draw order), now the examples' shared path."""
+    rng = np.random.default_rng(seed)
+    hidden = np.random.default_rng(424242)
+    dense_w = hidden.normal(0.0, 0.5, size=NUM_DENSE)
+    slot_idx = np.arange(NUM_TABLES, dtype=np.uint64)[None, :]
+    for batch_id, start in enumerate(range(0, num_samples, batch_size)):
+        n = min(batch_size, num_samples - start)
+        ids = rng.integers(0, vocab_per_slot, size=(n, NUM_TABLES))
+        dense = rng.normal(size=(n, NUM_DENSE)).astype(np.float32)
+        logits = hidden_weight(slot_idx, ids).sum(axis=1)
+        logits += dense @ dense_w
+        std = float(logits.std()) or 1.0  # n==1 tail batch: std is 0
+        logits += rng.normal(0.0, noise * std, size=n)
+        prob = 1.0 / (1.0 + np.exp(-2.5 * logits / std))
+        label = (rng.random(n) < prob).astype(np.float32)[:, None]
+        # distinct sign ranges per slot; +1 keeps sign 0 = "missing"
+        signs = (ids + np.arange(NUM_TABLES)[None, :] * vocab_per_slot
+                 + 1).astype(np.uint64)
+        yield PersiaBatch(
+            [IDTypeFeatureWithSingleID(
+                CRITEO_SLOT_NAMES[i], np.ascontiguousarray(signs[:, i]))
+             for i in range(NUM_TABLES)],
+            non_id_type_features=[NonIDTypeFeature(dense)],
+            labels=[Label(label)],
+            requires_grad=requires_grad,
+            batch_id=batch_id,
+        )
+
+
+# --- session / sequence scenario -----------------------------------------
+
+@dataclass(frozen=True)
+class SeqRecSpec:
+    """Session-traffic shape: an item sign space shared by the ragged
+    history slots AND the target slot (one logical item table read
+    three ways), small profile vocabs, hidden cluster structure."""
+
+    item_vocab: int = 20_000
+    profile_vocabs: Tuple[int, ...] = (500, 64)
+    n_clusters: int = 16
+    t_hist: int = 20
+    last_n: int = 4
+    alpha: float = 1.05
+    num_dense: int = 4
+    dim: int = 16
+
+
+SEQ_PROFILE_SLOTS = ("user_geo", "user_device")
+SEQ_HISTORY_SLOT = "recent_items"
+SEQ_CLICKS_SLOT = "recent_clicks"
+SEQ_TARGET_SLOT = "target_item"
+
+
+def seqrec_batches(
+    num_samples: int,
+    batch_size: int = 512,
+    seed: int = 0,
+    spec: Optional[SeqRecSpec] = None,
+    requires_grad: bool = True,
+) -> Iterator[PersiaBatch]:
+    """Sessions whose label hides in the HISTORY: every item belongs to
+    a hidden cluster (``id % n_clusters`` — opaque to the model, which
+    only sees signs); "engaged" sessions draw their history from the
+    target item's cluster and click with p=0.85, "browsing" sessions
+    draw zipf-at-large and click with p=0.15. Only a model that pools
+    per-item embeddings over the ragged history can find the signal —
+    the worker-tier mean/last-N pooling path is the only road to it.
+    """
+    spec = spec or SeqRecSpec()
+    rng = np.random.default_rng([seed, 0x5E9])
+    cdf = zipf_cdf(spec.item_vocab, spec.alpha)
+    nc = spec.n_clusters
+    for batch_id, start in enumerate(range(0, num_samples, batch_size)):
+        n = min(batch_size, num_samples - start)
+        target = zipf_ranks(rng, cdf, n) + 1  # 1-based item ids
+        engaged = rng.random(n) < 0.5
+        hist = zipf_ranks(rng, cdf, (n, spec.t_hist)) + 1
+        # snap engaged histories onto the target's cluster
+        same = (hist // nc) * nc + (target % nc)[:, None]
+        hist = np.where(engaged[:, None], same, hist)
+        np.clip(hist, 1, spec.item_vocab - 1, out=hist)
+        lengths = rng.integers(max(2, spec.t_hist // 4),
+                               spec.t_hist + 1, size=n)
+        label = np.where(engaged, rng.random(n) < 0.85,
+                         rng.random(n) < 0.15).astype(np.float32)
+        hist_rows = [np.ascontiguousarray(hist[i, :lengths[i]], np.uint64)
+                     for i in range(n)]
+        # the clicked sub-history: every other item, at least one
+        click_rows = [r[::2] if len(r) > 1 else r for r in hist_rows]
+        dense = rng.normal(size=(n, spec.num_dense)).astype(np.float32)
+        profiles = [
+            IDTypeFeatureWithSingleID(
+                name,
+                (rng.integers(0, pv, size=n)
+                 + spec.item_vocab + 1
+                 + sum(spec.profile_vocabs[:i])).astype(np.uint64))
+            for i, (name, pv) in enumerate(
+                zip(SEQ_PROFILE_SLOTS, spec.profile_vocabs))
+        ]
+        yield PersiaBatch(
+            profiles
+            + [IDTypeFeature(SEQ_HISTORY_SLOT, hist_rows),
+               IDTypeFeature(SEQ_CLICKS_SLOT, click_rows),
+               IDTypeFeatureWithSingleID(
+                   SEQ_TARGET_SLOT,
+                   np.ascontiguousarray(target, np.uint64))],
+            non_id_type_features=[NonIDTypeFeature(dense)],
+            labels=[Label(label.reshape(n, 1))],
+            requires_grad=requires_grad,
+            batch_id=batch_id,
+        )
+
+
+# --- multi-task scenario -------------------------------------------------
+
+@dataclass(frozen=True)
+class MultiTaskSpec:
+    """Two objectives (click, convert) over ONE shared set of embedding
+    tables. The convert logits reuse 60% of the click logits plus their
+    own hidden weights, so the tasks are correlated but not identical —
+    the regime where a shared bottom genuinely transfers."""
+
+    user_vocab: int = 20_000
+    item_vocab: int = 50_000
+    ctx_vocabs: Tuple[int, ...] = (100, 30)
+    alpha: float = 1.05
+    num_dense: int = 6
+    dim: int = 16
+    label_noise: float = 0.25
+    convert_carryover: float = 0.6
+
+
+MT_TASKS = ("click", "convert")
+MT_SLOTS = ("user", "item", "ctx_0", "ctx_1")
+
+
+def multitask_batches(
+    num_samples: int,
+    batch_size: int = 1024,
+    seed: int = 0,
+    spec: Optional[MultiTaskSpec] = None,
+    requires_grad: bool = True,
+) -> Iterator[PersiaBatch]:
+    """Zipf user/item draws; labels land as ONE (batch, 2) array
+    (click, convert) so the existing single-Label train plumbing carries
+    both objectives unchanged."""
+    spec = spec or MultiTaskSpec()
+    rng = np.random.default_rng([seed, 0x307])
+    u_cdf = zipf_cdf(spec.user_vocab, spec.alpha)
+    i_cdf = zipf_cdf(spec.item_vocab, spec.alpha)
+    base_item = spec.user_vocab + 1
+    base_ctx = base_item + spec.item_vocab
+    for batch_id, start in enumerate(range(0, num_samples, batch_size)):
+        n = min(batch_size, num_samples - start)
+        user = zipf_ranks(rng, u_cdf, n).astype(np.uint64)
+        item = zipf_ranks(rng, i_cdf, n).astype(np.uint64)
+        ctx = [rng.integers(0, cv, size=n).astype(np.uint64)
+               for cv in spec.ctx_vocabs]
+        dense = rng.normal(size=(n, spec.num_dense)).astype(np.float32)
+        shared = (hidden_weight(np.full(n, 0, np.uint64), user)
+                  + hidden_weight(np.full(n, 1, np.uint64), item))
+        # the pairwise term is intentionally small: a shared-bottom
+        # model cannot memorize (user, item) pairs, so it acts as
+        # structured label noise — at 0.5x it bounds click AUC without
+        # drowning the learnable per-sign weights
+        click_logits = shared + 0.5 * hidden_weight(
+            np.full(n, 2, np.uint64), user * _U64(3) + item)
+        conv_logits = (spec.convert_carryover * click_logits
+                       + hidden_weight(np.full(n, 3, np.uint64), item)
+                       + hidden_weight(np.full(n, 4, np.uint64), user))
+        label = np.stack(
+            [_labels_from_logits(rng, click_logits, spec.label_noise),
+             _labels_from_logits(rng, conv_logits, spec.label_noise)],
+            axis=1)
+        feats = [
+            IDTypeFeatureWithSingleID("user", user + _U64(1)),
+            IDTypeFeatureWithSingleID("item", item + _U64(base_item)),
+        ]
+        off = base_ctx
+        for i, c in enumerate(ctx):
+            feats.append(IDTypeFeatureWithSingleID(
+                MT_SLOTS[2 + i], c + _U64(off)))
+            off += spec.ctx_vocabs[i]
+        yield PersiaBatch(
+            feats,
+            non_id_type_features=[NonIDTypeFeature(dense)],
+            labels=[Label(label)],
+            requires_grad=requires_grad,
+            batch_id=batch_id,
+        )

@@ -1,0 +1,127 @@
+"""Dense towers for the workload-zoo scenarios (flax.linen, bf16-first).
+
+All three share the repo's model calling convention
+(``model(non_id_tensors, embedding_tensors, train=...)``) and run on
+the existing ctx/pipeline stack unchanged — the zoo adds model SHAPES
+(mixed embedding dims, worker-pooled session slots, multi-task heads),
+not a new training path.
+"""
+
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from persia_tpu.models.common import MLP
+
+
+def _pool_if_raw(e, dt):
+    """(bs, dim) pooled slots pass through; a raw (emb, index) pair is
+    mean-pooled on device (fallback — zoo schemas pool on the worker)."""
+    if isinstance(e, (tuple, list)):
+        from persia_tpu.models.common import gather_raw_embedding
+
+        emb, index = e
+        gathered, mask = gather_raw_embedding(emb, index)
+        denom = jnp.maximum(mask.sum(axis=1, keepdims=True), 1)
+        return (gathered.sum(axis=1) / denom).astype(dt)
+    return e.astype(dt)
+
+
+class ZooDLRM(nn.Module):
+    """DLRM-shaped tower over a MIXED-dim embedding schema.
+
+    The classic DLRM interaction needs every field at one width; real
+    schemas ladder dims by table cardinality. Fields whose dim differs
+    from ``proj_dim`` go through a per-field linear projection first
+    (the standard mixed-dim DLRM trick), then the usual lower-triangle
+    pairwise dots + bottom/top MLPs.
+    """
+
+    proj_dim: int = 16
+    bottom_mlp: Sequence[int] = (64, 32)
+    top_mlp: Sequence[int] = (128, 64)
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, non_id_tensors, embedding_tensors,
+                 train: bool = False):
+        dt = self.compute_dtype
+        dense_x = non_id_tensors[0].astype(dt)
+        bottom = MLP((*self.bottom_mlp, self.proj_dim),
+                     compute_dtype=dt)(dense_x, train)
+        fields = []
+        for i, e in enumerate(embedding_tensors):
+            x = _pool_if_raw(e, dt)
+            if x.shape[-1] != self.proj_dim:
+                x = nn.Dense(self.proj_dim, dtype=dt,
+                             name=f"field_proj_{i}")(x)
+            fields.append(x)
+        t = jnp.stack([bottom, *fields], axis=1)  # (bs, F+1, proj_dim)
+        dots = jnp.einsum("bfd,bgd->bfg", t, t)
+        f = t.shape[1]
+        iu, ju = jnp.triu_indices(f, k=1)
+        interactions = dots[:, iu, ju]
+        top_in = jnp.concatenate([bottom, interactions.astype(dt)], axis=1)
+        out = MLP((*self.top_mlp, 1), final_activation=False,
+                  compute_dtype=dt)(top_in, train)
+        return nn.sigmoid(out.astype(jnp.float32))
+
+
+class PooledSessionNet(nn.Module):
+    """Session tower over WORKER-pooled slots: every embedding input is
+    already a (bs, dim) vector (mean / last-N pooling ran on the worker
+    tier), so the device side is one concat + MLP — the cheap-inference
+    counterpart of the attention SequenceTower."""
+
+    mlp: Sequence[int] = (128, 64)
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, non_id_tensors, embedding_tensors,
+                 train: bool = False):
+        dt = self.compute_dtype
+        parts = [t.astype(dt) for t in non_id_tensors]
+        parts += [_pool_if_raw(e, dt) for e in embedding_tensors]
+        x = jnp.concatenate(parts, axis=1)
+        out = MLP((*self.mlp, 1), final_activation=False,
+                  compute_dtype=dt)(x, train)
+        return nn.sigmoid(out.astype(jnp.float32))
+
+
+class MultiTaskDNN(nn.Module):
+    """Shared-bottom multi-task tower: one trunk over the shared
+    embedding tables + dense features, one small head per task,
+    predictions concatenated to (bs, num_tasks) — labels travel as one
+    (bs, num_tasks) array, so the whole single-Label train path (packed
+    wire, DDP step, pipeline) carries both objectives unchanged."""
+
+    num_tasks: int = 2
+    bottom_mlp: Sequence[int] = (128, 64)
+    head_mlp: Sequence[int] = (32,)
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, non_id_tensors, embedding_tensors,
+                 train: bool = False):
+        dt = self.compute_dtype
+        parts = [t.astype(dt) for t in non_id_tensors]
+        parts += [_pool_if_raw(e, dt) for e in embedding_tensors]
+        x = jnp.concatenate(parts, axis=1)
+        trunk = MLP(tuple(self.bottom_mlp), compute_dtype=dt)(x, train)
+        heads = []
+        for t in range(self.num_tasks):
+            h = MLP((*self.head_mlp, 1), final_activation=False,
+                    compute_dtype=dt, name=f"head_{t}")(trunk, train)
+            heads.append(h)
+        out = jnp.concatenate(heads, axis=1)
+        return nn.sigmoid(out.astype(jnp.float32))
+
+
+def multitask_bce(pred: jnp.ndarray, label: jnp.ndarray) -> jnp.ndarray:
+    """Mean BCE over every task column: d(L)/d(shared embedding) is the
+    SUM of the per-task gradients (the shared-table accounting the zoo
+    tests pin), scaled by 1/num_tasks."""
+    pred = jnp.clip(pred, 1e-7, 1.0 - 1e-7)
+    return -jnp.mean(label * jnp.log(pred)
+                     + (1.0 - label) * jnp.log(1.0 - pred))

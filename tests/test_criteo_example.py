@@ -78,6 +78,40 @@ def test_synthetic_batches_shape():
     assert all(len(b.id_type_features) == NUM_SLOTS for b in bs)
 
 
+def test_example_uses_shared_workloads_generator():
+    """The example's synthetic streams ARE the workload zoo's (one
+    shared definition for tests, benches and examples), and the shared
+    stream is deterministic per seed."""
+    from persia_tpu.workloads import generator as zoo
+
+    assert synthetic_batches is zoo.criteo_uniform_batches
+    from criteo_data import learnable_batches
+
+    assert learnable_batches is zoo.criteo_learnable_batches
+    a = next(iter(synthetic_batches(64, 64, seed=5)))
+    b = next(iter(zoo.criteo_uniform_batches(64, 64, seed=5)))
+    assert a.to_bytes() == b.to_bytes()
+
+
+def test_example_training_smoke_zoo_model(tmp_path):
+    """The zoo's mixed-dim tower (zoo-dlrm) through the example's full
+    hybrid path — the shared generator + shared model combination."""
+    criteo_train = _load_criteo_train()
+
+    path = tmp_path / "train.tsv"
+    write_synthetic_tsv(str(path), 400, seed=11)
+    args = __import__("argparse").Namespace(
+        train=str(path), test=None, synthetic=False, local=True,
+        embedding_config="/nonexistent", num_remote_workers=1,
+        model="zoo-dlrm", dim=8, batch_size=128, samples=400,
+        test_samples=128, vocab=1 << 12, n_ps=2, ps_capacity=100_000,
+        ps_shards=4, lr=0.05, sparse_lr=0.05, staleness=4, num_workers=2,
+        mesh=None, grad_reduce_dtype=None, seed=0, log_every=100,
+    )
+    auc = criteo_train.main(args)
+    assert np.isfinite(auc)
+
+
 def test_non_hex_tokens_do_not_crash(tmp_path):
     """Corrupt/non-hex categorical tokens fall back to raw-byte packing
     instead of aborting the stream mid-epoch."""

@@ -1,0 +1,331 @@
+"""Workload zoo: generator determinism, zipf fidelity, worker-tier
+ragged pooling parity, multi-task gradient accounting, scenario
+registry round-trips, and the planner's predicted-vs-measured delta."""
+
+import numpy as np
+import pytest
+
+from persia_tpu import hotness as hot
+from persia_tpu.config import EmbeddingSchema, SlotConfig
+from persia_tpu.worker import middleware as mw
+from persia_tpu.workloads import generator as gen
+from persia_tpu.workloads import get_scenario, scenario_names
+
+
+# --- generator determinism ----------------------------------------------
+
+@pytest.mark.parametrize("name", ["dlrm", "seqrec", "multitask"])
+def test_generator_determinism_same_seed_identical_batches(name):
+    sc = get_scenario(name, smoke=True)
+    a = [b.to_bytes() for b in sc.batches(3 * 64, 64, seed=7)]
+    b = [b.to_bytes() for b in sc.batches(3 * 64, 64, seed=7)]
+    assert a == b
+    c = [b.to_bytes() for b in sc.batches(3 * 64, 64, seed=8)]
+    assert a != c
+
+
+def test_hidden_task_is_seed_independent():
+    """Different seeds are disjoint draws from the SAME task: the
+    hidden per-sign weights must not move with the generator seed."""
+    ids = np.arange(1, 200, dtype=np.uint64)
+    w1 = gen.hidden_weight(np.full(len(ids), 3, np.uint64), ids)
+    w2 = gen.hidden_weight(np.full(len(ids), 3, np.uint64), ids)
+    np.testing.assert_array_equal(w1, w2)
+    assert abs(float(w1.mean())) < 0.3  # ~N(0,1), not degenerate
+    assert 0.5 < float(w1.std()) < 1.5
+
+
+# --- zipf fidelity -------------------------------------------------------
+
+@pytest.mark.parametrize("alpha", [0.9, 1.05, 1.3])
+def test_generated_traffic_fits_configured_alpha(alpha):
+    """The skew knob is real: exact rank counts of a generated stream
+    fit back (hotness.fit_zipf_alpha) to the configured alpha."""
+    rng = np.random.default_rng(3)
+    vocab = 5000
+    cdf = gen.zipf_cdf(vocab, alpha)
+    ranks = gen.zipf_ranks(rng, cdf, 400_000)
+    counts = np.bincount(ranks, minlength=vocab)
+    counts = np.sort(counts[counts > 0])[::-1].astype(float)
+    fitted = hot.fit_zipf_alpha(counts[:1000])
+    assert fitted is not None
+    assert abs(fitted - alpha) < 0.15, (fitted, alpha)
+
+
+def test_dlrm_traffic_alpha_through_armed_holder():
+    """End-to-end telemetry fit: ONE dlrm table's generated sign stream
+    through a hotness-armed holder fits back near the configured alpha
+    — the planner's input is trustworthy on traffic it did not
+    generate. (PS hotness tables are keyed by dim; feeding a single
+    feature keeps the stream un-blended — a full 26-table run merges
+    disjoint zipf heads per dim, which legitimately flattens the
+    blended fit.)"""
+    from persia_tpu.ps.store import EmbeddingHolder
+
+    spec = gen.CriteoSpec.build(scale=0.2, alpha=1.1)
+    h = EmbeddingHolder(500_000, 4, hotness=True)
+    h.configure("bounded_uniform", {"lower": -0.01, "upper": 0.01})
+    h.register_optimizer({
+        "type": "adagrad", "lr": 0.05, "initialization": 0.01,
+        "g_square_momentum": 1.0, "vectorwise_shared": False})
+    # the widest-vocab table has the most fit-able head
+    t = int(np.argmax(spec.vocabs))
+    feature = gen.CRITEO_SLOT_NAMES[t]
+    dim = spec.dims[t]
+    for b in gen.dlrm_batches(40 * 1024, 1024, spec=spec,
+                              requires_grad=False):
+        f = next(x for x in b.id_type_features if x.name == feature)
+        h.lookup(f.signs, dim, training=True)
+    snap = h.hotness_snapshot()
+    assert snap.get("enabled")
+    fit = hot.summary_view(snap)["tables"][str(dim)]["zipf_alpha"]
+    assert fit is not None
+    assert abs(fit - 1.1) < 0.35, fit
+
+
+# --- ragged pooling parity ----------------------------------------------
+
+def _ragged_feature(rng, n=7, vocab=60, max_len=9):
+    from persia_tpu.data.batch import IDTypeFeature
+
+    rows = [rng.integers(1, vocab,
+                         size=rng.integers(1, max_len),
+                         dtype=np.uint64) for _ in range(n)]
+    return IDTypeFeature("s", rows), rows
+
+
+@pytest.mark.parametrize("pooling", ["sum", "mean", "last3"])
+def test_pooled_worker_result_bitmatches_dense_reference(pooling):
+    """The pooled (batch, dim) worker output is BIT-identical to a
+    per-sample dense loop that sums rows in CSR (arrival) order and
+    applies the same post-scale — the contract the backend-parity and
+    reproducibility goldens extend to the new pooling modes."""
+    rng = np.random.default_rng(11)
+    feat, rows = _ragged_feature(rng)
+    df = mw.dedup_feature(feat)
+    dim = 6
+    emb = rng.normal(size=(df.num_distinct, dim)).astype(np.float32)
+    slot = SlotConfig("s", dim, pooling=pooling)
+    out = mw.postprocess_feature(df, slot, emb).embeddings
+
+    row_of = {int(s): i for i, s in enumerate(df.distinct_signs)}
+    ref = np.zeros((len(rows), dim), np.float32)
+    for i, r in enumerate(rows):
+        sel = r[-3:] if pooling == "last3" else r
+        acc = np.zeros(dim, np.float32)
+        for sid in sel:  # element order == CSR order
+            acc = acc + emb[row_of[int(sid)]]
+        if pooling == "mean":
+            acc = acc * (np.float32(1.0) / np.float32(len(r)))
+        ref[i] = acc
+    np.testing.assert_array_equal(out, ref)
+
+
+@pytest.mark.parametrize("pooling", ["sum", "mean", "last3"])
+def test_pooled_gradient_is_adjoint_of_forward(pooling):
+    """The pooled forward is a linear map F; aggregate_gradients must
+    be its adjoint: <F(E), G> == <E, aggregate(G)> for random E, G."""
+    rng = np.random.default_rng(5)
+    feat, rows = _ragged_feature(rng)
+    df = mw.dedup_feature(feat)
+    dim = 4
+    slot = SlotConfig("s", dim, pooling=pooling)
+    E = rng.normal(size=(df.num_distinct, dim)).astype(np.float32)
+    G = rng.normal(size=(len(rows), dim)).astype(np.float32)
+
+    lhs = float((mw.postprocess_feature(df, slot, E).embeddings
+                 * G).sum())
+    agg = mw.aggregate_gradients(df, slot, G)
+    assert agg.shape == (df.num_distinct, dim)
+    rhs = float((E * agg).sum())
+    np.testing.assert_allclose(lhs, rhs, rtol=1e-4, atol=1e-5)
+
+
+def test_pooling_config_validation():
+    with pytest.raises(ValueError):
+        SlotConfig("x", 4, pooling="bogus")
+    with pytest.raises(ValueError):
+        SlotConfig("x", 4, pooling="mean", embedding_summation=False)
+    with pytest.raises(ValueError):
+        SlotConfig("x", 4, pooling="last2", sqrt_scaling=True)
+    from persia_tpu.config import HashStackConfig
+
+    with pytest.raises(ValueError):
+        SlotConfig("x", 4, pooling="mean",
+                   hash_stack_config=HashStackConfig(2, 100))
+    assert SlotConfig("x", 4, pooling="last10").pooling_last_n == 10
+
+
+def test_pooling_survives_yaml_roundtrip():
+    """Schema -> service yaml dict -> EmbeddingSchema keeps pooling
+    (the worker subprocess must pool exactly like the in-process
+    worker)."""
+    from persia_tpu.service.helper import _schema_to_yaml_dict
+
+    sc = get_scenario("seqrec", smoke=True)
+    raw = _schema_to_yaml_dict(sc.schema)
+    back = EmbeddingSchema.from_dict(raw)
+    for name, slot in sc.schema.slots_config.items():
+        assert back.get_slot(name).pooling == slot.pooling
+
+
+def test_pooled_lookup_through_worker_and_service_wire():
+    """A pooled slot round-trips the worker lookup AND the service
+    serialization as a plain SumEmbedding — no new wire kind."""
+    from persia_tpu.ps.store import EmbeddingHolder
+    from persia_tpu.service.serialization import (
+        pack_lookup_result,
+        unpack_lookup_result,
+    )
+    from persia_tpu.worker.middleware import SumEmbedding
+    from persia_tpu.worker.worker import EmbeddingWorker
+
+    sc = get_scenario("seqrec", smoke=True)
+    h = EmbeddingHolder(100_000, 2)
+    h.configure("bounded_uniform", {"lower": -0.05, "upper": 0.05})
+    h.register_optimizer({
+        "type": "adagrad", "lr": 0.05, "initialization": 0.01,
+        "g_square_momentum": 1.0, "vectorwise_shared": False})
+    worker = EmbeddingWorker(sc.schema, [h])
+    try:
+        b = next(iter(sc.batches(32, 32, requires_grad=False)))
+        out = worker.lookup_direct(b.id_type_features, training=True)
+    finally:
+        worker.close()
+    for name in (gen.SEQ_HISTORY_SLOT, gen.SEQ_CLICKS_SLOT):
+        assert isinstance(out[name], SumEmbedding)
+        assert out[name].embeddings.shape == (32, 16)
+    back = unpack_lookup_result(pack_lookup_result(out))
+    for name, r in out.items():
+        assert isinstance(back[name], SumEmbedding)
+        np.testing.assert_array_equal(back[name].embeddings,
+                                      r.embeddings)
+
+
+# --- multi-task shared-table gradient accounting -------------------------
+
+def test_multitask_shared_table_gradient_accounting():
+    """With L = L_click + L_convert over ONE shared embedding input,
+    the per-sign gradient the worker aggregates equals the SUM of the
+    two tasks' per-sign gradients — no double count, no lost half."""
+    import jax
+    import jax.numpy as jnp
+
+    from persia_tpu.workloads.models import MultiTaskDNN
+
+    sc = get_scenario("multitask", smoke=True)
+    batch = next(iter(sc.batches(16, 16)))
+    model = MultiTaskDNN(num_tasks=2)
+    non_id = [jnp.asarray(batch.non_id_type_features[0].data)]
+    rng = np.random.default_rng(0)
+    emb_inputs = [
+        jnp.asarray(rng.normal(size=(16, sc.schema.get_slot(f.name).dim))
+                    .astype(np.float32))
+        for f in batch.id_type_features
+    ]
+    params = model.init(jax.random.key(0), non_id, emb_inputs)
+    label = jnp.asarray(batch.labels[0].data)
+
+    def task_loss(embs, t):
+        pred = model.apply(params, non_id, embs)
+        p = jnp.clip(pred[:, t], 1e-7, 1 - 1e-7)
+        y = label[:, t]
+        return -jnp.mean(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+
+    def joint(embs):
+        return task_loss(embs, 0) + task_loss(embs, 1)
+
+    g_joint = jax.grad(joint)(emb_inputs)
+    g_click = jax.grad(lambda e: task_loss(e, 0))(emb_inputs)
+    g_conv = jax.grad(lambda e: task_loss(e, 1))(emb_inputs)
+    for gj, gc, gv in zip(g_joint, g_click, g_conv):
+        np.testing.assert_allclose(np.asarray(gj),
+                                   np.asarray(gc) + np.asarray(gv),
+                                   rtol=1e-4, atol=1e-5)
+    # and through the worker's aggregation: per-sign accounting is the
+    # same linear sum (duplicate signs accumulate both tasks' shares)
+    feats = mw.preprocess_batch(batch.id_type_features, sc.schema)
+    slot = sc.schema.get_slot("item")
+    fi = [f.name for f in batch.id_type_features].index("item")
+    gj = np.asarray(g_joint[fi], np.float32)
+    gc = np.asarray(g_click[fi], np.float32)
+    gv = np.asarray(g_conv[fi], np.float32)
+    agg_joint = mw.aggregate_gradients(feats[fi], slot, gj)
+    agg_split = (mw.aggregate_gradients(feats[fi], slot, gc)
+                 + mw.aggregate_gradients(feats[fi], slot, gv))
+    np.testing.assert_allclose(agg_joint, agg_split, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_multitask_labels_shape_and_tasks():
+    sc = get_scenario("multitask", smoke=True)
+    b = next(iter(sc.batches(64, 64)))
+    assert b.labels[0].data.shape == (64, 2)
+    assert sc.tasks == ("click", "convert")
+    assert sc.loss_fn is not None
+
+
+# --- scenario registry ---------------------------------------------------
+
+def test_registry_roundtrip_all_scenarios():
+    """Every registered scenario resolves, its stream matches its
+    schema (names, batch sizes), and its model initializes and runs a
+    forward pass on the stream's shapes."""
+    import jax
+    import jax.numpy as jnp
+
+    assert set(scenario_names()) >= {"dlrm", "seqrec", "multitask"}
+    for name in scenario_names():
+        sc = get_scenario(name, smoke=True)
+        b = next(iter(sc.batches(8, 8)))
+        feat_names = [f.name for f in b.id_type_features]
+        assert sorted(feat_names) == sorted(sc.schema.feature_names)
+        assert b.non_id_type_features[0].data.shape == (8, sc.num_dense)
+        for rf in sc.ragged_features:
+            assert rf in feat_names
+        # model forward on schema-shaped inputs (pooled slots = (bs, d))
+        model = sc.model()
+        non_id = [jnp.asarray(b.non_id_type_features[0].data)]
+        emb = [jnp.zeros((8, sc.schema.get_slot(f.name).dim),
+                         jnp.float32)
+               for f in b.id_type_features]
+        params = model.init(jax.random.key(0), non_id, emb)
+        pred = model.apply(params, non_id, emb)
+        assert pred.shape[0] == 8
+
+
+def test_registry_unknown_scenario_raises():
+    with pytest.raises(KeyError):
+        get_scenario("nope")
+
+
+def test_registry_honors_workload_knobs(monkeypatch):
+    monkeypatch.setenv("PERSIA_WORKLOAD_SEED", "42")
+    monkeypatch.setenv("PERSIA_WORKLOAD_ALPHA", "1.25")
+    sc = get_scenario("dlrm", smoke=True)
+    assert sc.seed == 42
+    a42 = next(iter(sc.batches(32, 32))).to_bytes()
+    monkeypatch.setenv("PERSIA_WORKLOAD_SEED", "43")
+    sc2 = get_scenario("dlrm", smoke=True)
+    assert sc2.seed == 43
+    assert next(iter(sc2.batches(32, 32))).to_bytes() != a42
+
+
+# --- planner predicted-vs-measured delta ---------------------------------
+
+def test_planner_report_measured_hit_rate_delta():
+    snap = {
+        "enabled": True,
+        "total": 1000,
+        "tables": {
+            "16": {"total": 1000, "unique_est": 100.0,
+                   "topk": [[int(s), 50, 0] for s in range(1, 11)]},
+        },
+    }
+    doc = hot.planner_report(snap, hbm_bytes=100 * 16 * 4)
+    assert "measured_overall_hit_rate" not in doc
+    doc = hot.planner_report(snap, hbm_bytes=100 * 16 * 4,
+                             measured_hit_rate=0.5)
+    assert doc["measured_overall_hit_rate"] == 0.5
+    assert doc["hit_rate_delta"] == pytest.approx(
+        doc["expected_overall_hit_rate"] - 0.5, abs=1e-6)

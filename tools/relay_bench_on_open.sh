@@ -119,6 +119,17 @@ while [ "$(date +%s)" -lt "$END" ]; do
       step "bench online (serving loop + variants)" python bench.py \
         --mode online --online-out /root/repo/BENCH_online.json \
         --max-seconds 900
+      # 4k. workload zoo (PR 15): all three production-shaped
+      #     scenarios (dlrm / seqrec / multitask) end to end at the
+      #     full row budget — per-scenario samples/s + convergence
+      #     smoke, the DLRM planner predicted-vs-measured device-cache
+      #     hit rate (the ROADMAP-item-5 validation loop), and the
+      #     ragged-free wire pin; on the TPU host the dense towers run
+      #     on real chips, so these samples/s are the production
+      #     scenario numbers; BENCH_e2e.json lands next to this log
+      step "bench e2e (workload zoo scenarios)" python bench.py \
+        --mode e2e --e2e-out /root/repo/BENCH_e2e.json \
+        --max-seconds 1400
       # 5. re-capture the headline near the end of the window
       step "re-capture: python bench.py" python bench.py
       echo "$(date -u +%FT%TZ) chip sequence complete — see BENCH_CAPTURE_r05.log" >> "$LOG"
