@@ -89,6 +89,72 @@ int ptps_register_optimizer(void* h, const char* wire) {
   return static_cast<Store*>(h)->register_optimizer(wire) ? 0 : -1;
 }
 
+// SIMD path introspection/control (simd.h). Python probes
+// ptps_simd_path to log + export the selected path; ptps_simd_force is
+// the A/B-bench and forced-scalar-parity hook ("auto" restores
+// env/hardware selection). Returns the resolved path code
+// (0 scalar | 1 avx2 | 2 neon), i.e. what will actually execute.
+const char* ptps_simd_path(void) {
+  return persia::simd_path_name(persia::simd_selected());
+}
+
+int ptps_simd_force(const char* path) {
+  int p = persia::kSimdAuto;
+  if (path != nullptr) {
+    if (std::strcmp(path, "scalar") == 0) p = persia::kSimdScalar;
+    else if (std::strcmp(path, "avx2") == 0) p = persia::kSimdAVX2;
+    else if (std::strcmp(path, "neon") == 0) p = persia::kSimdNEON;
+  }
+  persia::simd_force(p);
+  return persia::simd_selected();
+}
+
+// Standalone row conversions with an explicit path (-1 = selected):
+// the kernel A/B microbench and the SIMD-vs-scalar property tests call
+// these on flat buffers without touching a store.
+void ptps_narrow_rows(int dtype, const float* src, uint64_t n, uint8_t* dst,
+                      int path) {
+  if (dtype < 0 || dtype > persia::kRowBF16) return;
+  persia::RowDtype dt = static_cast<persia::RowDtype>(dtype);
+  int p = path == -1 ? persia::simd_selected() : persia::simd_resolve(path);
+  uint64_t isz = persia::row_itemsize(dt);
+  while (n > 0) {
+    uint32_t chunk = n > (1u << 30) ? (1u << 30) : static_cast<uint32_t>(n);
+    persia::simd_narrow_row_path(dt, src, chunk, dst, p);
+    src += chunk;
+    dst += uint64_t(chunk) * isz;
+    n -= chunk;
+  }
+}
+
+void ptps_widen_rows(int dtype, const uint8_t* src, uint64_t n, float* dst,
+                     int path) {
+  if (dtype < 0 || dtype > persia::kRowBF16) return;
+  persia::RowDtype dt = static_cast<persia::RowDtype>(dtype);
+  int p = path == -1 ? persia::simd_selected() : persia::simd_resolve(path);
+  uint64_t isz = persia::row_itemsize(dt);
+  while (n > 0) {
+    uint32_t chunk = n > (1u << 30) ? (1u << 30) : static_cast<uint32_t>(n);
+    persia::simd_widen_row_path(dt, src, chunk, dst, p);
+    src += uint64_t(chunk) * isz;
+    dst += chunk;
+    n -= chunk;
+  }
+}
+
+// Shard-parallel tuning: threads == 0 restores auto (hw capped at 8),
+// min_batch == 0 leaves the serial threshold unchanged. out[2] =
+// {resolved threads, min_batch} — the PS dispatcher's capability probe.
+void ptps_set_parallel(void* h, uint32_t threads, uint64_t min_batch) {
+  static_cast<Store*>(h)->set_parallel(threads, min_batch);
+}
+
+void ptps_get_parallel(void* h, uint64_t* out) {
+  Store* s = static_cast<Store*>(h);
+  out[0] = s->parallel_threads();
+  out[1] = s->parallel_min_batch();
+}
+
 int ptps_lookup(void* h, const uint64_t* signs, uint64_t n, uint32_t dim,
                 int training, float* out) {
   return static_cast<Store*>(h)->lookup(signs, n, dim, training != 0, out);
@@ -119,6 +185,18 @@ int64_t ptps_get_entry(void* h, uint64_t sign, float* out, uint32_t maxlen,
 int ptps_set_entry(void* h, uint64_t sign, uint32_t dim, const float* vec,
                    uint32_t len) {
   return static_cast<Store*>(h)->set_entry(sign, dim, vec, len);
+}
+
+// Batched entry access (one GIL-released foreign call per group instead
+// of one per sign): vecs/out are dense (n, len)/(n, maxlen) f32.
+int ptps_set_entries(void* h, const uint64_t* signs, uint64_t n, uint32_t dim,
+                     const float* vecs, uint32_t len) {
+  return static_cast<Store*>(h)->set_entries(signs, n, dim, vecs, len);
+}
+
+int64_t ptps_get_entries(void* h, const uint64_t* signs, uint64_t n,
+                         uint32_t maxlen, float* out, int64_t* lens) {
+  return static_cast<Store*>(h)->get_entries(signs, n, maxlen, out, lens);
 }
 
 int ptps_dump(void* h, const char* path) {

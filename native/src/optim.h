@@ -13,6 +13,8 @@
 #include <unordered_map>
 #include <vector>
 
+#include "simd.h"
+
 namespace persia {
 
 struct OptimizerConfig {
@@ -119,13 +121,16 @@ class Optimizer {
     }
   }
 
-  // One optimizer step on a single entry, in place.
+  // One optimizer step on a single entry, in place. Element-wise math
+  // dispatches through simd.h (bit-exact scalar/avx2/neon paths); the
+  // Adagrad vectorwise-shared g^2 reduction stays scalar because its
+  // sequential double-accumulation order is part of the parity contract.
   void update(float* entry, const float* grad, uint32_t dim, float b1p,
               float b2p) const {
+    const int path = simd_selected();
     switch (cfg_.kind) {
       case OptimizerConfig::kSGD: {
-        for (uint32_t i = 0; i < dim; ++i)
-          entry[i] -= cfg_.lr * (grad[i] + cfg_.wd * entry[i]);
+        simd_sgd_update(entry, grad, dim, cfg_.lr, cfg_.wd, path);
         break;
       }
       case OptimizerConfig::kAdagrad: {
@@ -134,34 +139,23 @@ class Optimizer {
           float acc = entry[dim];
           float scale =
               cfg_.lr / std::sqrt(acc + cfg_.eps);
+          simd_scale_sub(emb, grad, dim, scale, path);
           double g2 = 0.0;
-          for (uint32_t i = 0; i < dim; ++i) {
-            emb[i] -= scale * grad[i];
+          for (uint32_t i = 0; i < dim; ++i)
             g2 += static_cast<double>(grad[i]) * grad[i];
-          }
           // mean of squares accumulated in f32 like numpy's float32 mean
           float g2f = static_cast<float>(g2 / dim);
           entry[dim] = acc * cfg_.g_square_momentum + g2f;
         } else {
-          float* acc = entry + dim;
-          for (uint32_t i = 0; i < dim; ++i) {
-            emb[i] -= cfg_.lr * grad[i] / std::sqrt(acc[i] + cfg_.eps);
-            acc[i] = acc[i] * cfg_.g_square_momentum + grad[i] * grad[i];
-          }
+          simd_adagrad_update(emb, entry + dim, grad, dim, cfg_.lr, cfg_.eps,
+                              cfg_.g_square_momentum, path);
         }
         break;
       }
       case OptimizerConfig::kAdam: {
-        float* emb = entry;
-        float* m = entry + dim;
-        float* v = entry + 2 * dim;
-        for (uint32_t i = 0; i < dim; ++i) {
-          m[i] = cfg_.beta1 * m[i] + (1.0f - cfg_.beta1) * grad[i];
-          v[i] = cfg_.beta2 * v[i] + (1.0f - cfg_.beta2) * grad[i] * grad[i];
-          float m_hat = m[i] / (1.0f - b1p);
-          float v_hat = v[i] / (1.0f - b2p);
-          emb[i] -= cfg_.lr * m_hat / (cfg_.eps + std::sqrt(v_hat));
-        }
+        simd_adam_update(entry, entry + dim, entry + 2 * dim, grad, dim,
+                         cfg_.lr, cfg_.beta1, cfg_.beta2, cfg_.eps, b1p, b2p,
+                         path);
         break;
       }
     }
@@ -176,10 +170,7 @@ class Optimizer {
 };
 
 inline void weight_bound_clamp(float* emb, uint32_t dim, float bound) {
-  for (uint32_t i = 0; i < dim; ++i) {
-    if (emb[i] > bound) emb[i] = bound;
-    if (emb[i] < -bound) emb[i] = -bound;
-  }
+  simd_clamp(emb, dim, bound, simd_selected());
 }
 
 }  // namespace persia

@@ -1,0 +1,771 @@
+// Runtime-dispatched SIMD kernels for the arena hot path: fp16/bf16
+// narrow/widen (rowbytes.h) and the in-slab fp32 optimizer updates
+// (optim.h). Three paths:
+//
+//   scalar  - the rowbytes.h/optim.h reference loops (always available)
+//   avx2    - x86-64, compiled via the gcc target("avx2") attribute so a
+//             single TU carries both variants; engaged only when
+//             __builtin_cpu_supports("avx2") says the host can run it
+//   neon    - aarch64, compile-time (__aarch64__); x86 builds never
+//             reference it
+//
+// BIT-EXACTNESS CONTRACT: every vector kernel implements the SAME
+// rounding algorithm as its scalar twin, with integer ops (variable
+// shifts for the fp16 subnormal path, add-based RN-even for bf16) —
+// NOT the hardware vcvtps2ph/FCVT conversions, whose flag behaviour
+// we'd otherwise have to prove equivalent. The cross-backend parity
+// suites compare STORED bytes, so one ulp of disagreement fails them.
+// Float kernels use only IEEE-exact ops (mul/add/sub/div/sqrt, each
+// correctly rounded, no FMA — the build sets -ffp-contract=off) in the
+// same evaluation order as the scalar expressions. The Adagrad
+// vectorwise-shared g^2 reduction stays scalar (sequential double
+// accumulation order is part of the contract); only its element-wise
+// embedding update vectorizes.
+//
+// Layout invariants the kernels rely on (store.h SlabPool): a record is
+// `[emb bytes | pad to 4 | f32 state | pad to 8]`, rows are contiguous
+// within 4096-row slabs, and the f32 state view is 4-aligned — so the
+// kernels only ever need unaligned vector loads/stores over dense rows
+// plus a scalar tail of < one vector width.
+//
+// Selection: PERSIA_NATIVE_SIMD=auto|avx2|neon|scalar (read once), then
+// clamped to what the host can actually execute. simd_force() (exposed
+// as ptps_simd_force) overrides at runtime for A/B benches and the
+// forced-scalar parity lane.
+#pragma once
+
+#include <cmath>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+#include "rowbytes.h"
+
+#if defined(__x86_64__) || defined(__i386__)
+#define PERSIA_SIMD_X86 1
+#include <immintrin.h>
+#endif
+#if defined(__aarch64__)
+#define PERSIA_SIMD_NEON 1
+#include <arm_neon.h>
+#endif
+
+namespace persia {
+
+enum SimdPath : int {
+  kSimdAuto = -1,
+  kSimdScalar = 0,
+  kSimdAVX2 = 1,
+  kSimdNEON = 2,
+};
+
+inline const char* simd_path_name(int p) {
+  switch (p) {
+    case kSimdAVX2:
+      return "avx2";
+    case kSimdNEON:
+      return "neon";
+    default:
+      return "scalar";
+  }
+}
+
+// Best path this host can execute.
+inline int simd_probe_hw() {
+#if PERSIA_SIMD_X86
+  return __builtin_cpu_supports("avx2") ? kSimdAVX2 : kSimdScalar;
+#elif PERSIA_SIMD_NEON
+  return kSimdNEON;
+#else
+  return kSimdScalar;
+#endif
+}
+
+// Clamp a requested path to one the host can execute (forcing avx2 on a
+// non-AVX2 box must degrade to scalar, not SIGILL).
+inline int simd_resolve(int path) {
+  int hw = simd_probe_hw();
+  if (path == kSimdAuto) return hw;
+  if (path == kSimdAVX2 && hw != kSimdAVX2) return kSimdScalar;
+  if (path == kSimdNEON && hw != kSimdNEON) return kSimdScalar;
+  if (path != kSimdScalar && path != kSimdAVX2 && path != kSimdNEON)
+    return kSimdScalar;
+  return path;
+}
+
+inline int& simd_forced_ref() {
+  static int forced = kSimdAuto;
+  return forced;
+}
+
+// Test/bench hook (ptps_simd_force): kSimdAuto restores env/hw selection.
+inline int simd_force(int path) {
+  simd_forced_ref() = path;
+  return path == kSimdAuto ? -1 : simd_resolve(path);
+}
+
+inline int simd_env_choice() {
+  static int choice = [] {
+    const char* e = std::getenv("PERSIA_NATIVE_SIMD");
+    if (e == nullptr || std::strcmp(e, "auto") == 0 || e[0] == '\0')
+      return static_cast<int>(kSimdAuto);
+    if (std::strcmp(e, "avx2") == 0) return static_cast<int>(kSimdAVX2);
+    if (std::strcmp(e, "neon") == 0) return static_cast<int>(kSimdNEON);
+    if (std::strcmp(e, "scalar") == 0) return static_cast<int>(kSimdScalar);
+    return static_cast<int>(kSimdAuto);  // unknown value: behave as auto
+  }();
+  return choice;
+}
+
+// The path every hot-path call dispatches on.
+inline int simd_selected() {
+  int f = simd_forced_ref();
+  if (f != kSimdAuto) return simd_resolve(f);
+  return simd_resolve(simd_env_choice());
+}
+
+// ---------------------------------------------------------------------------
+// AVX2 kernels (x86-64). Single-TU multiversioning via target("avx2");
+// only reached when simd_resolve said the host supports it.
+// ---------------------------------------------------------------------------
+#if PERSIA_SIMD_X86
+
+__attribute__((target("avx2"))) inline void f32_to_f16_avx2(const float* src,
+                                                            uint32_t n,
+                                                            uint16_t* dst) {
+  const __m256i c_one = _mm256_set1_epi32(1);
+  const __m256i c_sign = _mm256_set1_epi32(0x8000);
+  const __m256i c_ff = _mm256_set1_epi32(0xFF);
+  const __m256i c_man = _mm256_set1_epi32(0x7FFFFF);
+  const __m256i c_112 = _mm256_set1_epi32(112);
+  const __m256i c_rem = _mm256_set1_epi32(0x1FFF);
+  const __m256i c_half = _mm256_set1_epi32(0x1000);
+  const __m256i c_hid = _mm256_set1_epi32(0x800000);
+  const __m256i c_14 = _mm256_set1_epi32(14);
+  const __m256i c_inf16 = _mm256_set1_epi32(0x7C00);
+  const __m256i c_quiet = _mm256_set1_epi32(0x200);
+  const __m256i c_zero = _mm256_setzero_si256();
+  uint32_t i = 0;
+  for (; i + 8 <= n; i += 8) {
+    __m256i x = _mm256_castps_si256(_mm256_loadu_ps(src + i));
+    __m256i sign = _mm256_and_si256(_mm256_srli_epi32(x, 16), c_sign);
+    __m256i exp = _mm256_and_si256(_mm256_srli_epi32(x, 23), c_ff);
+    __m256i man = _mm256_and_si256(x, c_man);
+    __m256i e = _mm256_sub_epi32(exp, c_112);
+
+    // normal: h = sign | e<<10 | man>>13, RN-even on the low 13 bits
+    __m256i h = _mm256_or_si256(
+        sign, _mm256_or_si256(_mm256_slli_epi32(e, 10),
+                              _mm256_srli_epi32(man, 13)));
+    __m256i rem = _mm256_and_si256(man, c_rem);
+    __m256i inc = _mm256_or_si256(
+        _mm256_cmpgt_epi32(rem, c_half),
+        _mm256_and_si256(_mm256_cmpeq_epi32(rem, c_half),
+                         _mm256_cmpeq_epi32(_mm256_and_si256(h, c_one),
+                                            c_one)));
+    h = _mm256_sub_epi32(h, inc);  // inc lanes are -1
+
+    // subnormal: variable shift 14-e (lanes with e < -11 are blended to
+    // bare sign below; their oversized shifts legally produce 0 here)
+    __m256i man_s = _mm256_or_si256(man, c_hid);
+    __m256i shift = _mm256_sub_epi32(c_14, e);
+    __m256i half = _mm256_srlv_epi32(man_s, shift);
+    __m256i low = _mm256_sub_epi32(_mm256_sllv_epi32(c_one, shift), c_one);
+    __m256i rem_s = _mm256_and_si256(man_s, low);
+    __m256i halfway =
+        _mm256_sllv_epi32(c_one, _mm256_sub_epi32(shift, c_one));
+    __m256i sinc = _mm256_or_si256(
+        _mm256_cmpgt_epi32(rem_s, halfway),
+        _mm256_and_si256(_mm256_cmpeq_epi32(rem_s, halfway),
+                         _mm256_cmpeq_epi32(_mm256_and_si256(half, c_one),
+                                            c_one)));
+    half = _mm256_sub_epi32(half, sinc);
+    __m256i hsub = _mm256_or_si256(sign, half);
+
+    __m256i m_sub = _mm256_cmpgt_epi32(c_one, e);  // e <= 0
+    __m256i m_tiny = _mm256_cmpgt_epi32(_mm256_set1_epi32(-11), e);
+    __m256i m_ovf = _mm256_cmpgt_epi32(e, _mm256_set1_epi32(30));
+    __m256i m_naninf = _mm256_cmpeq_epi32(exp, c_ff);
+
+    __m256i payload =
+        _mm256_or_si256(c_quiet, _mm256_srli_epi32(man, 13));
+    payload = _mm256_andnot_si256(_mm256_cmpeq_epi32(man, c_zero), payload);
+    __m256i hnan =
+        _mm256_or_si256(sign, _mm256_or_si256(c_inf16, payload));
+
+    __m256i r = _mm256_blendv_epi8(h, hsub, m_sub);
+    r = _mm256_blendv_epi8(r, sign, m_tiny);
+    r = _mm256_blendv_epi8(r, _mm256_or_si256(sign, c_inf16), m_ovf);
+    r = _mm256_blendv_epi8(r, hnan, m_naninf);
+
+    __m256i p = _mm256_packus_epi32(r, r);
+    p = _mm256_permute4x64_epi64(p, 0xE8);  // low 128 = lanes 0,2
+    _mm_storeu_si128(reinterpret_cast<__m128i*>(dst + i),
+                     _mm256_castsi256_si128(p));
+  }
+  for (; i < n; ++i) dst[i] = f32_to_f16(src[i]);
+}
+
+__attribute__((target("avx2"))) inline void f32_to_bf16_avx2(const float* src,
+                                                             uint32_t n,
+                                                             uint16_t* dst) {
+  const __m256i c_abs = _mm256_set1_epi32(0x7FFFFFFF);
+  const __m256i c_inf = _mm256_set1_epi32(0x7F800000);
+  const __m256i c_rnd = _mm256_set1_epi32(0x7FFF);
+  const __m256i c_one = _mm256_set1_epi32(1);
+  const __m256i c_quiet = _mm256_set1_epi32(0x40);
+  uint32_t i = 0;
+  for (; i + 8 <= n; i += 8) {
+    __m256i x = _mm256_castps_si256(_mm256_loadu_ps(src + i));
+    __m256i top = _mm256_srli_epi32(x, 16);
+    __m256i m_nan =
+        _mm256_cmpgt_epi32(_mm256_and_si256(x, c_abs), c_inf);
+    __m256i hnan = _mm256_or_si256(top, c_quiet);
+    __m256i lsb = _mm256_and_si256(top, c_one);
+    __m256i r = _mm256_add_epi32(x, _mm256_add_epi32(c_rnd, lsb));
+    r = _mm256_srli_epi32(r, 16);
+    r = _mm256_blendv_epi8(r, hnan, m_nan);
+    __m256i p = _mm256_packus_epi32(r, r);
+    p = _mm256_permute4x64_epi64(p, 0xE8);
+    _mm_storeu_si128(reinterpret_cast<__m128i*>(dst + i),
+                     _mm256_castsi256_si128(p));
+  }
+  for (; i < n; ++i) dst[i] = f32_to_bf16(src[i]);
+}
+
+__attribute__((target("avx2"))) inline void f16_to_f32_avx2(
+    const uint16_t* src, uint32_t n, float* dst) {
+  const __m256i c_sign = _mm256_set1_epi32(0x8000);
+  const __m256i c_e5 = _mm256_set1_epi32(0x1F);
+  const __m256i c_man = _mm256_set1_epi32(0x3FF);
+  const __m256i c_112 = _mm256_set1_epi32(112);
+  const __m256i c_inf = _mm256_set1_epi32(0x7F800000);
+  const __m256i c_zero = _mm256_setzero_si256();
+  // float(man) * 2^-24 is exact (<= 11 significant bits, scale by a
+  // power of two, min result 2^-24 is a normal f32), so its bits equal
+  // the scalar subnormal normalization loop's.
+  const __m256 c_scale = _mm256_set1_ps(5.9604644775390625e-8f);
+  uint32_t i = 0;
+  for (; i + 8 <= n; i += 8) {
+    __m128i raw =
+        _mm_loadu_si128(reinterpret_cast<const __m128i*>(src + i));
+    __m256i h = _mm256_cvtepu16_epi32(raw);
+    __m256i sign = _mm256_slli_epi32(_mm256_and_si256(h, c_sign), 16);
+    __m256i exp = _mm256_and_si256(_mm256_srli_epi32(h, 10), c_e5);
+    __m256i man = _mm256_and_si256(h, c_man);
+    __m256i man13 = _mm256_slli_epi32(man, 13);
+    __m256i normal = _mm256_or_si256(
+        sign, _mm256_or_si256(
+                  _mm256_slli_epi32(_mm256_add_epi32(exp, c_112), 23),
+                  man13));
+    __m256 subf = _mm256_mul_ps(_mm256_cvtepi32_ps(man), c_scale);
+    __m256i subn = _mm256_or_si256(_mm256_castps_si256(subf), sign);
+    __m256i m_e0 = _mm256_cmpeq_epi32(exp, c_zero);
+    __m256i m_m0 = _mm256_cmpeq_epi32(man, c_zero);
+    __m256i m_inf = _mm256_cmpeq_epi32(exp, c_e5);
+    __m256i r = _mm256_blendv_epi8(normal, subn, m_e0);
+    r = _mm256_blendv_epi8(r, sign, _mm256_and_si256(m_e0, m_m0));
+    r = _mm256_blendv_epi8(
+        r, _mm256_or_si256(sign, _mm256_or_si256(c_inf, man13)), m_inf);
+    _mm256_storeu_ps(dst + i, _mm256_castsi256_ps(r));
+  }
+  for (; i < n; ++i) dst[i] = f16_to_f32(src[i]);
+}
+
+__attribute__((target("avx2"))) inline void bf16_to_f32_avx2(
+    const uint16_t* src, uint32_t n, float* dst) {
+  uint32_t i = 0;
+  for (; i + 8 <= n; i += 8) {
+    __m128i raw =
+        _mm_loadu_si128(reinterpret_cast<const __m128i*>(src + i));
+    __m256i x = _mm256_slli_epi32(_mm256_cvtepu16_epi32(raw), 16);
+    _mm256_storeu_ps(dst + i, _mm256_castsi256_ps(x));
+  }
+  for (; i < n; ++i) dst[i] = bf16_to_f32(src[i]);
+}
+
+// entry[i] -= lr * (grad[i] + wd * entry[i])
+__attribute__((target("avx2"))) inline void sgd_update_avx2(
+    float* entry, const float* grad, uint32_t dim, float lr, float wd) {
+  const __m256 vlr = _mm256_set1_ps(lr);
+  const __m256 vwd = _mm256_set1_ps(wd);
+  uint32_t i = 0;
+  for (; i + 8 <= dim; i += 8) {
+    __m256 e = _mm256_loadu_ps(entry + i);
+    __m256 g = _mm256_loadu_ps(grad + i);
+    __m256 t = _mm256_mul_ps(vlr, _mm256_add_ps(g, _mm256_mul_ps(vwd, e)));
+    _mm256_storeu_ps(entry + i, _mm256_sub_ps(e, t));
+  }
+  for (; i < dim; ++i) entry[i] -= lr * (grad[i] + wd * entry[i]);
+}
+
+// emb[i] -= lr*grad[i]/sqrt(acc[i]+eps); acc[i] = acc[i]*g2m + grad[i]^2
+__attribute__((target("avx2"))) inline void adagrad_update_avx2(
+    float* emb, float* acc, const float* grad, uint32_t dim, float lr,
+    float eps, float g2m) {
+  const __m256 vlr = _mm256_set1_ps(lr);
+  const __m256 veps = _mm256_set1_ps(eps);
+  const __m256 vg2m = _mm256_set1_ps(g2m);
+  uint32_t i = 0;
+  for (; i + 8 <= dim; i += 8) {
+    __m256 e = _mm256_loadu_ps(emb + i);
+    __m256 a = _mm256_loadu_ps(acc + i);
+    __m256 g = _mm256_loadu_ps(grad + i);
+    __m256 s = _mm256_sqrt_ps(_mm256_add_ps(a, veps));
+    __m256 d = _mm256_div_ps(_mm256_mul_ps(vlr, g), s);
+    _mm256_storeu_ps(emb + i, _mm256_sub_ps(e, d));
+    _mm256_storeu_ps(acc + i, _mm256_add_ps(_mm256_mul_ps(a, vg2m),
+                                            _mm256_mul_ps(g, g)));
+  }
+  for (; i < dim; ++i) {
+    emb[i] -= lr * grad[i] / std::sqrt(acc[i] + eps);
+    acc[i] = acc[i] * g2m + grad[i] * grad[i];
+  }
+}
+
+// emb[i] -= scale * grad[i]  (Adagrad vectorwise_shared embedding half)
+__attribute__((target("avx2"))) inline void scale_sub_avx2(float* emb,
+                                                           const float* grad,
+                                                           uint32_t dim,
+                                                           float scale) {
+  const __m256 vs = _mm256_set1_ps(scale);
+  uint32_t i = 0;
+  for (; i + 8 <= dim; i += 8) {
+    __m256 e = _mm256_loadu_ps(emb + i);
+    __m256 g = _mm256_loadu_ps(grad + i);
+    _mm256_storeu_ps(emb + i, _mm256_sub_ps(e, _mm256_mul_ps(vs, g)));
+  }
+  for (; i < dim; ++i) emb[i] -= scale * grad[i];
+}
+
+__attribute__((target("avx2"))) inline void adam_update_avx2(
+    float* emb, float* m, float* v, const float* grad, uint32_t dim, float lr,
+    float beta1, float beta2, float eps, float b1p, float b2p) {
+  const float c1 = 1.0f - beta1, c2 = 1.0f - beta2;
+  const float d1 = 1.0f - b1p, d2 = 1.0f - b2p;
+  const __m256 vb1 = _mm256_set1_ps(beta1), vc1 = _mm256_set1_ps(c1);
+  const __m256 vb2 = _mm256_set1_ps(beta2), vc2 = _mm256_set1_ps(c2);
+  const __m256 vd1 = _mm256_set1_ps(d1), vd2 = _mm256_set1_ps(d2);
+  const __m256 vlr = _mm256_set1_ps(lr), veps = _mm256_set1_ps(eps);
+  uint32_t i = 0;
+  for (; i + 8 <= dim; i += 8) {
+    __m256 g = _mm256_loadu_ps(grad + i);
+    __m256 mi = _mm256_add_ps(_mm256_mul_ps(vb1, _mm256_loadu_ps(m + i)),
+                              _mm256_mul_ps(vc1, g));
+    // (1-b2)*g*g evaluates left-to-right in the scalar loop
+    __m256 vi = _mm256_add_ps(
+        _mm256_mul_ps(vb2, _mm256_loadu_ps(v + i)),
+        _mm256_mul_ps(_mm256_mul_ps(vc2, g), g));
+    _mm256_storeu_ps(m + i, mi);
+    _mm256_storeu_ps(v + i, vi);
+    __m256 m_hat = _mm256_div_ps(mi, vd1);
+    __m256 v_hat = _mm256_div_ps(vi, vd2);
+    __m256 den = _mm256_add_ps(veps, _mm256_sqrt_ps(v_hat));
+    __m256 step = _mm256_div_ps(_mm256_mul_ps(vlr, m_hat), den);
+    _mm256_storeu_ps(emb + i,
+                     _mm256_sub_ps(_mm256_loadu_ps(emb + i), step));
+  }
+  for (; i < dim; ++i) {
+    m[i] = beta1 * m[i] + c1 * grad[i];
+    v[i] = beta2 * v[i] + c2 * grad[i] * grad[i];
+    float m_hat = m[i] / d1;
+    float v_hat = v[i] / d2;
+    emb[i] -= lr * m_hat / (eps + std::sqrt(v_hat));
+  }
+}
+
+// NaN lanes compare false on both sides and pass through unchanged,
+// matching the scalar if-chain.
+__attribute__((target("avx2"))) inline void clamp_avx2(float* emb,
+                                                       uint32_t dim,
+                                                       float bound) {
+  const __m256 vb = _mm256_set1_ps(bound);
+  const __m256 vnb = _mm256_set1_ps(-bound);
+  uint32_t i = 0;
+  for (; i + 8 <= dim; i += 8) {
+    __m256 x = _mm256_loadu_ps(emb + i);
+    __m256 gt = _mm256_cmp_ps(x, vb, _CMP_GT_OQ);
+    x = _mm256_blendv_ps(x, vb, gt);
+    __m256 lt = _mm256_cmp_ps(x, vnb, _CMP_LT_OQ);
+    x = _mm256_blendv_ps(x, vnb, lt);
+    _mm256_storeu_ps(emb + i, x);
+  }
+  for (; i < dim; ++i) {
+    if (emb[i] > bound) emb[i] = bound;
+    if (emb[i] < -bound) emb[i] = -bound;
+  }
+}
+
+#endif  // PERSIA_SIMD_X86
+
+// ---------------------------------------------------------------------------
+// NEON kernels (aarch64 only: the float kernels need vdivq/vsqrtq).
+// 4-wide mirrors of the AVX2 kernels; same algorithms, same ops.
+// ---------------------------------------------------------------------------
+#if PERSIA_SIMD_NEON
+
+inline void f32_to_f16_neon(const float* src, uint32_t n, uint16_t* dst) {
+  const uint32x4_t c_one = vdupq_n_u32(1);
+  uint32_t i = 0;
+  for (; i + 4 <= n; i += 4) {
+    uint32x4_t x = vreinterpretq_u32_f32(vld1q_f32(src + i));
+    uint32x4_t sign = vandq_u32(vshrq_n_u32(x, 16), vdupq_n_u32(0x8000));
+    uint32x4_t exp = vandq_u32(vshrq_n_u32(x, 23), vdupq_n_u32(0xFF));
+    uint32x4_t man = vandq_u32(x, vdupq_n_u32(0x7FFFFF));
+    int32x4_t e = vsubq_s32(vreinterpretq_s32_u32(exp), vdupq_n_s32(112));
+
+    uint32x4_t h = vorrq_u32(
+        sign, vorrq_u32(vreinterpretq_u32_s32(vshlq_n_s32(e, 10)),
+                        vshrq_n_u32(man, 13)));
+    uint32x4_t rem = vandq_u32(man, vdupq_n_u32(0x1FFF));
+    uint32x4_t inc = vorrq_u32(
+        vcgtq_u32(rem, vdupq_n_u32(0x1000)),
+        vandq_u32(vceqq_u32(rem, vdupq_n_u32(0x1000)),
+                  vceqq_u32(vandq_u32(h, c_one), c_one)));
+    h = vsubq_u32(h, inc);
+
+    uint32x4_t man_s = vorrq_u32(man, vdupq_n_u32(0x800000));
+    int32x4_t shift = vsubq_s32(vdupq_n_s32(14), e);
+    // USHL with out-of-range counts yields 0, like x86 vpsrlv/vpsllv;
+    // affected lanes are blended to bare sign below anyway
+    uint32x4_t half = vshlq_u32(man_s, vnegq_s32(shift));
+    uint32x4_t low = vsubq_u32(vshlq_u32(c_one, shift), c_one);
+    uint32x4_t rem_s = vandq_u32(man_s, low);
+    uint32x4_t halfway =
+        vshlq_u32(c_one, vsubq_s32(shift, vdupq_n_s32(1)));
+    uint32x4_t sinc = vorrq_u32(
+        vcgtq_u32(rem_s, halfway),
+        vandq_u32(vceqq_u32(rem_s, halfway),
+                  vceqq_u32(vandq_u32(half, c_one), c_one)));
+    half = vsubq_u32(half, sinc);
+    uint32x4_t hsub = vorrq_u32(sign, half);
+
+    uint32x4_t m_sub = vcleq_s32(e, vdupq_n_s32(0));
+    uint32x4_t m_tiny = vcltq_s32(e, vdupq_n_s32(-11));
+    uint32x4_t m_ovf = vcgtq_s32(e, vdupq_n_s32(30));
+    uint32x4_t m_naninf = vceqq_u32(exp, vdupq_n_u32(0xFF));
+
+    uint32x4_t payload =
+        vorrq_u32(vdupq_n_u32(0x200), vshrq_n_u32(man, 13));
+    payload = vbicq_u32(payload, vceqq_u32(man, vdupq_n_u32(0)));
+    uint32x4_t hnan =
+        vorrq_u32(sign, vorrq_u32(vdupq_n_u32(0x7C00), payload));
+
+    uint32x4_t r = vbslq_u32(m_sub, hsub, h);
+    r = vbslq_u32(m_tiny, sign, r);
+    r = vbslq_u32(m_ovf, vorrq_u32(sign, vdupq_n_u32(0x7C00)), r);
+    r = vbslq_u32(m_naninf, hnan, r);
+    vst1_u16(dst + i, vmovn_u32(r));
+  }
+  for (; i < n; ++i) dst[i] = f32_to_f16(src[i]);
+}
+
+inline void f32_to_bf16_neon(const float* src, uint32_t n, uint16_t* dst) {
+  uint32_t i = 0;
+  for (; i + 4 <= n; i += 4) {
+    uint32x4_t x = vreinterpretq_u32_f32(vld1q_f32(src + i));
+    uint32x4_t top = vshrq_n_u32(x, 16);
+    uint32x4_t m_nan = vcgtq_u32(vandq_u32(x, vdupq_n_u32(0x7FFFFFFF)),
+                                 vdupq_n_u32(0x7F800000));
+    uint32x4_t hnan = vorrq_u32(top, vdupq_n_u32(0x40));
+    uint32x4_t lsb = vandq_u32(top, vdupq_n_u32(1));
+    uint32x4_t r =
+        vaddq_u32(x, vaddq_u32(vdupq_n_u32(0x7FFF), lsb));
+    r = vshrq_n_u32(r, 16);
+    r = vbslq_u32(m_nan, hnan, r);
+    vst1_u16(dst + i, vmovn_u32(r));
+  }
+  for (; i < n; ++i) dst[i] = f32_to_bf16(src[i]);
+}
+
+inline void f16_to_f32_neon(const uint16_t* src, uint32_t n, float* dst) {
+  const float32x4_t c_scale = vdupq_n_f32(5.9604644775390625e-8f);
+  uint32_t i = 0;
+  for (; i + 4 <= n; i += 4) {
+    uint32x4_t h = vmovl_u16(vld1_u16(src + i));
+    uint32x4_t sign = vshlq_n_u32(vandq_u32(h, vdupq_n_u32(0x8000)), 16);
+    uint32x4_t exp = vandq_u32(vshrq_n_u32(h, 10), vdupq_n_u32(0x1F));
+    uint32x4_t man = vandq_u32(h, vdupq_n_u32(0x3FF));
+    uint32x4_t man13 = vshlq_n_u32(man, 13);
+    uint32x4_t normal = vorrq_u32(
+        sign, vorrq_u32(
+                  vshlq_n_u32(vaddq_u32(exp, vdupq_n_u32(112)), 23),
+                  man13));
+    float32x4_t subf = vmulq_f32(vcvtq_f32_u32(man), c_scale);
+    uint32x4_t subn = vorrq_u32(vreinterpretq_u32_f32(subf), sign);
+    uint32x4_t m_e0 = vceqq_u32(exp, vdupq_n_u32(0));
+    uint32x4_t m_m0 = vceqq_u32(man, vdupq_n_u32(0));
+    uint32x4_t m_inf = vceqq_u32(exp, vdupq_n_u32(0x1F));
+    uint32x4_t r = vbslq_u32(m_e0, subn, normal);
+    r = vbslq_u32(vandq_u32(m_e0, m_m0), sign, r);
+    r = vbslq_u32(
+        m_inf, vorrq_u32(sign, vorrq_u32(vdupq_n_u32(0x7F800000), man13)),
+        r);
+    vst1q_f32(dst + i, vreinterpretq_f32_u32(r));
+  }
+  for (; i < n; ++i) dst[i] = f16_to_f32(src[i]);
+}
+
+inline void bf16_to_f32_neon(const uint16_t* src, uint32_t n, float* dst) {
+  uint32_t i = 0;
+  for (; i + 4 <= n; i += 4) {
+    uint32x4_t x = vshll_n_u16(vld1_u16(src + i), 16);
+    vst1q_f32(dst + i, vreinterpretq_f32_u32(x));
+  }
+  for (; i < n; ++i) dst[i] = bf16_to_f32(src[i]);
+}
+
+inline void sgd_update_neon(float* entry, const float* grad, uint32_t dim,
+                            float lr, float wd) {
+  const float32x4_t vlr = vdupq_n_f32(lr), vwd = vdupq_n_f32(wd);
+  uint32_t i = 0;
+  for (; i + 4 <= dim; i += 4) {
+    float32x4_t e = vld1q_f32(entry + i);
+    float32x4_t g = vld1q_f32(grad + i);
+    float32x4_t t = vmulq_f32(vlr, vaddq_f32(g, vmulq_f32(vwd, e)));
+    vst1q_f32(entry + i, vsubq_f32(e, t));
+  }
+  for (; i < dim; ++i) entry[i] -= lr * (grad[i] + wd * entry[i]);
+}
+
+inline void adagrad_update_neon(float* emb, float* acc, const float* grad,
+                                uint32_t dim, float lr, float eps,
+                                float g2m) {
+  const float32x4_t vlr = vdupq_n_f32(lr), veps = vdupq_n_f32(eps);
+  const float32x4_t vg2m = vdupq_n_f32(g2m);
+  uint32_t i = 0;
+  for (; i + 4 <= dim; i += 4) {
+    float32x4_t e = vld1q_f32(emb + i);
+    float32x4_t a = vld1q_f32(acc + i);
+    float32x4_t g = vld1q_f32(grad + i);
+    float32x4_t s = vsqrtq_f32(vaddq_f32(a, veps));
+    float32x4_t d = vdivq_f32(vmulq_f32(vlr, g), s);
+    vst1q_f32(emb + i, vsubq_f32(e, d));
+    vst1q_f32(acc + i,
+              vaddq_f32(vmulq_f32(a, vg2m), vmulq_f32(g, g)));
+  }
+  for (; i < dim; ++i) {
+    emb[i] -= lr * grad[i] / std::sqrt(acc[i] + eps);
+    acc[i] = acc[i] * g2m + grad[i] * grad[i];
+  }
+}
+
+inline void scale_sub_neon(float* emb, const float* grad, uint32_t dim,
+                           float scale) {
+  const float32x4_t vs = vdupq_n_f32(scale);
+  uint32_t i = 0;
+  for (; i + 4 <= dim; i += 4) {
+    float32x4_t e = vld1q_f32(emb + i);
+    float32x4_t g = vld1q_f32(grad + i);
+    vst1q_f32(emb + i, vsubq_f32(e, vmulq_f32(vs, g)));
+  }
+  for (; i < dim; ++i) emb[i] -= scale * grad[i];
+}
+
+inline void adam_update_neon(float* emb, float* m, float* v,
+                             const float* grad, uint32_t dim, float lr,
+                             float beta1, float beta2, float eps, float b1p,
+                             float b2p) {
+  const float c1 = 1.0f - beta1, c2 = 1.0f - beta2;
+  const float d1 = 1.0f - b1p, d2 = 1.0f - b2p;
+  const float32x4_t vb1 = vdupq_n_f32(beta1), vc1 = vdupq_n_f32(c1);
+  const float32x4_t vb2 = vdupq_n_f32(beta2), vc2 = vdupq_n_f32(c2);
+  const float32x4_t vd1 = vdupq_n_f32(d1), vd2 = vdupq_n_f32(d2);
+  const float32x4_t vlr = vdupq_n_f32(lr), veps = vdupq_n_f32(eps);
+  uint32_t i = 0;
+  for (; i + 4 <= dim; i += 4) {
+    float32x4_t g = vld1q_f32(grad + i);
+    float32x4_t mi =
+        vaddq_f32(vmulq_f32(vb1, vld1q_f32(m + i)), vmulq_f32(vc1, g));
+    float32x4_t vi = vaddq_f32(vmulq_f32(vb2, vld1q_f32(v + i)),
+                               vmulq_f32(vmulq_f32(vc2, g), g));
+    vst1q_f32(m + i, mi);
+    vst1q_f32(v + i, vi);
+    float32x4_t m_hat = vdivq_f32(mi, vd1);
+    float32x4_t v_hat = vdivq_f32(vi, vd2);
+    float32x4_t den = vaddq_f32(veps, vsqrtq_f32(v_hat));
+    float32x4_t step = vdivq_f32(vmulq_f32(vlr, m_hat), den);
+    vst1q_f32(emb + i, vsubq_f32(vld1q_f32(emb + i), step));
+  }
+  for (; i < dim; ++i) {
+    m[i] = beta1 * m[i] + c1 * grad[i];
+    v[i] = beta2 * v[i] + c2 * grad[i] * grad[i];
+    float m_hat = m[i] / d1;
+    float v_hat = v[i] / d2;
+    emb[i] -= lr * m_hat / (eps + std::sqrt(v_hat));
+  }
+}
+
+inline void clamp_neon(float* emb, uint32_t dim, float bound) {
+  const float32x4_t vb = vdupq_n_f32(bound);
+  const float32x4_t vnb = vdupq_n_f32(-bound);
+  uint32_t i = 0;
+  for (; i + 4 <= dim; i += 4) {
+    float32x4_t x = vld1q_f32(emb + i);
+    x = vbslq_f32(vcgtq_f32(x, vb), vb, x);
+    x = vbslq_f32(vcltq_f32(x, vnb), vnb, x);
+    vst1q_f32(emb + i, x);
+  }
+  for (; i < dim; ++i) {
+    if (emb[i] > bound) emb[i] = bound;
+    if (emb[i] < -bound) emb[i] = -bound;
+  }
+}
+
+#endif  // PERSIA_SIMD_NEON
+
+// ---------------------------------------------------------------------------
+// Dispatching entry points. `path` must come from simd_selected() or
+// simd_resolve() (i.e. already clamped to what the host executes).
+// ---------------------------------------------------------------------------
+
+inline void simd_narrow_row_path(RowDtype dt, const float* src, uint32_t n,
+                                 uint8_t* dst, int path) {
+  if (dt == kRowF32) {
+    std::memcpy(dst, src, 4ull * n);
+    return;
+  }
+  uint16_t* d = reinterpret_cast<uint16_t*>(dst);
+#if PERSIA_SIMD_X86
+  if (path == kSimdAVX2) {
+    if (dt == kRowF16)
+      f32_to_f16_avx2(src, n, d);
+    else
+      f32_to_bf16_avx2(src, n, d);
+    return;
+  }
+#endif
+#if PERSIA_SIMD_NEON
+  if (path == kSimdNEON) {
+    if (dt == kRowF16)
+      f32_to_f16_neon(src, n, d);
+    else
+      f32_to_bf16_neon(src, n, d);
+    return;
+  }
+#endif
+  (void)path;
+  narrow_row(dt, src, n, dst);
+}
+
+inline void simd_widen_row_path(RowDtype dt, const uint8_t* src, uint32_t n,
+                                float* dst, int path) {
+  if (dt == kRowF32) {
+    std::memcpy(dst, src, 4ull * n);
+    return;
+  }
+  const uint16_t* s = reinterpret_cast<const uint16_t*>(src);
+#if PERSIA_SIMD_X86
+  if (path == kSimdAVX2) {
+    if (dt == kRowF16)
+      f16_to_f32_avx2(s, n, dst);
+    else
+      bf16_to_f32_avx2(s, n, dst);
+    return;
+  }
+#endif
+#if PERSIA_SIMD_NEON
+  if (path == kSimdNEON) {
+    if (dt == kRowF16)
+      f16_to_f32_neon(s, n, dst);
+    else
+      bf16_to_f32_neon(s, n, dst);
+    return;
+  }
+#endif
+  (void)path;
+  widen_row(dt, src, n, dst);
+}
+
+inline void simd_narrow_row(RowDtype dt, const float* src, uint32_t n,
+                            uint8_t* dst) {
+  simd_narrow_row_path(dt, src, n, dst, simd_selected());
+}
+
+inline void simd_widen_row(RowDtype dt, const uint8_t* src, uint32_t n,
+                           float* dst) {
+  simd_widen_row_path(dt, src, n, dst, simd_selected());
+}
+
+inline void simd_sgd_update(float* entry, const float* grad, uint32_t dim,
+                            float lr, float wd, int path) {
+#if PERSIA_SIMD_X86
+  if (path == kSimdAVX2) return sgd_update_avx2(entry, grad, dim, lr, wd);
+#endif
+#if PERSIA_SIMD_NEON
+  if (path == kSimdNEON) return sgd_update_neon(entry, grad, dim, lr, wd);
+#endif
+  (void)path;
+  for (uint32_t i = 0; i < dim; ++i)
+    entry[i] -= lr * (grad[i] + wd * entry[i]);
+}
+
+inline void simd_adagrad_update(float* emb, float* acc, const float* grad,
+                                uint32_t dim, float lr, float eps, float g2m,
+                                int path) {
+#if PERSIA_SIMD_X86
+  if (path == kSimdAVX2)
+    return adagrad_update_avx2(emb, acc, grad, dim, lr, eps, g2m);
+#endif
+#if PERSIA_SIMD_NEON
+  if (path == kSimdNEON)
+    return adagrad_update_neon(emb, acc, grad, dim, lr, eps, g2m);
+#endif
+  (void)path;
+  for (uint32_t i = 0; i < dim; ++i) {
+    emb[i] -= lr * grad[i] / std::sqrt(acc[i] + eps);
+    acc[i] = acc[i] * g2m + grad[i] * grad[i];
+  }
+}
+
+inline void simd_scale_sub(float* emb, const float* grad, uint32_t dim,
+                           float scale, int path) {
+#if PERSIA_SIMD_X86
+  if (path == kSimdAVX2) return scale_sub_avx2(emb, grad, dim, scale);
+#endif
+#if PERSIA_SIMD_NEON
+  if (path == kSimdNEON) return scale_sub_neon(emb, grad, dim, scale);
+#endif
+  (void)path;
+  for (uint32_t i = 0; i < dim; ++i) emb[i] -= scale * grad[i];
+}
+
+inline void simd_adam_update(float* emb, float* m, float* v,
+                             const float* grad, uint32_t dim, float lr,
+                             float beta1, float beta2, float eps, float b1p,
+                             float b2p, int path) {
+#if PERSIA_SIMD_X86
+  if (path == kSimdAVX2)
+    return adam_update_avx2(emb, m, v, grad, dim, lr, beta1, beta2, eps, b1p,
+                            b2p);
+#endif
+#if PERSIA_SIMD_NEON
+  if (path == kSimdNEON)
+    return adam_update_neon(emb, m, v, grad, dim, lr, beta1, beta2, eps, b1p,
+                            b2p);
+#endif
+  (void)path;
+  for (uint32_t i = 0; i < dim; ++i) {
+    m[i] = beta1 * m[i] + (1.0f - beta1) * grad[i];
+    v[i] = beta2 * v[i] + (1.0f - beta2) * grad[i] * grad[i];
+    float m_hat = m[i] / (1.0f - b1p);
+    float v_hat = v[i] / (1.0f - b2p);
+    emb[i] -= lr * m_hat / (eps + std::sqrt(v_hat));
+  }
+}
+
+inline void simd_clamp(float* emb, uint32_t dim, float bound, int path) {
+#if PERSIA_SIMD_X86
+  if (path == kSimdAVX2) return clamp_avx2(emb, dim, bound);
+#endif
+#if PERSIA_SIMD_NEON
+  if (path == kSimdNEON) return clamp_neon(emb, dim, bound);
+#endif
+  (void)path;
+  for (uint32_t i = 0; i < dim; ++i) {
+    if (emb[i] > bound) emb[i] = bound;
+    if (emb[i] < -bound) emb[i] = -bound;
+  }
+}
+
+}  // namespace persia

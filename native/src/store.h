@@ -46,6 +46,7 @@
 #include "hashrng.h"
 #include "optim.h"
 #include "rowbytes.h"
+#include "simd.h"
 
 namespace persia {
 
@@ -447,19 +448,41 @@ class Store {
       (*order)[cursor[shard_of[i]]++] = static_cast<uint32_t>(i);
   }
 
+  // Tune the internal shard-parallel engine: threads == 0 means auto
+  // (hardware_concurrency capped at 8, the historical default);
+  // min_batch is the batch size below which dispatch stays serial.
+  // The PS-service dispatcher (ShardParallelDispatcher) drives these so
+  // the whole GIL-released foreign call runs shard-parallel instead of
+  // layering a Python thread pool on top.
+  void set_parallel(uint32_t threads, uint64_t min_batch) {
+    par_threads_ = threads;
+    if (min_batch > 0) par_min_batch_ = min_batch;
+  }
+
+  uint32_t parallel_threads() const {
+    unsigned t = par_threads_;
+    if (t == 0) {
+      unsigned hw = std::thread::hardware_concurrency();
+      t = hw == 0 ? 1 : (hw > 8 ? 8 : hw);
+    }
+    return t;
+  }
+
+  uint64_t parallel_min_batch() const { return par_min_batch_; }
+
   // Run fn(shard_index) for every non-empty shard, spread over worker
   // threads when the batch is large (the reference gets the same effect
   // from tokio + per-shard RwLocks).
   template <typename F>
   void parallel_shards(const std::vector<uint32_t>& starts, uint64_t n,
                        F&& fn) {
-    unsigned hw = std::thread::hardware_concurrency();
-    unsigned threads = hw == 0 ? 1 : (hw > 8 ? 8 : hw);
-    if (n < 4096 || threads <= 1 || num_shards_ == 1) {
+    unsigned threads = parallel_threads();
+    if (n < par_min_batch_ || threads <= 1 || num_shards_ == 1) {
       for (uint32_t s = 0; s < num_shards_; ++s)
         if (starts[s] != starts[s + 1]) fn(s);
       return;
     }
+    if (threads > num_shards_) threads = num_shards_;
     std::atomic<uint32_t> next{0};
     auto worker = [&]() {
       for (;;) {
@@ -505,7 +528,7 @@ class Store {
             e = sh.map->get_refresh(sign);
           }
           if (e != nullptr && e->dim == dim) {
-            widen_row(dtype_, sh.pool->ptr(e->cls, e->slot), dim, dst);
+            simd_widen_row(dtype_, sh.pool->ptr(e->cls, e->slot), dim, dst);
           } else if (e == nullptr && !admit(sign, admit_probability_)) {
             std::memset(dst, 0, sizeof(float) * dim);
             ++local_misses;
@@ -520,13 +543,13 @@ class Store {
             insert_locked(sh, sign, dim, init_vec.data(),
                           static_cast<uint32_t>(init_vec.size()));
             EvictionMap::Node* ne = sh.map->get(sign);
-            widen_row(dtype_, sh.pool->ptr(ne->cls, ne->slot), dim, dst);
+            simd_widen_row(dtype_, sh.pool->ptr(ne->cls, ne->slot), dim, dst);
             ++local_misses;
           }
         } else {
           EvictionMap::Node* e = sh.map->get(sign);
           if (e != nullptr && e->dim == dim) {
-            widen_row(dtype_, sh.pool->ptr(e->cls, e->slot), dim, dst);
+            simd_widen_row(dtype_, sh.pool->ptr(e->cls, e->slot), dim, dst);
           } else {
             std::memset(dst, 0, sizeof(float) * dim);
             ++local_misses;
@@ -587,14 +610,14 @@ class Store {
             weight_bound_clamp(vec, dim, weight_bound_);
         } else {
           // widen-on-read, fp32-exact update, narrow-on-write
-          widen_row(dtype_, p, dim, row.data());
+          simd_widen_row(dtype_, p, dim, row.data());
           std::memcpy(row.data() + dim, p + ci.emb_pad, 4ull * space);
           optimizer_->update(row.data(),
                              grads + static_cast<size_t>(i) * dim, dim, bp1,
                              bp2);
           if (enable_weight_bound_)
             weight_bound_clamp(row.data(), dim, weight_bound_);
-          narrow_row(dtype_, row.data(), dim, p);
+          simd_narrow_row(dtype_, row.data(), dim, p);
           std::memcpy(p + ci.emb_pad, row.data() + dim, 4ull * space);
         }
       }
@@ -618,7 +641,7 @@ class Store {
     uint32_t len = ci.dim + ci.space;
     if (out != nullptr && maxlen >= len) {
       const uint8_t* p = sh.pool->ptr(e->cls, e->slot);
-      widen_row(dtype_, p, ci.dim, out);
+      simd_widen_row(dtype_, p, ci.dim, out);
       std::memcpy(out + ci.dim, p + ci.emb_pad, 4ull * ci.space);
     }
     return len;
@@ -629,6 +652,63 @@ class Store {
     std::lock_guard<std::mutex> lk(*locks_[s]);
     insert_locked(*shards_[s], sign, dim, vec, len);
     return 0;
+  }
+
+  // Batched set_entry for uniform (dim, len) groups: vecs is n rows of
+  // len f32 each. One shard-grouped pass (each mutex taken once,
+  // shard-parallel for large n) instead of n foreign calls — the
+  // reshard-install and device-cache write-back hot path.
+  int set_entries(const uint64_t* signs, uint64_t n, uint32_t dim,
+                  const float* vecs, uint32_t len) {
+    if (len < dim) return -1;
+    std::vector<uint32_t> order, starts;
+    group_by_shard(signs, n, &order, &starts);
+    parallel_shards(starts, n, [&](uint32_t s) {
+      std::lock_guard<std::mutex> lk(*locks_[s]);
+      Shard& sh = *shards_[s];
+      for (uint32_t k = starts[s]; k < starts[s + 1]; ++k) {
+        uint32_t i = order[k];
+        insert_locked(sh, signs[i], dim,
+                      vecs + static_cast<size_t>(i) * len, len);
+      }
+    });
+    return 0;
+  }
+
+  // Batched get_entry: out is n rows of maxlen f32; lens[i] gets the
+  // entry length (dim + state), or -1 when the sign is absent. Rows
+  // longer than maxlen report their length but are not written.
+  // Returns the number of rows written.
+  int64_t get_entries(const uint64_t* signs, uint64_t n, uint32_t maxlen,
+                      float* out, int64_t* lens) {
+    std::vector<uint32_t> order, starts;
+    group_by_shard(signs, n, &order, &starts);
+    std::atomic<int64_t> found{0};
+    parallel_shards(starts, n, [&](uint32_t s) {
+      int64_t local = 0;
+      std::lock_guard<std::mutex> lk(*locks_[s]);
+      Shard& sh = *shards_[s];
+      for (uint32_t k = starts[s]; k < starts[s + 1]; ++k) {
+        uint32_t i = order[k];
+        EvictionMap::Node* e = sh.map->get(signs[i]);
+        if (e == nullptr) {
+          lens[i] = -1;
+          continue;
+        }
+        const SlabPool::ClassInfo& ci = sh.pool->info(e->cls);
+        uint32_t len = ci.dim + ci.space;
+        lens[i] = len;
+        if (out != nullptr && len <= maxlen) {
+          const uint8_t* p = sh.pool->ptr(e->cls, e->slot);
+          float* dst = out + static_cast<size_t>(i) * maxlen;
+          simd_widen_row(dtype_, p, ci.dim, dst);
+          std::memcpy(dst + ci.dim, p + ci.emb_pad, 4ull * ci.space);
+          ++local;
+        }
+      }
+      found += local;
+    });
+    return found.load();
   }
 
   int contains(uint64_t sign) {
@@ -812,7 +892,7 @@ class Store {
         ok = std::fread(raw.data(), 1, raw.size(), f) == raw.size();
         if (!ok) break;
         vec.resize(dim + state_len);
-        widen_row(rec_dt, raw.data(), dim, vec.data());
+        simd_widen_row(rec_dt, raw.data(), dim, vec.data());
         std::memcpy(vec.data() + dim, raw.data() + emb_bytes,
                     4ull * state_len);
       }
@@ -849,7 +929,7 @@ class Store {
     if (found_nbytes < emb_bytes) return false;
     uint32_t state_len = (found_nbytes - emb_bytes) / 4;
     std::vector<float> vec(dim + state_len);
-    widen_row(dtype_, sh.drain.data() + found, dim, vec.data());
+    simd_widen_row(dtype_, sh.drain.data() + found, dim, vec.data());
     std::memcpy(vec.data() + dim, sh.drain.data() + found + emb_bytes,
                 4ull * state_len);
     insert_locked(sh, sign, dim, vec.data(),
@@ -891,7 +971,7 @@ class Store {
   void write_row(Shard& sh, uint32_t cls, uint32_t slot, const float* vec,
                  uint32_t dim, uint32_t space) {
     uint8_t* p = sh.pool->ptr(cls, slot);
-    narrow_row(dtype_, vec, dim, p);
+    simd_narrow_row(dtype_, vec, dim, p);
     std::memcpy(p + sh.pool->info(cls).emb_pad, vec + dim, 4ull * space);
   }
 
@@ -929,6 +1009,8 @@ class Store {
   uint32_t num_shards_;
   RowDtype dtype_;
   uint64_t bytes_per_shard_ = 0;
+  uint32_t par_threads_ = 0;        // 0 = auto (hw capped at 8)
+  uint64_t par_min_batch_ = 4096;   // serial below this batch size
   std::vector<std::unique_ptr<Shard>> shards_;
   mutable std::vector<std::unique_ptr<std::mutex>> locks_;
   std::unique_ptr<Optimizer> optimizer_;

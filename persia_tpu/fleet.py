@@ -117,6 +117,12 @@ class ScrapeTarget:
             # epoch and, mid-migration, its donor capture/freeze state
             "routing_epoch": h.get("routing_epoch"),
             "reshard": h.get("reshard"),
+            # kernel-path + dispatch observables (PS replicas): which
+            # SIMD path the native store selected and how requests are
+            # parallelized — fleet_status cross-checks these so one
+            # replica silently running scalar kernels is flagged
+            "simd": h.get("simd"),
+            "dispatch": h.get("dispatch"),
             "last_scrape_age_sec": (
                 round(now - self.last_scrape_t, 3)
                 if self.last_scrape_t is not None else None),
@@ -517,6 +523,12 @@ class FleetMonitor:
         now = time.monotonic()
         targets = [t.status_doc(now) for t in self.targets()]
         versions = {t["version"] for t in targets if t["version"]}
+        # kernel-path skew, same shape as version_skew: PS replicas
+        # reporting different SIMD paths (one fell back to scalar —
+        # env forced down, wrong .so, heterogeneous hosts) serve
+        # bit-identical results but at silently different cost, which
+        # capacity planning must see
+        simd_paths = {t["simd"] for t in targets if t.get("simd")}
         return {
             "fleet_monitor": {
                 "version": __version__,
@@ -528,6 +540,8 @@ class FleetMonitor:
             "n_targets": len(targets),
             "n_up": sum(1 for t in targets if t["up"]),
             "version_skew": len(versions) > 1,
+            "simd_skew": len(simd_paths) > 1,
+            "simd_paths": sorted(simd_paths),
             "targets": targets,
         }
 

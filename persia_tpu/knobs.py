@@ -128,6 +128,15 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
        "Explicit path to libpersia_native.so, tried before the normal "
        "candidates. The ASan parity hook points it at the "
        "`make -C native sanitize` build (native/build/asan/)."),
+    _k("PERSIA_NATIVE_SIMD", "str", "auto",
+       "Kernel path of the native store's narrow/widen and in-slab "
+       "optimizer updates: `auto` probes the CPU (AVX2 on x86, NEON on "
+       "aarch64, scalar otherwise), `avx2`/`neon`/`scalar` force a "
+       "path — clamped to what the host can execute, never a crash. "
+       "All paths are bit-exact; the selected one is logged at holder "
+       "init and exported via /healthz (\"simd\") and the fleet "
+       "gauges. Read by the C++ library at first use (set it before "
+       "the process loads the .so)."),
     _k("PERSIA_METRICS_GATEWAY_ADDR", "str", None,
        "Prometheus push-gateway address for metrics.push_loop. Unset "
        "= pull-only via the /metrics sidecar."),

@@ -71,6 +71,16 @@ _ARENA_SYMBOLS = (
     "ptps_drain_evicted", "ptps_contains",
 )
 
+# the SIMD-era ABI (a second, independent capability set: an arena-era
+# .so without these still serves every storage policy — only the SIMD
+# kernels, tunable shard-parallelism, and batched entry calls are
+# missing, and the service tier negotiates down to its legacy constants)
+_SIMD_SYMBOLS = (
+    "ptps_simd_path", "ptps_simd_force", "ptps_narrow_rows",
+    "ptps_widen_rows", "ptps_set_parallel", "ptps_get_parallel",
+    "ptps_set_entries", "ptps_get_entries",
+)
+
 _lib = None
 
 
@@ -173,6 +183,27 @@ def load_native_lib(build_if_missing: bool = True) -> Optional[ctypes.CDLL]:
         lib.ptps_drain_evicted.argtypes = [ctypes.c_void_p, u8p, u64]
         lib.ptps_contains.argtypes = [ctypes.c_void_p, ctypes.POINTER(u64),
                                       u64, u8p]
+    # SIMD-era ABI (independent probe: negotiate-down keeps working on a
+    # library that predates it)
+    if all(hasattr(lib, s) for s in _SIMD_SYMBOLS):
+        lib.ptps_simd_path.restype = ctypes.c_char_p
+        lib.ptps_simd_path.argtypes = []
+        lib.ptps_simd_force.restype = i32
+        lib.ptps_simd_force.argtypes = [ctypes.c_char_p]
+        lib.ptps_narrow_rows.argtypes = [i32, ctypes.POINTER(fptr), u64, u8p,
+                                         i32]
+        lib.ptps_widen_rows.argtypes = [i32, u8p, u64, ctypes.POINTER(fptr),
+                                        i32]
+        lib.ptps_set_parallel.argtypes = [ctypes.c_void_p, u32, u64]
+        lib.ptps_get_parallel.argtypes = [ctypes.c_void_p,
+                                          ctypes.POINTER(u64)]
+        lib.ptps_set_entries.restype = i32
+        lib.ptps_set_entries.argtypes = [ctypes.c_void_p, ctypes.POINTER(u64),
+                                         u64, u32, ctypes.POINTER(fptr), u32]
+        lib.ptps_get_entries.restype = i64
+        lib.ptps_get_entries.argtypes = [ctypes.c_void_p, ctypes.POINTER(u64),
+                                         u64, u32, ctypes.POINTER(fptr),
+                                         ctypes.POINTER(i64)]
     _lib = lib
     return lib
 
@@ -186,10 +217,13 @@ def native_capabilities(lib=None) -> frozenset:
         lib = load_native_lib(build_if_missing=False)
     if lib is None:
         return frozenset()
+    caps = set()
     if all(hasattr(lib, s) for s in _ARENA_SYMBOLS):
-        return frozenset({"row_dtype", "capacity_bytes", "psd_v2",
-                          "spill", "arena_stats"})
-    return frozenset()
+        caps.update({"row_dtype", "capacity_bytes", "psd_v2",
+                     "spill", "arena_stats"})
+    if all(hasattr(lib, s) for s in _SIMD_SYMBOLS):
+        caps.update({"simd", "parallel_tuning", "batched_entries"})
+    return frozenset(caps)
 
 
 def required_capabilities(row_dtype=None, capacity_bytes=None,
@@ -216,6 +250,21 @@ def _u64_ptr(a: np.ndarray):
 
 def _u8_ptr(a: np.ndarray):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def _i64_ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def native_simd_path(lib=None) -> Optional[str]:
+    """Kernel path the loaded native library selected ("avx2" | "neon" |
+    "scalar"), honoring the PERSIA_NATIVE_SIMD knob; None when no
+    library is loaded or it predates the SIMD ABI."""
+    if lib is None:
+        lib = load_native_lib(build_if_missing=False)
+    if lib is None or "simd" not in native_capabilities(lib):
+        return None
+    return lib.ptps_simd_path().decode()
 
 
 def _params_array(params: dict):
@@ -299,6 +348,21 @@ class NativeEmbeddingHolder:
         self.capacity_bytes = capacity_bytes
         self.num_internal_shards = num_internal_shards
         self.row_dtype = row_dtype
+        # LOUD: name the engaged kernel path at init so a replica that
+        # silently degraded to scalar (bad knob value, older CPU) is
+        # visible in logs — and exported via /healthz + fleet gauges
+        self.simd_path = (lib.ptps_simd_path().decode()
+                          if "simd" in self._caps else None)
+        if self.simd_path is not None:
+            _logger.info(
+                "native store SIMD kernel path: %s "
+                "(PERSIA_NATIVE_SIMD=%s, row_dtype=%s)",
+                self.simd_path, knobs.get("PERSIA_NATIVE_SIMD") or "auto",
+                row_dtype)
+        else:
+            _logger.info(
+                "native store predates the SIMD ABI: scalar kernels, no "
+                "parallel tuning (rebuild `make -C native`)")
         # widen/narrow policy of the logical record bytes (drain + spill)
         from persia_tpu.ps.optim import RowPrecision
 
@@ -345,6 +409,27 @@ class NativeEmbeddingHolder:
             self._lib.ptps_free(h)
             self._h = None
 
+    def parallel_info(self) -> Optional[dict]:
+        """Capability probe for the service-tier dispatcher: the native
+        store's resolved shard-parallel worker count and the batch size
+        below which it stays serial. None when the loaded ``.so``
+        predates tunable parallelism (the dispatcher then falls back to
+        its legacy constants — negotiate-down, never a crash)."""
+        if "parallel_tuning" not in self._caps:
+            return None
+        out = np.zeros(2, np.uint64)
+        self._lib.ptps_get_parallel(self._h, _u64_ptr(out))
+        return {"threads": int(out[0]), "min_batch": int(out[1])}
+
+    def set_parallel(self, threads: int = 0, min_batch: int = 0) -> bool:
+        """Tune the native shard-parallel engine (threads=0 restores
+        auto; min_batch=0 leaves the serial threshold unchanged).
+        Returns False on a pre-SIMD-ABI library."""
+        if "parallel_tuning" not in self._caps:
+            return False
+        self._lib.ptps_set_parallel(self._h, int(threads), int(min_batch))
+        return True
+
     def configure(self, init_method: str, init_params: dict,
                   admit_probability: float = 1.0, weight_bound: float = 10.0,
                   enable_weight_bound: bool = True):
@@ -377,20 +462,24 @@ class NativeEmbeddingHolder:
             if not got:
                 return
             # parse the shard-concatenated records, grouped per
-            # (dim, nbytes) for the batched (slab-slice) spill path
+            # (dim, nbytes) for the batched (slab-slice) spill path;
+            # the header walk stays a (cheap) loop — record lengths are
+            # data-dependent — but the payload copy is ONE fancy-index
+            # gather per group instead of per-record slices + np.stack
             groups = {}
             off = 0
             while off + _DRAIN_REC.size <= got:
                 sign, dim, nbytes = _DRAIN_REC.unpack_from(buf, off)
                 off += _DRAIN_REC.size
-                groups.setdefault((dim, nbytes), ([], []))
-                g = groups[(dim, nbytes)]
+                g = groups.setdefault((dim, nbytes), ([], []))
                 g[0].append(sign)
-                g[1].append(buf[off: off + nbytes])
+                g[1].append(off)
                 off += nbytes
-            for (dim, nbytes), (signs, raws) in groups.items():
+            for (dim, nbytes), (signs, offs) in groups.items():
                 signs = np.array(signs, np.uint64)
-                mat = np.stack(raws)
+                starts = np.asarray(offs, np.int64)
+                mat = buf[starts[:, None]
+                          + np.arange(nbytes, dtype=np.int64)[None, :]]
                 resident = np.zeros(len(signs), np.uint8)
                 lib.ptps_contains(self._h, _u64_ptr(signs), len(signs),
                                   _u8_ptr(resident))
@@ -536,13 +625,36 @@ class NativeEmbeddingHolder:
 
     def _get_entries_impl(self, signs: np.ndarray, width: int):
         """Batched get_entry (uniform width; absent/mismatched width =>
-        not found). One ctypes call per sign locally — the point of the
-        batch shape is the RPC twin, where it collapses to ONE round
-        trip (ps_service get_entries)."""
+        not found). With the SIMD-era ABI this is ONE GIL-released
+        foreign call (ptps_get_entries) that widens straight out of the
+        slabs; a pre-SIMD library falls back to the per-sign loop."""
         signs = np.ascontiguousarray(signs, dtype=np.uint64)
         n = len(signs)
         found = np.zeros(n, dtype=bool)
         vecs = np.zeros((n, width), dtype=np.float32)
+        if n == 0:
+            return found, vecs
+        if "batched_entries" in self._caps:
+            lens = np.empty(n, dtype=np.int64)
+            self._lib.ptps_get_entries(self._h, _u64_ptr(signs), n, width,
+                                       _f32_ptr(vecs), _i64_ptr(lens))
+            found = lens == width
+            # a resident row of the wrong width counts as not-found and
+            # must come back zero (the native call wrote its prefix)
+            mismatched = (lens >= 0) & ~found
+            if mismatched.any():
+                vecs[mismatched] = 0.0
+            if self.spill is not None and len(self.spill):
+                for i in np.nonzero(lens < 0)[0]:
+                    got = self.spill.peek(int(signs[i]))
+                    if got is None:
+                        continue
+                    dim0, raw = got
+                    vec = self._widen_raw(dim0, raw)
+                    if len(vec) == width:
+                        found[i] = True
+                        vecs[i] = vec
+            return found, vecs
         dim_out = ctypes.c_uint32(0)
         buf = np.empty(width, dtype=np.float32)
         for i in range(n):
@@ -571,11 +683,25 @@ class NativeEmbeddingHolder:
     def _set_entries_impl(self, signs: np.ndarray, dim: int, vecs: np.ndarray):
         signs = np.ascontiguousarray(signs, dtype=np.uint64)
         vecs = np.ascontiguousarray(vecs, dtype=np.float32)
-        for i in range(len(signs)):
+        if len(signs) == 0:
+            return
+        if "batched_entries" in self._caps:
+            # ONE GIL-released foreign call narrows the whole group
+            # straight into the slabs (the reshard-install hot path)
             if self.spill is not None:
-                self.spill.discard(int(signs[i]))
-            self._lib.ptps_set_entry(self._h, int(signs[i]), dim,
-                                     _f32_ptr(vecs[i]), vecs.shape[1])
+                for s in signs.tolist():
+                    self.spill.discard(int(s))
+            rc = self._lib.ptps_set_entries(self._h, _u64_ptr(signs),
+                                            len(signs), dim, _f32_ptr(vecs),
+                                            vecs.shape[1])
+            if rc != 0:
+                raise RuntimeError("native set_entries failed (len < dim)")
+        else:
+            for i in range(len(signs)):
+                if self.spill is not None:
+                    self.spill.discard(int(signs[i]))
+                self._lib.ptps_set_entry(self._h, int(signs[i]), dim,
+                                         _f32_ptr(vecs[i]), vecs.shape[1])
         if self.spill is not None:
             self._drain_evictions()
 

@@ -123,18 +123,101 @@ def is_reshard_fenced(exc: BaseException) -> Optional[Tuple[int, int]]:
 # PSD-v1-shaped record stream: '<Q' row count, then per row
 # '<QII' (sign, dim, vec_len) + vec_len f32s (value + optimizer state,
 # widened to f32 by the donor's version-agnostic reader).
+#
+# The stream is naturally RUN-shaped: rows from one table share (dim,
+# vec_len), so consecutive records have a constant stride. The codec
+# exploits that — a run packs/unpacks as ONE (k, 16+4*len) uint8
+# record-matrix memcpy instead of k struct.pack/frombuffer round trips
+# — while the wire bytes stay identical to the per-row form (the
+# fallback below IS the format's definition; the parity tests pin it).
+
+# below this many same-shape rows the matrix setup costs more than the
+# per-row loop it replaces
+_RUN_VECTORIZE_MIN = 8
+
+
+def _pack_run(signs: np.ndarray, dim: int, mat: np.ndarray) -> np.ndarray:
+    """One same-shape run -> its record bytes (no count header):
+    a (k, 16 + 4*len) uint8 record matrix filled column-wise."""
+    k, ln = mat.shape
+    rec = np.empty((k, 16 + 4 * ln), np.uint8)
+    rec[:, 0:8] = signs.astype("<u8", copy=False).reshape(-1, 1) \
+        .view(np.uint8)
+    rec[:, 8:16] = np.frombuffer(
+        struct.pack("<II", int(dim), ln), np.uint8)
+    if ln:
+        rec[:, 16:] = np.ascontiguousarray(mat, "<f4").view(np.uint8)
+    return rec
+
+
+def pack_row_runs(runs: List[Tuple[np.ndarray, int, np.ndarray]]) -> bytes:
+    """Pack pre-grouped runs [(signs u64[k], dim, (k, len) f32)] —
+    byte-identical to ``pack_rows`` over the concatenated rows."""
+    total = sum(len(signs) for signs, _d, _m in runs)
+    parts = [struct.pack("<Q", total)]
+    for signs, dim, mat in runs:
+        if len(signs):
+            parts.append(_pack_run(signs, dim, mat).tobytes())
+    return b"".join(parts)
 
 
 def pack_rows(rows: Iterable[Tuple[int, int, np.ndarray]]) -> bytes:
-    parts = [b""]
-    n = 0
-    for sign, dim, vec in rows:
-        vec = np.ascontiguousarray(vec, np.float32)
-        parts.append(struct.pack("<QII", int(sign), int(dim), len(vec)))
-        parts.append(vec.tobytes())
-        n += 1
-    parts[0] = struct.pack("<Q", n)
+    rows = rows if isinstance(rows, list) else list(rows)
+    parts = [struct.pack("<Q", len(rows))]
+    i, n = 0, len(rows)
+    while i < n:
+        dim, ln = int(rows[i][1]), len(rows[i][2])
+        j = i + 1
+        while j < n and int(rows[j][1]) == dim and len(rows[j][2]) == ln:
+            j += 1
+        if j - i >= _RUN_VECTORIZE_MIN:
+            signs = np.fromiter((int(r[0]) for r in rows[i:j]),
+                                np.uint64, j - i)
+            mat = np.array([r[2] for r in rows[i:j]], np.float32) \
+                if ln else np.empty((j - i, 0), np.float32)
+            parts.append(_pack_run(signs, dim, mat).tobytes())
+        else:
+            for sign, d, vec in rows[i:j]:
+                vec = np.ascontiguousarray(vec, np.float32)
+                parts.append(struct.pack("<QII", int(sign), int(d),
+                                         len(vec)))
+                parts.append(vec.tobytes())
+        i = j
     return b"".join(parts)
+
+
+def unpack_row_runs(buf) -> List[Tuple[np.ndarray, int, np.ndarray]]:
+    """Unpack to same-shape runs [(signs u64[k], dim, (k, len) f32)]:
+    each run is one strided record-matrix slice — no per-row numpy
+    allocation. Concatenating the runs reproduces ``unpack_rows``
+    order; the returned arrays are fresh copies (safe past the frame
+    buffer's lifetime)."""
+    mv = memoryview(buf)
+    if isinstance(buf, memoryview):
+        buf = bytes(buf)  # np.frombuffer needs a buffer it can pin
+    (n,) = struct.unpack_from("<Q", mv, 0)
+    u8 = np.frombuffer(buf, np.uint8)
+    end = len(mv)
+    unpack_from = struct.unpack_from
+    runs: List[Tuple[np.ndarray, int, np.ndarray]] = []
+    off, left = 8, int(n)
+    while left > 0:
+        sign0, dim, ln = unpack_from("<QII", mv, off)
+        stride = 16 + 4 * ln
+        # extend the run while the NEXT record exists and shares shape
+        k = 1
+        while (k < left and off + (k + 1) * stride <= end
+               and unpack_from("<II", mv, off + k * stride + 8)
+               == (dim, ln)):
+            k += 1
+        block = u8[off:off + k * stride].reshape(k, stride)
+        signs = block[:, 0:8].copy().view("<u8").reshape(k)
+        mat = block[:, 16:].copy().view("<f4").reshape(k, ln) \
+            if ln else np.empty((k, 0), np.float32)
+        runs.append((signs, int(dim), mat))
+        off += k * stride
+        left -= k
+    return runs
 
 
 def unpack_rows(buf: bytes) -> List[Tuple[int, int, np.ndarray]]:
@@ -695,25 +778,44 @@ class ReshardController:
 
     def _install(self, chunk: bytes, target_of_slot: Dict[int, int],
                  new_table: RoutingTable) -> int:
-        rows = unpack_rows(chunk) if isinstance(chunk, (bytes, bytearray)) \
-            else list(chunk)
-        if not rows:
+        if isinstance(chunk, (bytes, bytearray, memoryview)):
+            runs = unpack_row_runs(chunk)
+        else:
+            rows = list(chunk)
+            runs = [(np.array([r[0]], np.uint64), int(r[1]),
+                     np.ascontiguousarray(r[2], np.float32).reshape(1, -1))
+                    for r in rows]
+        if not runs:
             return 0
+        # route whole runs, not rows: per run, one vectorized slot hash
+        # + one target map, then mask-partition the record matrix — the
+        # per-target streams keep scan order, so the installed bytes
+        # match the old per-row regrouping exactly
+        tgt_of = np.full(new_table.num_slots, -1, np.int64)
+        for slot, tgt in target_of_slot.items():
+            tgt_of[slot] = tgt
         by_target: Dict[int, List] = {}
-        signs = np.array([r[0] for r in rows], np.uint64)
-        slot_ids = new_table.slot_of(signs)
-        for row, slot in zip(rows, slot_ids.tolist()):
-            tgt = target_of_slot.get(int(slot))
-            if tgt is None:
-                # a captured sign outside the moving set (possible when
-                # one capture set serves several move groups): skip
+        installed = 0
+        for signs, dim, mat in runs:
+            if not len(signs):
                 continue
-            by_target.setdefault(tgt, []).append(row)
-        for tgt, tgt_rows in by_target.items():
-            self.ps_clients[tgt].reshard_install(pack_rows(tgt_rows),
+            tgts = tgt_of[new_table.slot_of(signs)]
+            for tgt in np.unique(tgts):
+                tgt = int(tgt)
+                if tgt < 0:
+                    # a captured sign outside the moving set (possible
+                    # when one capture set serves several move
+                    # groups): skip
+                    continue
+                sel = tgts == tgt
+                by_target.setdefault(tgt, []).append(
+                    (signs[sel], dim, mat[sel]))
+                installed += int(sel.sum())
+        for tgt, tgt_runs in by_target.items():
+            self.ps_clients[tgt].reshard_install(pack_row_runs(tgt_runs),
                                                  fence=self.fence,
                                                  mig_id=self.mig_id)
-        return sum(len(v) for v in by_target.values())
+        return installed
 
     def _publish(self, table: RoutingTable):
         applied = 0

@@ -211,6 +211,12 @@ class _ReshardState:
         return out
 
 
+# numeric encodings for the constant-per-process path gauges (the
+# fleet scraper compares them across replicas to flag skew)
+SIMD_PATH_CODES = {"scalar": 0, "avx2": 1, "neon": 2}
+DISPATCH_MODE_CODES = {"serial": 0, "pool": 1, "native": 2}
+
+
 class ShardParallelDispatcher:
     """Executes holder lookups/updates in parallel across the holder's
     INTERNAL shards (thread pool sized to ``num_internal_shards``,
@@ -228,17 +234,25 @@ class ShardParallelDispatcher:
     it falls back to the plain serial call (``force=True`` overrides,
     for the parity tests).
 
-    The native store already parallelizes internally for batches >=
-    NATIVE_INTERNAL_N (store.h parallel_shards, capped at 8 threads),
-    so the dispatcher only adds value where that does not reach: small
-    batches (native runs them serial) and hosts with more than 8 cores
-    (the service pool is sized to num_internal_shards).
+    Backends that expose ``parallel_info()``/``set_parallel()`` (the
+    tuning-capable native .so) get "native" mode instead: the store's
+    own parallel_shards is tuned down to MIN_PARALLEL at construction,
+    so lookup/update stay ONE foreign call — the GIL is released across
+    the whole request and the store fans out over its internal shards
+    by itself. No Python pool means no per-core dispatch tax, so this
+    mode engages on any host (the old ``cpus >= 4`` floor only guarded
+    pool.map overhead). The thread pool remains for backends that lack
+    the tuning ABI (pre-SIMD .so — detected by the capability probe,
+    not the class name) and for ``force=True`` parity tests that pin
+    the split/scatter semantics.
     """
 
-    # below this many signs the split/scatter overhead beats the win
+    # below this many signs the split/scatter overhead beats the win;
+    # native mode tunes store.h parallel_shards to this same threshold
     MIN_PARALLEL = 512
-    # native/src/store.h parallel_shards engages at this batch size
-    # with min(8, hw_concurrency) threads
+    # legacy fallback when the .so predates ptps_get_parallel and its
+    # internal config cannot be probed: store.h parallel_shards used to
+    # hard-code this engage batch size with min(8, hw) threads
     NATIVE_INTERNAL_N = 4096
     NATIVE_INTERNAL_THREADS = 8
 
@@ -252,35 +266,84 @@ class ShardParallelDispatcher:
             enabled = self._releases_gil
         cpus = os.cpu_count() or 1
         self._workers = min(n, max(cpus, 1))
-        # a 2-core host is already saturated by thread-per-connection
-        # request concurrency; pool.map dispatch there costs more than
-        # the split wins (measured: +26 ms/batch at bs=256 on 2 cores),
-        # so the dispatcher needs headroom to engage
-        self.enabled = bool(
-            (force or enabled)
-            and n > 1
-            and (force or cpus >= 4)
-            and knobs.get("PERSIA_PS_SHARD_PARALLEL")
-        )
+        # capability probe: a tuning-capable native backend reports its
+        # internal parallel_shards config (and accepts overrides); a
+        # pre-SIMD .so or the pure-Python holder reports None and
+        # negotiates down to the legacy pool/serial behavior
+        self._native_par = None
+        probe = getattr(holder, "parallel_info", None)
+        if callable(probe) and not force:
+            try:
+                self._native_par = probe()
+            except Exception:
+                self._native_par = None
+        want = bool(knobs.get("PERSIA_PS_SHARD_PARALLEL"))
+        self.mode = "serial"
         self._pool = None
-        if self.enabled:
-            from concurrent.futures import ThreadPoolExecutor
+        if (self._native_par is not None and enabled and n > 1 and want):
+            # native-internal mode: one GIL-released call per request;
+            # the store fans out internally from MIN_PARALLEL signs.
+            # Hosts beyond the store's 8-thread auto cap get an
+            # explicit thread count so big machines are not left idle.
+            threads = 0 if cpus <= 8 else min(n, cpus)
+            try:
+                holder.set_parallel(threads, self.MIN_PARALLEL)
+                self._native_par = probe()
+            except Exception:
+                pass
+            self.mode = "native"
+            self.enabled = True
+        else:
+            # a 2-core host is already saturated by thread-per-
+            # connection request concurrency; pool.map dispatch there
+            # costs more than the split wins (measured: +26 ms/batch at
+            # bs=256 on 2 cores), so the pool needs headroom to engage
+            self.enabled = bool(
+                (force or enabled)
+                and n > 1
+                and (force or cpus >= 4)
+                and want
+            )
+            if self.enabled:
+                from concurrent.futures import ThreadPoolExecutor
 
-            self._pool = ThreadPoolExecutor(
-                max_workers=self._workers,
-                thread_name_prefix="ps-shard")
+                self.mode = "pool"
+                self._pool = ThreadPoolExecutor(
+                    max_workers=self._workers,
+                    thread_name_prefix="ps-shard")
+
+    def info(self) -> dict:
+        """Health/metrics snapshot: how this replica dispatches."""
+        doc = {"mode": self.mode, "enabled": self.enabled,
+               "workers": self._workers}
+        if self._native_par is not None:
+            doc["native_threads"] = int(self._native_par["threads"])
+            doc["native_min_batch"] = int(self._native_par["min_batch"])
+        return doc
 
     def _engage(self, n_signs: int) -> bool:
         if not self.enabled or n_signs < self.MIN_PARALLEL:
             return False
+        if self.mode == "native":
+            # the tuned store parallelizes inside the single foreign
+            # call — splitting here would serialize it behind pool.map
+            return False
         if self.force:
             return True
-        if (self._releases_gil and n_signs >= self.NATIVE_INTERNAL_N
-                and self._workers <= self.NATIVE_INTERNAL_THREADS):
-            # the native store's own parallel_shards already covers this
-            # batch with as many threads as this host has — splitting
-            # here would only disable it and add dispatch overhead
-            return False
+        if self._releases_gil:
+            # the native store's own parallel_shards already covers
+            # this batch with as many threads as this host has —
+            # splitting here would only disable it and add dispatch
+            # overhead. Probed config when the backend reports one,
+            # legacy constants for an old .so.
+            if self._native_par is not None:
+                nat_n = int(self._native_par["min_batch"])
+                nat_t = int(self._native_par["threads"])
+            else:
+                nat_n = self.NATIVE_INTERNAL_N
+                nat_t = self.NATIVE_INTERNAL_THREADS
+            if n_signs >= nat_n and self._workers <= nat_t:
+                return False
         return True
 
     def _shard_buckets(self, signs: np.ndarray) -> List[np.ndarray]:
@@ -471,6 +534,25 @@ class PsService:
                               "planning should shrink the table or "
                               "restart the replica)"),
             }
+        # kernel-path + dispatch gauges: constant-per-process codes so
+        # /fleet/status (and any scraper) can flag a replica that fell
+        # back to scalar kernels or negotiated shard-parallel dispatch
+        # down to serial without parsing /healthz. simd: -1 no native
+        # SIMD ABI | 0 scalar | 1 avx2 | 2 neon; dispatch: 0 serial |
+        # 1 thread-pool | 2 native-internal.
+        simd_name = getattr(holder, "simd_path", None)
+        g_simd = reg.gauge(
+            "ps_simd_path", {"server": port_label},
+            help_text="native kernel path this replica selected "
+                      "(-1 none/pre-SIMD .so, 0 scalar, 1 avx2, "
+                      "2 neon) — scalar on an AVX2 host usually means "
+                      "PERSIA_NATIVE_SIMD was forced down")
+        g_simd.set(SIMD_PATH_CODES.get(simd_name, -1))
+        g_disp = reg.gauge(
+            "ps_dispatch_mode", {"server": port_label},
+            help_text="shard-parallel dispatch mode (0 serial, "
+                      "1 thread-pool, 2 native-internal GIL-free)")
+        g_disp.set(DISPATCH_MODE_CODES.get(self._dispatch.mode, 0))
         # disk-tier gauges (spill-armed holders only)
         self._spill_gauges = None
         if getattr(holder, "spill", None) is not None:
@@ -576,6 +658,13 @@ class PsService:
             doc["model_manager_status"] = self.status
         doc["holder_entries"] = len(self.holder)
         doc["shard_parallel"] = self._dispatch.enabled
+        # kernel-path + dispatch observables: which SIMD path the
+        # native store selected (None for the python holder or a
+        # pre-SIMD .so) and how this replica parallelizes requests —
+        # /fleet/status flags replicas that fell back to scalar or
+        # negotiated the dispatcher down
+        doc["simd"] = getattr(self.holder, "simd_path", None)
+        doc["dispatch"] = self._dispatch.info()
         # storage-policy observables: what precision this replica's rows
         # are stored at and how many data bytes are resident (split so
         # capacity planning can see the embedding-vs-state share); the
@@ -1041,8 +1130,10 @@ class PsService:
         if done:
             rs.snapshot_rows = []  # freed; capture carries the rest
             rs.extract_pos = 0
-        return pack_arrays({"done": done},
-                           [np.frombuffer(chunk, np.uint8)])
+        # scatter-gather framing: the packed chunk goes socketward
+        # without the pack_arrays staging concat (wire bytes identical)
+        return self._pack({"done": done},
+                          [np.frombuffer(chunk, np.uint8)])
 
     def _reshard_install(self, payload: bytes) -> bytes:
         """Install a migrated row chunk on the target: batched per
@@ -1052,7 +1143,7 @@ class PsService:
         like any other full-row write — a target that crashes after
         the migration reconstructs its migrated rows from the replay
         stream (see restore(routing=))."""
-        from persia_tpu.reshard import unpack_rows
+        from persia_tpu.reshard import unpack_row_runs
 
         meta, (blob,) = unpack_arrays(payload)
         if faults._active:
@@ -1064,19 +1155,25 @@ class PsService:
         # Repeated installs from the LIVE attempt are idempotent —
         # full-row set_entries writes.
         self._check_fence(meta.get("fence"), renew=False)
+        # runs come out of the chunk as (signs, dim, record matrix) —
+        # same-shape runs merge straight into one set_entries call
+        # (one GIL-released batched write on the native holder), no
+        # per-row unpack/stack staging
         by_shape: dict = {}
-        for sign, dim, vec in unpack_rows(bytes(blob)):
-            by_shape.setdefault((int(dim), len(vec)), []).append(
-                (int(sign), vec))
+        for signs, dim, mat in unpack_row_runs(blob):
+            by_shape.setdefault((dim, mat.shape[1]), []).append(
+                (signs, mat))
         n = 0
-        for (dim, _width), rows in by_shape.items():
-            signs = np.array([s for s, _v in rows], np.uint64)
-            vecs = np.stack([v for _s, v in rows])
+        for (dim, _width), runs in by_shape.items():
+            signs = (runs[0][0] if len(runs) == 1
+                     else np.concatenate([s for s, _m in runs]))
+            vecs = (runs[0][1] if len(runs) == 1
+                    else np.concatenate([m for _s, m in runs]))
             self.holder.set_entries(signs, dim, vecs)
             self._bump_update_ver()
             if self.inc_dumper is not None:
                 self.inc_dumper.commit(signs)
-            n += len(rows)
+            n += len(signs)
         return msgpack.packb({"installed": n})
 
     def _reshard_drain(self, payload: bytes) -> bytes:
@@ -1099,8 +1196,8 @@ class PsService:
             if entry is not None:
                 rows.append((sign, entry[0], entry[1]))
         chunk = pack_rows(rows)
-        return pack_arrays({"rows": len(rows)},
-                           [np.frombuffer(chunk, np.uint8)])
+        return self._pack({"rows": len(rows)},
+                          [np.frombuffer(chunk, np.uint8)])
 
     def _reshard_freeze(self, payload: bytes) -> bytes:
         req = msgpack.unpackb(payload, raw=False)

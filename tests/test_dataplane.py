@@ -330,8 +330,77 @@ def test_shard_parallel_python_holder_auto_serial():
 
     disp = ShardParallelDispatcher(_configure(EmbeddingHolder(1000, 8)))
     assert not disp.enabled
+    assert disp.mode == "serial"
     out = disp.lookup(np.array([1, 2, 3], np.uint64), DIM, True)
     assert out.shape == (3, DIM)
+
+
+def test_shard_parallel_capability_probe_negotiate_down(monkeypatch):
+    """The dispatcher's gating must introspect the backend (the
+    parallel_info capability probe), not the class name: a holder
+    without the tuning ABI — an old .so — negotiates down to the
+    legacy pool behavior with the hard-coded internal constants, and a
+    probe-armed holder engages native-internal mode on ANY core count
+    with one GIL-released call per request."""
+    import os as _os
+
+    from persia_tpu.service.ps_service import ShardParallelDispatcher
+
+    calls = []
+
+    class TunableHolder:  # new .so: probe + tuning ABI
+        num_internal_shards = 8
+        releases_gil = True
+
+        def parallel_info(self):
+            return {"threads": 4, "min_batch": 512}
+
+        def set_parallel(self, threads, min_batch):
+            calls.append((threads, min_batch))
+            return True
+
+        def lookup(self, signs, dim, training):
+            return np.zeros((len(signs), dim), np.float32)
+
+    class OldSoHolder:  # pre-SIMD .so: releases the GIL, no probe
+        num_internal_shards = 8
+        releases_gil = True
+
+        def lookup(self, signs, dim, training):
+            return np.zeros((len(signs), dim), np.float32)
+
+    # native-internal mode must not depend on the legacy cpus >= 4
+    # pool gate — pin a 1-core host
+    monkeypatch.setattr(_os, "cpu_count", lambda: 1)
+    disp = ShardParallelDispatcher(TunableHolder())
+    assert disp.mode == "native" and disp.enabled
+    assert calls and calls[0][1] == disp.MIN_PARALLEL
+    # one foreign call per request: _engage never splits in native mode
+    assert not disp._engage(100_000)
+    out = disp.lookup(np.arange(600, dtype=np.uint64), DIM, True)
+    assert out.shape == (600, DIM)
+
+    # old .so on the same 1-core host: no probe -> pool gating applies
+    # and the dispatcher stays serial (pool.map would only add tax)
+    old = ShardParallelDispatcher(OldSoHolder())
+    assert old._native_par is None
+    assert old.mode == "serial" and not old.enabled
+
+    # old .so on a big host: pool mode with the LEGACY internal
+    # constants — a 4096-sign batch is left to the store's internal
+    # parallelism, a mid-size one is split by the pool
+    monkeypatch.setattr(_os, "cpu_count", lambda: 8)
+    old8 = ShardParallelDispatcher(OldSoHolder())
+    assert old8.mode == "pool" and old8.enabled
+    assert old8._engage(1024)
+    assert not old8._engage(ShardParallelDispatcher.NATIVE_INTERNAL_N)
+    old8.close()
+
+    # force=True (the parity-test hook) pins the pool split path even
+    # when the backend could run native-internal
+    forced = ShardParallelDispatcher(TunableHolder(), force=True)
+    assert forced.mode == "pool" and forced._native_par is None
+    forced.close()
 
 
 def test_ps_service_shard_parallel_over_rpc():

@@ -1,0 +1,199 @@
+"""SIMD kernel parity: the vector paths (AVX2/NEON) must store the SAME
+BYTES as the scalar reference for every conversion and optimizer update.
+
+The kernels reimplement the scalar rounding algorithms with vector
+integer ops (not the hardware convert instructions), so equality is
+exact — these tests compare raw stored bytes, not float tolerances. On
+a host without the vector ISA `simd_resolve` clamps the forced path to
+scalar and the comparisons become trivial (still valid: never SIGILL).
+"""
+
+import ctypes
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+native = pytest.importorskip("persia_tpu.ps.native")
+
+if native.load_native_lib() is None:
+    pytest.skip("native library unavailable", allow_module_level=True)
+
+from persia_tpu.ps.native import (  # noqa: E402
+    NativeEmbeddingHolder,
+    load_native_lib,
+    native_capabilities,
+)
+
+LIB = load_native_lib()
+
+if "simd" not in native_capabilities(LIB):
+    pytest.skip("native library predates the SIMD ABI",
+                allow_module_level=True)
+
+_DT = {"fp16": (1, 2), "bf16": (2, 2)}  # name -> (code, itemsize)
+_SCALAR, _SELECTED = 0, -1
+
+
+def _narrow(dtype_code: int, src: np.ndarray, path: int) -> bytes:
+    src = np.ascontiguousarray(src, np.float32)
+    itemsize = 2
+    dst = np.empty(len(src) * itemsize, np.uint8)
+    LIB.ptps_narrow_rows(
+        dtype_code, src.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        len(src), dst.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), path)
+    return dst.tobytes()
+
+
+def _widen(dtype_code: int, raw: np.ndarray, path: int) -> bytes:
+    raw = np.ascontiguousarray(raw, np.uint8)
+    n = len(raw) // 2
+    dst = np.empty(n, np.float32)
+    LIB.ptps_widen_rows(
+        dtype_code, raw.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        n, dst.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), path)
+    return dst.tobytes()
+
+
+def _float_pool(rng: np.random.Generator, n: int) -> np.ndarray:
+    """~n f32s hitting every rounding branch: normals across the
+    exponent range, f16-subnormal magnitudes, f32 subnormals, overflow,
+    ties-to-even boundary patterns, specials, and raw random bits
+    (which include NaN payloads and infinities by construction)."""
+    parts = [
+        # normals spanning f16's and bf16's exponent ranges
+        (rng.normal(size=n // 4) *
+         np.exp2(rng.integers(-30, 31, n // 4))).astype(np.float32),
+        # f16-subnormal range and below-tiny
+        (rng.normal(size=n // 8) * 1e-7).astype(np.float32),
+        (rng.normal(size=n // 8) * 1e-41).astype(np.float32),  # f32 subnormal
+        (rng.normal(size=n // 8) * 1e5).astype(np.float32),    # f16 overflow
+        # exact ties: mantissa bits below the target's lsb set to the
+        # halfway pattern, forcing the round-to-even branch
+        (rng.integers(0, 1 << 32, n // 4, dtype=np.uint64)
+         .astype(np.uint32) & np.uint32(0xFFFFE000)
+         | np.uint32(0x1000)).view(np.float32),
+        # raw bit patterns: NaN payloads, infs, everything
+        rng.integers(0, 1 << 32, n // 8, dtype=np.uint64)
+        .astype(np.uint32).view(np.float32),
+        np.array([0.0, -0.0, np.inf, -np.inf, np.nan, 65504.0, 65520.0,
+                  2.0 ** -24, 2.0 ** -25, 2.0 ** -14, 1.0, -1.0],
+                 np.float32),
+    ]
+    return np.concatenate(parts)
+
+
+@pytest.mark.parametrize("dtype", ["fp16", "bf16"])
+def test_narrow_property_simd_vs_scalar(dtype):
+    """~80k adversarial/random floats per dtype, plus every n % 8 tail
+    length: the selected SIMD path must produce byte-identical narrow
+    results to the forced-scalar kernel."""
+    code, _ = _DT[dtype]
+    pool = _float_pool(np.random.default_rng(7), 80_000)
+    assert len(pool) >= 80_000
+    assert _narrow(code, pool, _SELECTED) == _narrow(code, pool, _SCALAR)
+    # every vector-tail remainder, from empty to two full lanes
+    for n in range(0, 17):
+        sub = pool[1000:1000 + n]
+        assert _narrow(code, sub, _SELECTED) == _narrow(code, sub, _SCALAR)
+
+
+@pytest.mark.parametrize("dtype", ["fp16", "bf16"])
+def test_widen_exhaustive_simd_vs_scalar(dtype):
+    """All 65536 16-bit patterns (the entire input domain of widen,
+    subnormals/NaN payloads/infs included) decode byte-identically on
+    the SIMD and scalar paths, at every tail length."""
+    code, _ = _DT[dtype]
+    raw = np.arange(65536, dtype=np.uint16).view(np.uint8)
+    assert _widen(code, raw, _SELECTED) == _widen(code, raw, _SCALAR)
+    for n in range(0, 17):
+        sub = raw[:2 * n]
+        assert _widen(code, sub, _SELECTED) == _widen(code, sub, _SCALAR)
+
+
+def test_narrow_widen_roundtrip_exact():
+    """Values exactly representable in the narrow dtype must survive a
+    narrow->widen round trip bit-for-bit on the selected path."""
+    for dtype in ("fp16", "bf16"):
+        code, _ = _DT[dtype]
+        nptype = np.float16 if dtype == "fp16" else None
+        vals = np.array([0.0, -0.0, 1.0, -2.5, 0.5, 65504.0 if nptype
+                         else 2.0 ** 127, 2.0 ** -14], np.float32)
+        if nptype is not None:
+            vals = vals.astype(nptype).astype(np.float32)
+        raw = np.frombuffer(_narrow(code, vals, _SELECTED), np.uint8)
+        back = np.frombuffer(_widen(code, raw, _SELECTED), np.float32)
+        np.testing.assert_array_equal(back.view(np.uint32),
+                                      vals.view(np.uint32))
+
+
+@pytest.mark.parametrize("optimizer", [
+    {"type": "sgd", "lr": 0.1, "wd": 0.01},
+    {"type": "adagrad", "lr": 0.05},
+    {"type": "adagrad", "lr": 0.05, "vectorwise_shared": True},
+    {"type": "adam", "lr": 0.01},
+])
+@pytest.mark.parametrize("row_dtype", ["fp32", "fp16", "bf16"])
+def test_optimizer_update_simd_vs_scalar_stored_bytes(optimizer,
+                                                     row_dtype):
+    """In-slab optimizer updates: two stores fed identical batches, one
+    on the selected SIMD path and one forced scalar, must hold
+    byte-identical rows afterwards (embedding AND optimizer state).
+    dim=19 exercises the vector tail on every row."""
+    dim = 19
+    rng = np.random.default_rng(13)
+    signs = rng.integers(1, 1 << 48, size=512, dtype=np.uint64)
+
+    def run(path: str) -> list:
+        assert LIB.ptps_simd_force(path.encode()) >= 0
+        try:
+            h = NativeEmbeddingHolder(1 << 14, 4, row_dtype=row_dtype)
+            h.configure("bounded_uniform", {"lower": -0.1, "upper": 0.1},
+                        weight_bound=1.0)
+            h.register_optimizer(optimizer)
+            g_rng = np.random.default_rng(29)
+            h.lookup(signs, dim, True)
+            for _ in range(4):
+                # large grads push values into the weight-bound clamp
+                grads = g_rng.normal(scale=5.0,
+                                     size=(len(signs), dim)).astype(
+                                         np.float32)
+                h.update_gradients(signs, grads, dim)
+            return [h.get_entry(int(s)) for s in signs[:64]]
+        finally:
+            LIB.ptps_simd_force(b"auto")
+
+    fast = run("auto")
+    slow = run("scalar")
+    for (da, va), (db, vb) in zip(fast, slow):
+        assert da == db
+        np.testing.assert_array_equal(va.view(np.uint32),
+                                      vb.view(np.uint32))
+
+
+def test_simd_env_knob_forces_scalar():
+    """PERSIA_NATIVE_SIMD=scalar must pin a fresh process to the scalar
+    path (the forced-scalar parity lane and the ops fallback knob)."""
+    env = dict(os.environ)
+    env["PERSIA_NATIVE_SIMD"] = "scalar"
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "from persia_tpu.ps.native import native_simd_path;"
+         "print(native_simd_path())"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert out.stdout.strip().splitlines()[-1] == "scalar"
+
+
+def test_simd_force_clamps_to_host():
+    """Forcing a path the host cannot execute must clamp (negotiate
+    down), never crash: ask for NEON on x86 / AVX2 on arm."""
+    for want in (b"avx2", b"neon", b"scalar"):
+        code = LIB.ptps_simd_force(want)
+        assert code in (0, 1, 2)
+    LIB.ptps_simd_force(b"auto")
+    path = LIB.ptps_simd_path().decode()
+    assert path in ("scalar", "avx2", "neon")
