@@ -23,6 +23,10 @@ federated view:
 - ``GET /fleet/variants`` — the serving tier's variant topology merged
   per variant (fleet-wide request totals, weight/status/default skew
   detection — a half-landed variant_admin broadcast shows up here).
+- ``GET /fleet/history`` — the bounded in-memory history ring: every
+  scraped series' recent ``(t, value)`` points with window aggregates
+  (avg/min/max/rate + per-service breakdown) — the evidence surface
+  the autopilot decides on (:mod:`persia_tpu.autopilot`).
 
 **Resilience contract**: scraping is PULL-ONLY (a fleet monitor that is
 absent, down, or slow changes nothing about the services — no new wire
@@ -212,6 +216,181 @@ class FlightRecorder:
         return path
 
 
+class FleetHistory:
+    """Bounded in-memory ring over every scraped metric: per-series
+    ``(t, value)`` points with time-window retention
+    (``PERSIA_FLEET_HISTORY_SEC``) and a per-series point cap
+    (``PERSIA_FLEET_HISTORY_POINTS``). Series are keyed
+    ``(service, metric, labels)``; duplicate series within one scrape
+    sum, same as the SLO engine's ingestion.
+
+    This is the substrate instantaneous scrapes cannot provide:
+    ``avg/min/max/rate_over(window)`` for capacity questions,
+    per-service ``breakdown`` for imbalance questions, and bounded
+    ``excerpt`` slices for autopilot decision evidence and
+    ``GET /fleet/history``. Pull-only by construction — it only ever
+    observes what the scrape loop already fetched."""
+
+    def __init__(self, keep_sec: Optional[float] = None,
+                 max_points: Optional[int] = None):
+        self.keep_sec = float(keep_sec if keep_sec is not None
+                              else knobs.get("PERSIA_FLEET_HISTORY_SEC"))
+        self.max_points = int(max_points if max_points is not None
+                              else knobs.get(
+                                  "PERSIA_FLEET_HISTORY_POINTS"))
+        self._lock = threading.Lock()
+        # (service, metric, labels_tuple) -> deque[(t, value)]
+        self._series: Dict[tuple, deque] = {}
+
+    def record(self, service: str, samples, t: Optional[float] = None):
+        """Feed one scrape's parsed samples (``parse_exposition``
+        output, or any iterable of ``(name, labels, value)``)."""
+        t = time.monotonic() if t is None else t
+        acc: Dict[tuple, float] = {}
+        for name, labels, value in samples:
+            key = (service, name, tuple(sorted(labels.items())))
+            acc[key] = acc.get(key, 0.0) + value
+        horizon = t - self.keep_sec
+        with self._lock:
+            for key, v in acc.items():
+                dq = self._series.setdefault(
+                    key, deque(maxlen=self.max_points))
+                dq.append((t, v))
+                while dq and dq[0][0] < horizon:
+                    dq.popleft()
+
+    def record_up(self, service: str, up: bool,
+                  t: Optional[float] = None):
+        """The synthetic liveness series, recorded every round whether
+        the scrape succeeded or not (a down target still moves its
+        history)."""
+        self.record(service, [("up", {}, 1.0 if up else 0.0)], t=t)
+
+    # --- queries ---------------------------------------------------------
+
+    def _windowed(self, metric: str, window_sec: float,
+                  service: Optional[str] = None,
+                  now: Optional[float] = None) -> Dict[tuple, list]:
+        """``{(service, labels): [(t, v), ...]}`` restricted to the
+        window; ``service`` is a regex (same contract as SloRule)."""
+        now = time.monotonic() if now is None else now
+        svc_re = re.compile(service) if service else None
+        out: Dict[tuple, list] = {}
+        with self._lock:
+            for (svc, name, lbl), dq in self._series.items():
+                if name != metric:
+                    continue
+                if svc_re is not None and not svc_re.search(svc):
+                    continue
+                pts = [(t, v) for t, v in dq if t >= now - window_sec]
+                if pts:
+                    out[(svc, lbl)] = pts
+        return out
+
+    @staticmethod
+    def _series_rate(pts) -> float:
+        """Counter-reset-aware per-second rate over one series' window
+        points (a restart counts from zero, not negative)."""
+        if len(pts) < 2:
+            return 0.0
+        inc = 0.0
+        for (_, prev), (_, cur) in zip(pts, pts[1:]):
+            inc += cur - prev if cur >= prev else cur
+        dt = pts[-1][0] - pts[0][0]
+        return inc / dt if dt > 0 else 0.0
+
+    def _agg(self, metric: str, window_sec: float, fn: str,
+             service: Optional[str] = None,
+             now: Optional[float] = None) -> Optional[float]:
+        per = self._windowed(metric, window_sec, service, now)
+        if not per:
+            return None
+        vals = []
+        for pts in per.values():
+            ys = [v for _, v in pts]
+            if fn == "avg":
+                vals.append(sum(ys) / len(ys))
+            elif fn == "min":
+                vals.append(min(ys))
+            elif fn == "max":
+                vals.append(max(ys))
+            elif fn == "rate":
+                vals.append(self._series_rate(pts))
+        # summed across series: the same aggregation the SLO engine
+        # applies, so history answers and rule answers agree
+        return sum(vals)
+
+    def avg_over(self, metric, window_sec, service=None, now=None):
+        return self._agg(metric, window_sec, "avg", service, now)
+
+    def min_over(self, metric, window_sec, service=None, now=None):
+        return self._agg(metric, window_sec, "min", service, now)
+
+    def max_over(self, metric, window_sec, service=None, now=None):
+        return self._agg(metric, window_sec, "max", service, now)
+
+    def rate_over(self, metric, window_sec, service=None, now=None):
+        return self._agg(metric, window_sec, "rate", service, now)
+
+    def breakdown(self, metric: str, window_sec: float,
+                  agg: str = "avg", service: Optional[str] = None,
+                  now: Optional[float] = None) -> Dict[str, float]:
+        """Per-service decomposition of an aggregate — the imbalance
+        view ('which replica carries the load'). Returns
+        ``{service: value}`` with each service's series summed."""
+        per = self._windowed(metric, window_sec, service, now)
+        out: Dict[str, float] = {}
+        for (svc, _lbl), pts in per.items():
+            ys = [v for _, v in pts]
+            if agg == "avg":
+                v = sum(ys) / len(ys)
+            elif agg == "min":
+                v = min(ys)
+            elif agg == "max":
+                v = max(ys)
+            elif agg == "rate":
+                v = self._series_rate(pts)
+            else:
+                raise ValueError(f"bad agg {agg!r}")
+            out[svc] = out.get(svc, 0.0) + v
+        return out
+
+    def excerpt(self, metric: Optional[str] = None,
+                window_sec: float = 60.0,
+                service: Optional[str] = None,
+                points: int = 32,
+                now: Optional[float] = None) -> List[Dict]:
+        """Bounded raw slices for evidence bundles and the HTTP view:
+        one entry per matching series, each with at most ``points``
+        stride-downsampled points (newest kept exactly)."""
+        now = time.monotonic() if now is None else now
+        if metric is None:
+            with self._lock:
+                names = sorted({k[1] for k in self._series})
+            return [{"metric": n} for n in names]
+        per = self._windowed(metric, window_sec, service, now)
+        out = []
+        for (svc, lbl) in sorted(per):
+            pts = per[(svc, lbl)]
+            if len(pts) > points:
+                stride = len(pts) / points
+                pts = [pts[min(int(i * stride), len(pts) - 1)]
+                       for i in range(points - 1)] + [pts[-1]]
+            out.append({
+                "service": svc, "metric": metric, "labels": dict(lbl),
+                "points": [[round(now - t, 3), v] for t, v in pts],
+            })
+        return out
+
+    def stats(self) -> Dict:
+        with self._lock:
+            return {"n_series": len(self._series),
+                    "n_points": sum(len(d)
+                                    for d in self._series.values()),
+                    "keep_sec": self.keep_sec,
+                    "max_points_per_series": self.max_points}
+
+
 class FleetMonitor:
     """The scrape loop + federation + SLO wiring.
 
@@ -268,8 +447,13 @@ class FleetMonitor:
             "fleet_slo_breaches_total",
             help_text="SLO firing transitions observed")
         self._t_round = self.registry.histogram(
-            "fleet_scrape_round_time_cost_sec",
-            help_text="wall time of one full scrape round")
+            "fleet_scrape_round_sec",
+            help_text="wall time of one full scrape round — a wedged "
+                      "or slow sidecar shows up here before it pages")
+        # bounded per-series history over everything scraped: the
+        # substrate for /fleet/history, autopilot evidence excerpts,
+        # and hysteresis questions instantaneous scrapes cannot answer
+        self.history = FleetHistory()
         self._pool: Optional[ThreadPoolExecutor] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -406,6 +590,11 @@ class FleetMonitor:
                 t.last_flight_t = now
                 self.recorder.observe(t.service, res["flight"])
             self.engine.ingest(t.service, res["samples"])
+            self.history.record(t.service, res["samples"])
+        # liveness moves every round for every target — a down target
+        # still advances its history (the autopilot's "is it back" view)
+        for t in targets:
+            self.history.record_up(t.service, t.up)
         self.engine.evaluate()
         self._m_rounds.inc()
         # under the targets lock: scrape_once is public API — the
@@ -590,6 +779,17 @@ class FleetMonitor:
         the RPC plane."""
         from persia_tpu import hotness as _hotness
 
+        snaps, scraped = self._hotness_snaps()
+        merged = _hotness.merge_snapshots(snaps)
+        report = _hotness.fleet_report(merged, hbm_bytes=hbm_bytes,
+                                       num_replicas=num_replicas,
+                                       measured_hit_rate=measured_hit_rate)
+        report["sources"] = scraped
+        return report
+
+    def _hotness_snaps(self):
+        """Pull every up target's full hotness snapshot (disabled or
+        absent targets contribute nothing)."""
         snaps = []
         scraped = []
         for t in self.targets():
@@ -607,12 +807,57 @@ class FleetMonitor:
                 snaps.append(doc)
                 scraped.append({"service": t.service,
                                 "total": int(doc.get("total", 0))})
+        return snaps, scraped
+
+    def hotness_plan(self, num_replicas: int,
+                     num_slots: Optional[int] = None,
+                     current_table=None) -> Dict:
+        """Hotness-balanced placement plan against the LIVE merged
+        sketches — what the autopilot's rebalance policy and the
+        operator's reshard driver size moves from. ``current_table``
+        pins slot count and enables moved-slot minimization; without
+        it the plan assumes a fresh hash-even layout. Pull-only like
+        every other fleet view."""
+        from persia_tpu import hotness as _hotness
+
+        snaps, _ = self._hotness_snaps()
         merged = _hotness.merge_snapshots(snaps)
-        report = _hotness.fleet_report(merged, hbm_bytes=hbm_bytes,
-                                       num_replicas=num_replicas,
-                                       measured_hit_rate=measured_hit_rate)
-        report["sources"] = scraped
-        return report
+        return _hotness.placement_plan(merged, num_replicas,
+                                       num_slots=num_slots,
+                                       current_table=current_table)
+
+    def fleet_history(self, metric: Optional[str] = None,
+                      service: Optional[str] = None,
+                      window_sec: float = 60.0,
+                      points: int = 32) -> Dict:
+        """The history ring's HTTP view: without ``metric``, the series
+        inventory + ring stats; with one, bounded per-series excerpts
+        plus the window aggregates (avg/min/max/rate + per-service
+        breakdown) so operators and CI read the same numbers the
+        autopilot decides on."""
+        doc = {"stats": self.history.stats(), "window_sec": window_sec}
+        if metric is None:
+            doc["metrics"] = [e["metric"]
+                              for e in self.history.excerpt()]
+            return doc
+        now = time.monotonic()
+        doc.update({
+            "metric": metric,
+            "service": service,
+            "avg": self.history.avg_over(metric, window_sec, service,
+                                         now),
+            "min": self.history.min_over(metric, window_sec, service,
+                                         now),
+            "max": self.history.max_over(metric, window_sec, service,
+                                         now),
+            "rate": self.history.rate_over(metric, window_sec, service,
+                                           now),
+            "breakdown": self.history.breakdown(metric, window_sec,
+                                                "avg", service, now),
+            "series": self.history.excerpt(metric, window_sec, service,
+                                           points, now),
+        })
+        return doc
 
     def fleet_routing(self) -> Dict:
         """The elastic tier's control-plane view: every target's
@@ -772,6 +1017,17 @@ class FleetHttpServer:
                     elif url.path == "/fleet/breaches":
                         body = json.dumps(
                             mon.engine.breach_events()).encode()
+                    elif url.path == "/fleet/history":
+                        # ?metric= names the series (omit for the
+                        # inventory); ?service= regex-filters;
+                        # ?window= seconds; ?points= per-series cap
+                        body = json.dumps(mon.fleet_history(
+                            metric=q.get("metric", [None])[0],
+                            service=q.get("service", [None])[0],
+                            window_sec=float(
+                                q.get("window", ["60"])[0]),
+                            points=int(q.get("points", ["32"])[0]),
+                        )).encode()
                     elif url.path == "/fleet/routing":
                         body = json.dumps(mon.fleet_routing()).encode()
                     elif url.path == "/fleet/variants":
@@ -858,8 +1114,14 @@ def main(argv=None):
                         "flight recorder)")
     p.add_argument("--check", type=int, default=0, metavar="ROUNDS",
                    help="CI gate mode: run ROUNDS scrape rounds "
-                        "synchronously, print the alert table, exit "
-                        "nonzero iff any SLO is firing")
+                        "synchronously, print the alert table plus an "
+                        "actionable FIRING summary (rule, label set, "
+                        "value vs threshold), exit nonzero iff any SLO "
+                        "is firing")
+    p.add_argument("--json", action="store_true",
+                   help="with --check: emit the full alert/breach "
+                        "document as JSON instead of the table "
+                        "(machine-readable CI logs)")
     args = p.parse_args(argv)
 
     engine = SloEngine(load_rules(args.slo_rules)
@@ -878,12 +1140,38 @@ def main(argv=None):
             monitor.scrape_once()
             time.sleep(args.scrape_interval)
         alerts = monitor.alerts()
+        firing = [a for a in alerts if a["firing"]]
+        if args.json:
+            print(json.dumps({
+                "firing": firing,
+                "alerts": alerts,
+                "breaches": monitor.engine.breach_events(),
+                "targets": [t.status_doc(time.monotonic())
+                            for t in monitor.targets()],
+            }, indent=1, default=str))
+            raise SystemExit(1 if firing else 0)
         for a in alerts:
             state = "FIRING" if a["firing"] else "ok"
             print(f"{state:>6}  {a['rule']:<24} {a['service']:<12} "
                   f"{a['expr']} {a['op']} {a['threshold']} "
                   f"(value={a['value']})")
-        raise SystemExit(monitor.engine.exit_code())
+        # the actionable summary CI logs need: WHAT breached, on which
+        # label set, and by how much — not just a nonzero exit
+        if firing:
+            print(f"\n{len(firing)} SLO rule(s) FIRING:")
+            for a in firing:
+                val = a["value"]
+                val = f"{val:.6g}" if isinstance(val, float) else val
+                since = a.get("firing_since")
+                held = (f", firing for "
+                        f"{time.monotonic() - since:.0f}s"
+                        if since is not None else "")
+                print(f"  {a['rule']} on {a['service']}: "
+                      f"{a['expr']} = {val}, breaching "
+                      f"{a['op']} {a['threshold']}{held}"
+                      + (f" — {a['description']}"
+                         if a.get("description") else ""))
+        raise SystemExit(1 if firing else 0)
     http = monitor.serve_http(args.host, args.port)
     monitor.start()
     _logger.info("fleet monitor serving /fleet/* on %s (%d targets)",

@@ -277,9 +277,67 @@ class Operator:
         conf = spec.get("roles", {}).get("embeddingParameterServer")
         return int(conf.get("replicas", 1)) if conf is not None else 0
 
+    def ps_replicas(self, job_name: str) -> int:
+        """The job's CURRENT desired PS replica count — the autopilot
+        reads the world it acts on from here (observed state, not its
+        own action history, so an operator-side manual scale between
+        ticks is seen, not fought)."""
+        with self._lock:
+            spec = self._jobs.get(job_name)
+            if spec is None:
+                raise KeyError(f"job {job_name!r} is not tracked")
+            return self._ps_replicas_of(spec)
+
     def reshard_events(self) -> List[dict]:
         with self._lock:
             return list(self._reshard_events)
+
+    def rebalance_ps(self, job_name: str) -> dict:
+        """Re-place slots across the CURRENT replica set by workload
+        hotness (replica count unchanged): the driver runs a
+        ``reshard_to`` at the same count with a hotness
+        ``placement_plan``'s slot weights. Without a driver the intent
+        is recorded (status ``pending``) for an external controller,
+        same convention as :meth:`scale_ps`."""
+        import time as _time
+
+        with self._lock:
+            spec = self._jobs.get(job_name)
+            if spec is None:
+                raise KeyError(f"job {job_name!r} is not tracked")
+            old = self._ps_replicas_of(spec)
+            if old == 0:
+                raise ValueError(f"job {job_name!r} has no PS role")
+        event = {"job": job_name, "from": old, "to": old,
+                 "phase": "rebalance",
+                 "time": _time.strftime("%Y-%m-%dT%H:%M:%S"),
+                 "status": "pending"}
+        if self._reshard_driver is not None:
+            self._reshard_driver(job_name, old, old, "rebalance", spec)
+            event["status"] = "done"
+        with self._lock:
+            self._reshard_events.append(event)
+        _logger.info("rebalance_ps %s: %d replicas (%s)", job_name, old,
+                     event["status"])
+        return event
+
+    # --- autopilot hookup -------------------------------------------
+
+    def attach_autopilot(self, pilot):
+        """Expose a running :class:`persia_tpu.autopilot.Autopilot` on
+        the REST surface (``GET /autopilot``). The operator never
+        drives the pilot — the pilot calls INTO the operator; this
+        hook only makes its decisions inspectable next to the
+        reshard/variant audit trails."""
+        self._autopilot = pilot
+
+    def autopilot_doc(self) -> dict:
+        pilot = getattr(self, "_autopilot", None)
+        if pilot is None:
+            return {"enabled": False}
+        doc = pilot.describe()
+        doc["enabled"] = True
+        return doc
 
     def scale_ps(self, job_name: str, replicas: int) -> dict:
         """Reconcile a job's PS tier to ``replicas`` with the live
@@ -591,6 +649,10 @@ class SchedulingServer:
                         self._send(200, {"events": op.reshard_events()})
                     elif route == "/variants":
                         self._send(200, {"events": op.variant_events()})
+                    elif route == "/autopilot":
+                        # the attached autopilot's posture + recent
+                        # decisions (enabled: false when none attached)
+                        self._send(200, op.autopilot_doc())
                     else:
                         self._send(404, {"error": f"no route {route!r}"})
                 except Exception as e:  # surface as HTTP, keep serving

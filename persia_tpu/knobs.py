@@ -71,6 +71,27 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
        "(shard, record-class) arena in the Python holder (amortized-"
        "doubling, so large stores reallocate O(log n) times). The "
        "native store's slab size is fixed at 4096 rows/slab."),
+    _k("PERSIA_AUTOPILOT_COOLDOWN_SEC", "float", 300.0,
+       "Default per-policy cooldown between executed autopilot actions "
+       "of the same kind. A policy may override it; raising it is the "
+       "first stabilizer when the action journal shows oscillation "
+       "(scale_out closely followed by scale_in)."),
+    _k("PERSIA_AUTOPILOT_JOURNAL_DIR", "str", None,
+       "Directory for the autopilot's durable action journal "
+       "(decision/executed/outcome records, atomic JSON files — same "
+       "discipline as the reshard journal). None keeps the journal "
+       "in-memory only: decisions are still queryable over HTTP but do "
+       "not survive the process."),
+    _k("PERSIA_AUTOPILOT_MAX_ACTIONS_PER_HOUR", "int", 12,
+       "Global autopilot action-rate limiter across ALL policies: "
+       "further actions (and recommendations) are deferred once this "
+       "many fired in the trailing hour. The blast-radius backstop "
+       "when a bad signal makes every policy want to act at once."),
+    _k("PERSIA_AUTOPILOT_MODE", "str", "recommend",
+       "Autopilot posture: `recommend` (default) journals every "
+       "decision it WOULD take without touching the fleet; `enforce` "
+       "executes decisions through the operator. Graduate only after "
+       "a recommend soak matches operator intent (DEPLOY.md runbook)."),
     _k("PERSIA_COORDINATOR_ADDR", "str", "127.0.0.1:23333",
        "Address of the persia-coordinator control-plane service (the "
        "NATS analogue). Service binaries take it as their argparse "
@@ -95,6 +116,15 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
     _k("PERSIA_FAULTS_SEED", "int", None,
        "Deterministic seed for the fault injector's RNG.",
        import_time_safe=True),
+    _k("PERSIA_FLEET_HISTORY_POINTS", "int", 512,
+       "Per-series point cap of the fleet monitor's in-memory history "
+       "ring (oldest points drop first). Bounds memory per scraped "
+       "series independently of the time window."),
+    _k("PERSIA_FLEET_HISTORY_SEC", "float", 600.0,
+       "Time-window retention of the fleet monitor's history ring: "
+       "every scraped series keeps this many seconds of (t, value) "
+       "points for /fleet/history, sustained()/trend() context, and "
+       "autopilot evidence excerpts."),
     _k("PERSIA_FLEET_TARGETS", "str", "",
        "Static fleet-monitor scrape targets: comma-joined "
        "`name=host:port` pairs, merged with coordinator discovery."),

@@ -104,6 +104,17 @@ class ObservabilityServer:
         self.variants_fn = variants_fn
         self.service = service
         self._t0 = time.monotonic()
+        # meta-observability: the sidecar measures ITSELF, so a slow
+        # /flight render or a wedged refresh_fn is visible in the same
+        # exposition it serves (and in /fleet/metrics). Pre-built per
+        # known path — unknown paths share "other" so a scanner cannot
+        # mint unbounded label cardinality.
+        self._t_request = {
+            p: registry.histogram(
+                "obs_http_request_sec", {"path": p},
+                help_text="sidecar HTTP request wall time per endpoint")
+            for p in ("/metrics", "/healthz", "/trace", "/flight",
+                      "/hotness", "/variants", "other")}
         sidecar = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -112,6 +123,16 @@ class ObservabilityServer:
                 pass
 
             def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+                t_req0 = time.perf_counter()
+                try:
+                    self._handle_get()
+                finally:
+                    path = urlparse(self.path).path
+                    hist = sidecar._t_request.get(
+                        path, sidecar._t_request["other"])
+                    hist.observe(time.perf_counter() - t_req0)
+
+            def _handle_get(self):
                 status = 200
                 try:
                     url = urlparse(self.path)

@@ -601,6 +601,32 @@ class PsService:
                       "armed client's lookup and its gradient's "
                       "apply (async-pipeline staleness, in steps)",
             buckets=STEP_BUCKETS)
+        # load-signal gauges for the autopilot's scale decisions: ROW
+        # volume, not RPC count — under the workers' all-to-all fanout
+        # every request touches every replica, so per-replica RPC rate
+        # is flat in replica count while rows/sec partitions with slot
+        # ownership (the signal that actually responds to scaling and
+        # to rebalancing). Pull-refreshed: the lookup path pays two
+        # uncontended lock ops (the _ver_lock cost class); the rate
+        # math runs per scrape in _refresh_mem_gauges.
+        self._rows_lock = threading.Lock()
+        self._rows_served = 0
+        self._rows_rate_last: Optional[tuple] = None  # (t, rows)
+        self._g_served_reqs = reg.gauge(
+            "ps_served_requests_total", {"server": port_label},
+            help_text="RPC requests this replica answered (monotone; "
+                      "mirrors the health doc's served_rpcs so wire-"
+                      "neutrality gates can read it from a scrape)")
+        self._g_lookup_rows = reg.gauge(
+            "ps_lookup_rows_total", {"server": port_label},
+            help_text="embedding rows served by lookup RPCs (monotone) "
+                      "— the load unit that scales with slot ownership")
+        self._g_lookup_row_rate = reg.gauge(
+            "ps_lookup_row_rate", {"server": port_label},
+            help_text="lookup rows/sec over the interval between the "
+                      "last two gauge refreshes (scrapes) — the "
+                      "autopilot's sustained() scale signal and its "
+                      "per-replica imbalance breakdown")
         # observability sidecar: /metrics + /healthz + /trace next to
         # the RPC socket (http_port=0 binds an ephemeral port; None
         # keeps the sidecar off — in-process test holders don't want a
@@ -629,6 +655,23 @@ class PsService:
             stats = self.holder.spill_stats()
             for key, g in self._spill_gauges.items():
                 g.set(stats.get(key, 0))
+        # load gauges: totals every refresh; the rate only re-anchors
+        # when at least 50ms passed, so a health probe landing right
+        # after a scrape cannot collapse the window to noise
+        t_now = time.monotonic()
+        rate = None
+        with self._rows_lock:
+            rows = self._rows_served
+            last = self._rows_rate_last
+            if last is None:
+                self._rows_rate_last = (t_now, rows)
+            elif t_now - last[0] >= 0.05:
+                self._rows_rate_last = (t_now, rows)
+                rate = (rows - last[1]) / (t_now - last[0])
+        self._g_lookup_rows.set(rows)
+        self._g_served_reqs.set(self.server.health()["served_rpcs"])
+        if rate is not None:
+            self._g_lookup_row_rate.set(max(rate, 0.0))
 
     def _health_rpc(self, payload: bytes) -> bytes:
         return msgpack.packb(self._health())
@@ -794,6 +837,9 @@ class PsService:
                 rs.exit_write(hit)
             if g is not None:
                 self._wgate.exit(g)
+        # row-volume accounting for the pull-refreshed load gauges
+        with self._rows_lock:
+            self._rows_served += len(signs)
         # telemetry-armed client asked ("hv" in the request meta) for
         # the holder's update version: it rides the response meta and
         # comes back on the client's update as "hver". Reply-only-when-

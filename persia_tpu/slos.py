@@ -22,6 +22,16 @@ scrape windows can answer without a query language):
 - ``p50/p90/p95/p99(<metric>)`` — quantile from a Prometheus histogram's
   ``_bucket`` series, computed on the window's bucket *increases* (the
   recent distribution, not the since-boot one).
+- ``sustained(<metric>)``  — the comparison's conservative extremum of
+  the per-scrape summed values across the window: under ``>``/``>=``
+  the window MINIMUM ("never dipped below X"), under ``<``/``<=`` the
+  MAXIMUM ("never rose above Y"), so the rule fires only when EVERY
+  scrape in the window breaches. Answers None until the window holds
+  at least 80% of its span. The hysteresis primitive an instantaneous
+  scrape cannot express — the autopilot's scale decisions key on it.
+- ``trend(<metric>)``      — least-squares slope (units/sec) of the
+  per-scrape summed values over the window (None with <2 points):
+  capacity-drift detection ("queue depth rising for 10 min").
 
 Rules evaluate per matching service by default (``scope: service``) so
 an alert names the replica that breached; ``scope: fleet`` aggregates
@@ -44,7 +54,8 @@ from persia_tpu.logger import get_default_logger
 _logger = get_default_logger(__name__)
 
 _EXPR_RE = re.compile(
-    r"^\s*(?:(?P<fn>rate|increase|ratio|p50|p90|p95|p99)\s*\(\s*"
+    r"^\s*(?:(?P<fn>rate|increase|ratio|sustained|trend"
+    r"|p50|p90|p95|p99)\s*\(\s*"
     r"(?P<arg1>[a-zA-Z_:][a-zA-Z0-9_:]*)\s*"
     r"(?:,\s*(?P<arg2>[a-zA-Z_:][a-zA-Z0-9_:]*)\s*)?\)"
     r"|(?P<metric>[a-zA-Z_:][a-zA-Z0-9_:]*))\s*$")
@@ -316,6 +327,20 @@ class SloEngine:
         self.breaches: "deque[Dict]" = deque(maxlen=self.MAX_BREACHES)
         self._keep_sec = max([r.window_sec for r in self.rules] + [60.0])
 
+    def add_rules(self, rules: List[SloRule]):
+        """Install additional rules at runtime, idempotent by name —
+        the autopilot contributes its policy rules to an already-
+        running engine. The retention window re-widens to cover the
+        largest new window."""
+        with self._lock:
+            have = {r.name for r in self.rules}
+            for r in rules:
+                if r.name not in have:
+                    self.rules.append(r)
+                    have.add(r.name)
+            self._keep_sec = max([r.window_sec for r in self.rules]
+                                 + [60.0])
+
     # --- ingestion -------------------------------------------------------
 
     def ingest(self, service: str, samples, t: Optional[float] = None):
@@ -341,9 +366,15 @@ class SloEngine:
             w.up = False
 
     def forget(self, service: str):
+        # by_label judgement state is keyed "service[label=value]" —
+        # forgetting a service must drop those too, or a re-registered
+        # service inherits a drained variant's firing_since and never
+        # fires a fresh breach
         with self._lock:
             self._windows.pop(service, None)
-            for key in [k for k in self._state if k[1] == service]:
+            for key in [k for k in self._state
+                        if k[1] == service
+                        or k[1].startswith(service + "[")]:
                 self._state.pop(key, None)
 
     # --- expression evaluation -------------------------------------------
@@ -434,6 +465,56 @@ class SloEngine:
             lo = b if b != float("inf") else lo
         return bounds[-2] if len(bounds) > 1 else 0.0
 
+    @staticmethod
+    def _points(w: _Window, name: str, window_sec: float,
+                now: float) -> List[Tuple[float, float]]:
+        """Per-snapshot summed values of ``name`` inside the window —
+        the time series sustained()/trend() aggregate over."""
+        pts: List[Tuple[float, float]] = []
+        for t, series in w.snaps:
+            if t < now - window_sec:
+                continue
+            vals = [v for (n, _l), v in series.items() if n == name]
+            if vals:
+                pts.append((t, sum(vals)))
+        return pts
+
+    def _sustained(self, w: _Window, name: str, window_sec: float,
+                   now: float, op: str = ">") -> Optional[float]:
+        """The comparison's conservative extremum of the per-scrape
+        summed values over the window: min under >/>= ("never dipped
+        below"), max under </<= ("never rose above") — either way the
+        rule only fires when every in-window scrape breaches. Answers
+        None until the window holds >=80% of its span — a freshly
+        started monitor (or a freshly appeared series) must not
+        declare load "sustained" off its first two scrapes. 80% rather
+        than 100% because retention prunes to exactly the largest rule
+        window, so strict coverage could never be met."""
+        pts = self._points(w, name, window_sec, now)
+        if not pts or now - pts[0][0] < window_sec * 0.8:
+            return None
+        ys = [v for _, v in pts]
+        return max(ys) if op in ("<", "<=") else min(ys)
+
+    def _trend(self, w: _Window, name: str, window_sec: float,
+               now: float) -> Optional[float]:
+        """Least-squares slope (units/sec) of the per-scrape summed
+        values over the window; None until two points exist."""
+        pts = self._points(w, name, window_sec, now)
+        if len(pts) < 2 or pts[-1][0] <= pts[0][0]:
+            return None
+        n = len(pts)
+        t0 = pts[0][0]
+        xs = [t - t0 for t, _ in pts]
+        ys = [v for _, v in pts]
+        mx = sum(xs) / n
+        my = sum(ys) / n
+        den = sum((x - mx) ** 2 for x in xs)
+        if den <= 0:
+            return None
+        num = sum((x - mx) * (y - my) for x, y in zip(xs, ys))
+        return num / den
+
     def _eval_expr(self, rule: SloRule, w: _Window,
                    now: float) -> Optional[float]:
         if rule.arg1 == "up" and rule.fn is None:
@@ -444,6 +525,11 @@ class SloEngine:
             return self._rate(w, rule.arg1, rule.window_sec, now)
         if rule.fn == "increase":
             return self._increase(w, rule.arg1, rule.window_sec, now)
+        if rule.fn == "sustained":
+            return self._sustained(w, rule.arg1, rule.window_sec, now,
+                                   op=rule.op)
+        if rule.fn == "trend":
+            return self._trend(w, rule.arg1, rule.window_sec, now)
         if rule.fn == "ratio":
             num = self._increase(w, rule.arg1, rule.window_sec, now)
             den = self._increase(w, rule.arg2, rule.window_sec, now)
@@ -498,7 +584,8 @@ class SloEngine:
             windows = {s: _Frozen(list(w.snaps), w.up)
                        for s, w in self._windows.items()}
         alerts: List[Dict] = []
-        for rule in self.rules:
+        # tuple(): add_rules may append concurrently mid-evaluation
+        for rule in tuple(self.rules):
             matched = {s: w for s, w in windows.items()
                        if rule.matches(s)}
             if rule.scope == "fleet":
@@ -514,16 +601,28 @@ class SloEngine:
                 # service[label=value] so alert/breach state never
                 # blends across values — a healthy default cannot mask
                 # (or be masked by) a broken canary
+                judged = set()
                 for service in sorted(matched):
                     w = matched[service]
                     for val in sorted(self._label_values(w, rule)):
+                        skey = f"{service}[{rule.by_label}={val}]"
+                        judged.add((rule.name, skey))
                         value = self._eval_expr(
                             rule, self._filter_label(w, rule.by_label,
                                                      val), now)
                         alerts.append(self._judge(
-                            rule,
-                            f"{service}[{rule.by_label}={val}]",
-                            value, now, fired))
+                            rule, skey, value, now, fired))
+                # label-value churn: a value absent from its service's
+                # latest snapshot (variant drained/removed) must not
+                # park pending/firing state — a re-registered variant
+                # that is STILL breaching gets a fresh breach event
+                # instead of silently inheriting firing_since (which
+                # would suppress the postmortem capture)
+                with self._lock:
+                    for k in [k for k in self._state
+                              if k[0] == rule.name and "[" in k[1]
+                              and k not in judged]:
+                        self._state.pop(k, None)
             else:
                 for service in sorted(matched):
                     value = self._eval_expr(rule, matched[service], now)

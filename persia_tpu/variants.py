@@ -135,6 +135,25 @@ class VariantRegistry:
                 raise KeyError(f"variant {name!r} is not registered")
             self._variants[name].weight = float(weight)
 
+    def reweight(self, weights: Dict[str, float]):
+        """Atomic bulk weight update: either every named variant gets
+        its new weight or nothing changes. The autopilot's traffic-
+        shift action uses this — shedding a burning variant means
+        lowering ITS weight while raising another's, and two
+        set_weight calls would expose a half-shifted split to every
+        route() between them."""
+        with self._lock:
+            missing = [n for n in weights if n not in self._variants]
+            if missing:
+                raise KeyError(
+                    f"variants {missing!r} are not registered")
+            bad = [n for n, w in weights.items() if float(w) < 0]
+            if bad:
+                raise ValueError(
+                    f"negative weights for {bad!r}")
+            for n, w in weights.items():
+                self._variants[n].weight = float(w)
+
     def set_status(self, name: str, status: str):
         if status not in (STATUS_LIVE, STATUS_DRAINING):
             raise ValueError(f"bad variant status {status!r}")

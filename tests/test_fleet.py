@@ -13,7 +13,7 @@ import urllib.request
 import pytest
 
 from persia_tpu import faults, tracing
-from persia_tpu.fleet import FleetMonitor, FlightRecorder
+from persia_tpu.fleet import FleetHistory, FleetMonitor, FlightRecorder
 from persia_tpu.metrics import MetricsRegistry, parse_exposition
 from persia_tpu.obs_http import ObservabilityServer
 from persia_tpu.slos import SloEngine, SloRule, default_rules, load_rules
@@ -629,3 +629,251 @@ def test_fleet_routing_reports_frozen_donors():
     finally:
         ps0.stop()
         ps1.stop()
+
+
+# --- fleet history ring (PR 18 tentpole substrate) ---------------------------
+
+
+def test_fleet_history_retention_and_aggregates():
+    """Time-window + point-cap retention, duplicate-series summing
+    within one scrape, and avg/min/max over the window."""
+    h = FleetHistory(keep_sec=10.0, max_points=4)
+    for t in (0.0, 2.0, 4.0, 6.0, 8.0):
+        h.record("ps0", [("m", {}, t)], t=t)
+    # max_points=4: the t=0 point fell off the cap
+    assert h.avg_over("m", 100.0, now=8.0) == pytest.approx(5.0)
+    assert h.min_over("m", 100.0, now=8.0) == 2.0
+    assert h.max_over("m", 100.0, now=8.0) == 8.0
+    # time retention: recording at t=20 prunes everything before t=10
+    h.record("ps0", [("m", {}, 9.0)], t=20.0)
+    assert h.avg_over("m", 100.0, now=20.0) == 9.0
+    assert h.stats()["n_points"] == 1
+    # duplicate series within ONE scrape sum (same contract as the
+    # SLO engine's ingestion)
+    h2 = FleetHistory(keep_sec=100.0, max_points=100)
+    h2.record("w0", [("q", {}, 1.0), ("q", {}, 2.0)], t=0.0)
+    assert h2.max_over("q", 10.0, now=1.0) == 3.0
+    # unknown metric / empty window answer None, not 0
+    assert h2.avg_over("nope", 10.0, now=1.0) is None
+    assert h2.avg_over("q", 0.5, now=50.0) is None
+
+
+def test_fleet_history_rate_and_breakdown():
+    h = FleetHistory(keep_sec=100.0, max_points=100)
+    for i, t in enumerate((0.0, 5.0, 10.0)):
+        h.record("ps0", [("c_total", {}, 10.0 * i)], t=t)
+    assert h.rate_over("c_total", 100.0, now=10.0) == pytest.approx(2.0)
+    # counter reset (restart): counts from zero, never negative
+    h.record("ps0", [("c_total", {}, 5.0)], t=15.0)
+    assert h.rate_over("c_total", 100.0, now=15.0) == pytest.approx(
+        (10.0 + 10.0 + 5.0) / 15.0)
+    # breakdown: per-service decomposition, label series summed
+    h3 = FleetHistory(keep_sec=100.0, max_points=100)
+    h3.record("ps0", [("rows", {"shard": "a"}, 3.0),
+                      ("rows", {"shard": "b"}, 5.0)], t=0.0)
+    h3.record("ps1", [("rows", {}, 2.0)], t=0.0)
+    assert h3.breakdown("rows", 10.0, "avg", now=1.0) == {
+        "ps0": 8.0, "ps1": 2.0}
+    # the aggregate view agrees with the breakdown's sum
+    assert h3.avg_over("rows", 10.0, now=1.0) == 10.0
+    with pytest.raises(ValueError):
+        h3.breakdown("rows", 10.0, "median", now=1.0)
+
+
+def test_fleet_history_excerpt_is_bounded():
+    h = FleetHistory(keep_sec=1000.0, max_points=500)
+    for t in range(100):
+        h.record("ps0", [("m", {}, float(t))], t=float(t))
+    # inventory form: metric names only
+    assert h.excerpt() == [{"metric": "m"}]
+    ex = h.excerpt("m", window_sec=1000.0, points=8, now=99.0)
+    assert len(ex) == 1
+    e = ex[0]
+    assert e["service"] == "ps0" and e["metric"] == "m"
+    assert len(e["points"]) == 8          # downsampled, not truncated
+    assert e["points"][-1] == [0.0, 99.0]  # newest kept exactly
+    ages = [p[0] for p in e["points"]]
+    assert ages == sorted(ages, reverse=True)  # oldest-first ages
+
+
+# --- sustained()/trend() rule grammar ---------------------------------------
+
+
+def test_sustained_rule_needs_window_coverage_and_no_dip():
+    eng = SloEngine([SloRule("hot", "sustained(load)", ">", 50.0,
+                             window_sec=10.0)])
+    eng.ingest("s", [("load", {}, 100.0)], t=0.0)
+    eng.ingest("s", [("load", {}, 100.0)], t=4.0)
+    # only 4s of a 10s window covered (<80%): answers None, not firing
+    a = [x for x in eng.evaluate(now=4.0) if x["rule"] == "hot"][0]
+    assert a["value"] is None and not a["firing"]
+    eng.ingest("s", [("load", {}, 80.0)], t=8.0)
+    # 8s covered (>=80%): the window extremum under '>' is the MIN
+    a = [x for x in eng.evaluate(now=8.0) if x["rule"] == "hot"][0]
+    assert a["value"] == 80.0 and a["firing"]
+    # one dip kills "sustained" — min drops under the threshold
+    eng.ingest("s", [("load", {}, 30.0)], t=10.0)
+    a = [x for x in eng.evaluate(now=10.0) if x["rule"] == "hot"][0]
+    assert a["value"] == 30.0 and not a["firing"]
+
+
+def test_sustained_under_less_than_uses_the_max():
+    # scale-in shape: fire only when load NEVER ROSE above the floor
+    eng = SloEngine([SloRule("calm", "sustained(load)", "<", 20.0,
+                             window_sec=10.0)])
+    for t, v in ((0.0, 5.0), (4.0, 40.0), (8.0, 5.0)):
+        eng.ingest("s", [("load", {}, v)], t=t)
+    a = [x for x in eng.evaluate(now=8.0) if x["rule"] == "calm"][0]
+    assert a["value"] == 40.0 and not a["firing"]  # one spike blocks
+    for t in (12.0, 16.0, 20.0):
+        eng.ingest("s", [("load", {}, 5.0)], t=t)
+    a = [x for x in eng.evaluate(now=20.0) if x["rule"] == "calm"][0]
+    assert a["value"] == 5.0 and a["firing"]
+
+
+def test_sustained_fleet_scope_sums_services():
+    eng = SloEngine([SloRule("fleet_hot", "sustained(load)", ">", 100.0,
+                             window_sec=10.0, service="^ps",
+                             scope="fleet")])
+    for t in (0.0, 4.0, 8.0):
+        eng.ingest("ps0", [("load", {}, 60.0)], t=t)
+        eng.ingest("ps1", [("load", {}, 60.0)], t=t)
+    a = [x for x in eng.evaluate(now=8.0)
+         if x["rule"] == "fleet_hot"][0]
+    assert a["service"] == "fleet"
+    assert a["value"] == 120.0 and a["firing"]  # summed across replicas
+
+
+def test_trend_rule_slope():
+    eng = SloEngine([SloRule("grow", "trend(depth)", ">", 1.0,
+                             window_sec=100.0)])
+    eng.ingest("s", [("depth", {}, 0.0)], t=0.0)
+    # a single point has no slope: None, not firing
+    a = [x for x in eng.evaluate(now=0.0) if x["rule"] == "grow"][0]
+    assert a["value"] is None and not a["firing"]
+    for t, v in ((2.0, 5.0), (4.0, 10.0), (6.0, 15.0)):
+        eng.ingest("s", [("depth", {}, v)], t=t)
+    a = [x for x in eng.evaluate(now=6.0) if x["rule"] == "grow"][0]
+    assert a["value"] == pytest.approx(2.5) and a["firing"]
+    # plateau: slope decays back under the threshold
+    for t in (8.0, 10.0, 12.0, 14.0, 16.0, 18.0, 20.0):
+        eng.ingest("s", [("depth", {}, 15.0)], t=t)
+    a = [x for x in eng.evaluate(now=20.0) if x["rule"] == "grow"][0]
+    assert a["value"] < 1.0 and not a["firing"]
+
+
+# --- by_label churn (variant drain / re-register / restart) ------------------
+
+
+def test_by_label_churn_drain_reregister_and_restart():
+    """A drained variant must not park stale firing state; the SAME
+    variant re-registered after a serving restart (counters reset) must
+    fire a FRESH breach event — and exactly one, not one per round."""
+    hits = []
+    eng = SloEngine([SloRule("vdeg", "ratio(bad_total, req_total)",
+                             ">", 0.25, window_sec=25.0,
+                             by_label="variant")],
+                    on_breach=hits.append)
+
+    def feed(t, variants):
+        samples = []
+        for name, (bad, req) in variants.items():
+            samples.append(("bad_total", {"variant": name}, bad))
+            samples.append(("req_total", {"variant": name}, req))
+        eng.ingest("serving0", samples, t=t)
+
+    t0 = 1000.0
+    feed(t0, {"default": (0.0, 100.0), "canary": (0.0, 100.0)})
+    feed(t0 + 10, {"default": (1.0, 200.0), "canary": (60.0, 200.0)})
+    alerts = eng.evaluate(now=t0 + 10)
+    firing = {a["service"] for a in alerts if a["firing"]}
+    assert firing == {"serving0[variant=canary]"}
+    assert len(hits) == 1
+    # drain: the canary leaves the exposition entirely. No judgement,
+    # no stale alert row, and the firing state is purged.
+    feed(t0 + 20, {"default": (1.0, 300.0)})
+    alerts = eng.evaluate(now=t0 + 20)
+    assert not [a for a in alerts if "canary" in a["service"]]
+    assert not [k for k in eng._state if "canary" in k[1]]
+    # re-register after a restart: counters RESET to zero, then the
+    # still-broken canary climbs again
+    feed(t0 + 30, {"default": (1.0, 400.0), "canary": (0.0, 0.0)})
+    assert not [a for a in eng.evaluate(now=t0 + 30) if a["firing"]]
+    feed(t0 + 40, {"default": (1.0, 500.0), "canary": (30.0, 100.0)})
+    alerts = eng.evaluate(now=t0 + 40)
+    a = [x for x in alerts
+         if x["service"] == "serving0[variant=canary]"][0]
+    assert a["firing"] and a["value"] == pytest.approx(0.3)
+    # a FRESH breach event — firing_since restarts at the new breach,
+    # it does not inherit the pre-drain episode's clock
+    assert len(hits) == 2
+    assert a["firing_since"] == t0 + 40
+    # still firing next round: no duplicate breach event (no
+    # double-fire from the churn)
+    feed(t0 + 45, {"default": (1.0, 550.0), "canary": (45.0, 150.0)})
+    alerts = eng.evaluate(now=t0 + 45)
+    assert [x for x in alerts
+            if x["service"] == "serving0[variant=canary]"
+            and x["firing"]]
+    assert len(hits) == 2
+
+
+# --- /fleet/history + meta-observability -------------------------------------
+
+
+def test_fleet_history_endpoint_and_meta_metrics():
+    """GET /fleet/history serves the ring (inventory + windowed
+    aggregates + bounded excerpts), the sidecar's own request timings
+    land in obs_http_request_sec, and the monitor times its rounds in
+    fleet_scrape_round_sec."""
+    reg0, a = _mk_sidecar("ps0")
+    reg1, b = _mk_sidecar("ps1")
+    g0 = reg0.gauge("ps_lookup_row_rate")
+    g1 = reg1.gauge("ps_lookup_row_rate")
+    mon = FleetMonitor(targets=[
+        {"service": "ps0", "http_addr": a.addr},
+        {"service": "ps1", "http_addr": b.addr},
+    ])
+    http = mon.serve_http()
+    try:
+        for v in (10.0, 20.0, 30.0):
+            g0.set(v)
+            g1.set(v / 10.0)
+            mon.scrape_once()
+            time.sleep(0.02)
+        # inventory form: the scraped metric names + ring stats
+        inv = json.loads(_get(f"http://{http.addr}/fleet/history"))
+        assert "ps_lookup_row_rate" in inv["metrics"]
+        assert "up" in inv["metrics"]  # synthetic liveness series
+        assert inv["stats"]["n_series"] >= 2
+        # per-metric form: aggregates + breakdown + bounded series
+        doc = json.loads(_get(
+            f"http://{http.addr}/fleet/history"
+            f"?metric=ps_lookup_row_rate&window=60&points=2"))
+        assert doc["max"] == pytest.approx(30.0 + 3.0)  # summed series
+        assert doc["min"] == pytest.approx(10.0 + 1.0)
+        assert doc["breakdown"]["ps0"] == pytest.approx(20.0)
+        assert doc["breakdown"]["ps1"] == pytest.approx(2.0)
+        assert {s["service"] for s in doc["series"]} == {"ps0", "ps1"}
+        assert all(len(s["points"]) <= 2 for s in doc["series"])
+        # ?service= regex narrows every view consistently
+        doc = json.loads(_get(
+            f"http://{http.addr}/fleet/history"
+            f"?metric=ps_lookup_row_rate&service=ps1"))
+        assert doc["max"] == pytest.approx(3.0)
+        assert list(doc["breakdown"]) == ["ps1"]
+        # meta-observability: the sidecar timed its own /metrics GETs…
+        samples, _ = parse_exposition(reg0.render())
+        hist = {(n, tuple(sorted(l.items()))): v for n, l, v in samples}
+        assert hist[("obs_http_request_sec_count",
+                     (("path", "/metrics"),))] >= 3.0
+        # …and the monitor timed its scrape rounds
+        msam, _ = parse_exposition(mon.registry.render())
+        d = {n: v for n, l, v in msam if not l}
+        assert d["fleet_scrape_round_sec_count"] >= 3.0
+        assert d["fleet_scrape_rounds_total"] >= 3.0
+    finally:
+        http.stop()
+        mon.stop()
+        a.stop()
+        b.stop()

@@ -1,0 +1,154 @@
+"""Gate-aware comparator for BENCH_*.json summaries.
+
+Every bench mode writes a machine-readable envelope (``bench.py``'s
+``_write_summary``): ``{mode, captured_at, metric, value, unit,
+gates: {name: {value, op, threshold, pass}}, ...}``. CI runs the smoke
+benches into a scratch directory and this tool diffs each fresh
+summary against the checked-in baseline:
+
+- the fresh run's **gates must all pass** — ``pass`` is recomputed
+  from ``(value, op, threshold)`` here, so a hand-edited ``pass: true``
+  cannot sneak a regression through;
+- **no gate may disappear**: every gate named in the baseline must
+  exist in the fresh summary (dropping a gate is how a regression
+  hides);
+- ``mode`` and ``metric`` must match — a renamed metric is a contract
+  change that needs the baseline updated in the same commit.
+
+Baselines that predate the gated envelope (no ``gates`` key) are
+tolerated with a warning: the fresh file's own gates still judge the
+run. Exit status is the number of failures (0 = green), so CI can wire
+``python -m tools.bench_diff baseline.json fresh.json [more pairs...]``
+directly as a step.
+
+Headline-value drift is reported but NOT gated here: wall-clock
+numbers move with the runner, and the per-mode hard gates inside
+bench.py already encode what "no worse" means for each mode.
+"""
+
+import argparse
+import json
+import sys
+
+_OPS = {
+    ">=": lambda a, b: a >= b,
+    "<=": lambda a, b: a <= b,
+    ">": lambda a, b: a > b,
+    "<": lambda a, b: a < b,
+    "==": lambda a, b: a == b,
+}
+
+
+def _load(path):
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return json.load(f), None
+    except FileNotFoundError:
+        return None, f"missing file: {path}"
+    except (OSError, ValueError) as e:
+        return None, f"unreadable summary {path}: {e}"
+
+
+def _gate_passes(gate):
+    """Recomputed verdict for one gate row; None when the row is too
+    old/odd to judge (tolerated, reported)."""
+    op = gate.get("op")
+    if op not in _OPS or "value" not in gate or "threshold" not in gate:
+        return None
+    try:
+        return bool(_OPS[op](gate["value"], gate["threshold"]))
+    except TypeError:
+        return None
+
+
+def diff_pair(baseline_path, fresh_path):
+    """Compare one (baseline, fresh) summary pair. Returns a list of
+    failure strings (empty = green) and prints the gate table."""
+    failures = []
+    base, err = _load(baseline_path)
+    if err is not None:
+        # a missing baseline is a setup error, not a tolerated legacy
+        # format: the whole point is comparing against what's checked in
+        return [err]
+    fresh, err = _load(fresh_path)
+    if err is not None:
+        return [err]
+
+    for key in ("mode", "metric"):
+        b, f = base.get(key), fresh.get(key)
+        if b is not None and f is not None and b != f:
+            failures.append(
+                f"{key} changed: baseline {b!r} vs fresh {f!r}")
+
+    fresh_gates = fresh.get("gates") or {}
+    base_gates = base.get("gates")
+    if base_gates is None:
+        print(f"  note: baseline {baseline_path} predates the gated "
+              f"envelope; judging fresh gates only")
+        base_gates = {}
+
+    for name in sorted(base_gates):
+        if name not in fresh_gates:
+            failures.append(
+                f"gate {name!r} present in baseline but missing from "
+                f"the fresh run")
+
+    if not fresh_gates:
+        failures.append(
+            f"fresh summary {fresh_path} carries no gates — the bench "
+            f"did not run through _write_summary")
+
+    for name in sorted(fresh_gates):
+        gate = fresh_gates[name]
+        ok = _gate_passes(gate)
+        mark = {True: "ok", False: "FAIL", None: "??"}[ok]
+        base_v = (base_gates.get(name) or {}).get("value")
+        drift = ("" if base_v is None
+                 else f"  (baseline {base_v})")
+        print(f"  [{mark:>4}] {name}: {gate.get('value')} "
+              f"{gate.get('op')} {gate.get('threshold')}{drift}")
+        if ok is False:
+            failures.append(
+                f"gate {name!r} fails: {gate.get('value')} "
+                f"{gate.get('op')} {gate.get('threshold')}")
+        if gate.get("pass") is True and ok is False:
+            failures.append(
+                f"gate {name!r} claims pass=true but recomputes as "
+                f"failing — stale or hand-edited summary")
+
+    bv, fv = base.get("value"), fresh.get("value")
+    if isinstance(bv, (int, float)) and isinstance(fv, (int, float)) \
+            and bv:
+        print(f"  headline {fresh.get('metric')}: {fv} vs baseline "
+              f"{bv} ({(fv / bv - 1) * 100:+.1f}%, informational)")
+    return failures
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="python -m tools.bench_diff",
+        description="gate-aware BENCH_*.json comparator (exit = "
+                    "number of gated regressions)")
+    p.add_argument("pairs", nargs="+",
+                   help="baseline.json fresh.json [baseline fresh ...]")
+    args = p.parse_args(argv)
+    if len(args.pairs) % 2:
+        p.error("paths must come in (baseline, fresh) pairs")
+
+    all_failures = []
+    for i in range(0, len(args.pairs), 2):
+        baseline, fresh = args.pairs[i], args.pairs[i + 1]
+        print(f"bench_diff: {baseline} vs {fresh}")
+        fails = diff_pair(baseline, fresh)
+        for f in fails:
+            print(f"  REGRESSION: {f}")
+        all_failures.extend(fails)
+    if all_failures:
+        print(f"bench_diff: {len(all_failures)} gated regression(s)")
+    else:
+        print("bench_diff: all gates green")
+    return len(all_failures)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
