@@ -978,12 +978,17 @@ def bench_worker_service(batch_size, steps, native_worker, n_ps=2, dim=DIM):
     return steps * batch_size / elapsed
 
 
-def _validate_postmortem(bundle_dir):
+def _validate_postmortem(bundle_dir, health_key="model_manager_status"):
     """Acceptance checks on a crash postmortem bundle: a VALID Chrome
     trace (at least one intact parent->child chain on one trace_id, no
     orphan parents — remote parents were promoted at capture), the
     final health doc, and a parseable last metrics snapshot. Returns a
-    summary dict; raises on violation."""
+    summary dict; raises on violation.
+
+    ``health_key`` is the field that proves the health doc is the real
+    tier-specific one (PS and trainer docs carry
+    ``model_manager_status``; worker docs carry
+    ``forward_buffer_depth``)."""
     from persia_tpu.metrics import parse_exposition
 
     with open(os.path.join(bundle_dir, "trace.json")) as f:
@@ -1008,15 +1013,17 @@ def _validate_postmortem(bundle_dir):
         raise AssertionError(f"trace_id {tid} is not a chain")
     with open(os.path.join(bundle_dir, "health.json")) as f:
         health = json.load(f)
-    if "model_manager_status" not in health:
-        raise AssertionError(f"final health doc incomplete: {health}")
+    if health_key not in health:
+        raise AssertionError(f"final health doc incomplete "
+                             f"(no {health_key!r}): {health}")
     with open(os.path.join(bundle_dir, "metrics.prom")) as f:
         samples, families = parse_exposition(f.read())
     if not samples:
         raise AssertionError("last metrics snapshot is empty")
     return {"spans": len(xs), "chain_trace_id": tid,
             "chain_len": len(chain), "metric_samples": len(samples),
-            "health_status": health.get("model_manager_status")}
+            "health_status": health.get("model_manager_status",
+                                        health.get(health_key))}
 
 
 def bench_chaos(batch_size, steps, n_ps=2, dim=8, kill_replica=1,
@@ -1794,6 +1801,671 @@ def bench_chaos_reshard(batch_size, steps, smoke=False, cells=None):
         f"{detail['total_sec']}s"
         + (f", lease recovery {detail['lease_recovery_sec']}s"
            if detail["lease_recovery_sec"] is not None else ""))
+    return len(results), detail
+
+
+# --- chaos-job matrix (PR 19): whole-job crash safety ------------------------
+
+# trainer cells SIGKILL the supervised trainer driver
+# (persia_tpu.service.trainer_service) at a named point; the ServiceCtx
+# supervisor respawns it, the replacement rolls the WHOLE job back to
+# the newest complete snapshot (PS stores wiped to the snapshot's
+# consistent cut) and replays the deterministic batch stream from the
+# snapshotted cursor — so the per-sign counting identity must come out
+# EXACT, with zero ambiguity. The worker cell kills the embedding-worker
+# tier under a live driving loop: updates acked to the dead worker but
+# not yet confirmed settled on the PS are the DECLARED ambiguity the
+# loss bound is gated against. torn_manifest and during_reshard exercise
+# the snapshot machinery itself; convergence gates resumed-run parity on
+# the zoo DLRM scenario through TrainCtx(resume_from=).
+CHAOS_JOB_FULL = (
+    ("trainer", "mid_step"),
+    ("trainer", "mid_snapshot"),
+    ("trainer", "between_snapshots"),
+    ("trainer", "torn_manifest"),
+    ("worker", "mid_step"),
+    ("snapshot", "during_reshard"),
+    ("trainer", "convergence"),
+)
+CHAOS_JOB_SMOKE = [("trainer", "mid_step")]
+
+# the counting arm every fleet cell uses (zero-init + sgd lr=1 + unit
+# gradients -> row value == -count, elementwise)
+_JOB_ARM = (("bounded_uniform", {"lower": 0.0, "upper": 0.0},
+             1.0, 1e9, False),
+            {"type": "sgd", "lr": 1.0, "wd": 0.0})
+
+
+def _job_expected_counts(pool, seed, steps, bs, n_feats, start=0):
+    """Regenerate the trainer driver's deterministic stream and return
+    the per-sign expected update counts for steps [start, steps)."""
+    from persia_tpu.service.trainer_service import batch_draws
+
+    expected = np.zeros(len(pool), np.int64)
+    for k in range(start, steps):
+        draws = batch_draws(pool, seed, k, bs, n_feats)
+        np.add.at(expected,
+                  np.searchsorted(pool, np.concatenate(draws)), 1)
+    return expected
+
+
+def _job_applied_counts(worker, pool, dim):
+    rows = worker.lookup_signs(pool, dim)
+    return -rows.sum(axis=1) / dim
+
+
+def _job_identity_or_raise(tag, pool, expected, got, tol=1e-3):
+    bad = np.nonzero(np.abs(got - expected) > tol)[0]
+    if len(bad):
+        forensic = [{"sign": int(pool[i]), "expected": int(expected[i]),
+                     "got": round(float(got[i]), 2)} for i in bad[:8]]
+        raise RuntimeError(
+            f"[{tag}] counting identity broken on {len(bad)} signs "
+            f"(expected {int(expected.sum())} total updates, applied "
+            f"{got.sum():.1f}); first: {forensic}")
+
+
+def _chaos_job_trainer_cell(kind, bs, smoke=False):
+    """One trainer-kill cell: supervised driver subprocess killed at
+    ``kind`` (mid_step / mid_snapshot / between_snapshots), supervisor
+    respawn, whole-job rollback + deterministic replay. Gates: the
+    driver finishes (exit 0) through the kill, at least one recovery
+    with a valid postmortem bundle, the replacement actually RESUMED
+    from a snapshot (mid_snapshot must have fallen back past the torn
+    one), the counting identity is exact, and retention kept at most
+    PERSIA_SNAPSHOT_KEEP complete snapshots."""
+    import tempfile
+
+    from persia_tpu import snapshot as _snapmod
+    from persia_tpu.config import EmbeddingSchema, uniform_slots
+    from persia_tpu.service.helper import ServiceCtx
+    from persia_tpu.service.trainer_service import sign_pool
+
+    dim, n_feats, seed, pool_size = 8, 2, 3, 2048
+    steps = 12 if smoke else 20
+    interval = 4
+    bs_t = min(bs, 64)
+    # mid_step / between_snapshots kill BETWEEN cadence boundaries (one
+    # complete snapshot behind them); mid_snapshot kills INSIDE the
+    # second snapshot so a complete fallback exists behind the torn one
+    die_step = 2 * interval if kind == "mid_snapshot" else interval + 2
+    schema = EmbeddingSchema(slots_config=uniform_slots(
+        [f"slot_{i}" for i in range(n_feats)], dim=dim))
+    tmp = tempfile.mkdtemp(prefix="persia_chaos_job_")
+    snap_dir = os.path.join(tmp, "snapshots")
+    pm_dir = os.path.join(tmp, "postmortems")
+    result_file = os.path.join(tmp, "result.json")
+    trainer_args = [
+        "--num-workers", "1", "--steps", str(steps),
+        "--batch-size", str(bs_t), "--n-feats", str(n_feats),
+        "--seed", str(seed), "--pool-size", str(pool_size),
+        "--snapshot-interval", str(interval),
+        "--die-at", kind, "--die-step", str(die_step),
+        "--result-file", result_file,
+        # slow the loop so flight-recorder polls land before the kill
+        "--step-delay", "0.15"]
+    with ServiceCtx(schema, n_workers=1, n_ps=2,
+                    supervise_trainer=True, trainer_args=trainer_args,
+                    snapshot_dir=snap_dir, postmortem_dir=pm_dir,
+                    flight_interval=0.3,
+                    env={"PERSIA_TRACING": "1"}) as svc:
+        rc = svc.wait_trainer_done(timeout=240.0)
+        if rc != 0:
+            raise RuntimeError(f"[trainer:{kind}] driver never finished "
+                               f"(rc={rc}, recoveries="
+                               f"{svc.trainer_recoveries})")
+        events = list(svc.trainer_recoveries)
+        if not events:
+            raise RuntimeError(f"[trainer:{kind}] the kill never fired "
+                               f"— zero trainer recoveries recorded")
+        bundle = events[0].get("postmortem")
+        if not bundle or not os.path.isdir(bundle):
+            raise RuntimeError(f"[trainer:{kind}] no postmortem bundle "
+                               f"for the killed trainer: {events[0]}")
+        pm = _validate_postmortem(bundle)
+        with open(result_file) as f:
+            result = json.load(f)
+        if result["steps"] != steps:
+            raise RuntimeError(f"[trainer:{kind}] driver finished at "
+                               f"step {result['steps']}, wanted {steps}")
+        if not result.get("resumed_from"):
+            raise RuntimeError(f"[trainer:{kind}] replacement driver "
+                               f"did not resume from a snapshot")
+        if (kind == "mid_snapshot"
+                and result["resumed_from"] != "snap_000000"):
+            raise RuntimeError(
+                f"[trainer:mid_snapshot] resumed from "
+                f"{result['resumed_from']!r} — the torn snapshot was "
+                f"not refused with fallback to snap_000000 (the "
+                f"complete one behind the torn snap_000001)")
+        pool = sign_pool(pool_size)
+        expected = _job_expected_counts(pool, seed, steps, bs_t, n_feats)
+        got = _job_applied_counts(svc.remote_worker(), pool, dim)
+        _job_identity_or_raise(f"trainer:{kind}", pool, expected, got)
+        complete = []
+        for p in _snapmod.list_snapshots(snap_dir):
+            try:
+                _snapmod.load_manifest(p)
+                complete.append(p)
+            except _snapmod.SnapshotError:
+                pass
+        from persia_tpu import knobs as _knobs
+
+        keep = int(_knobs.get("PERSIA_SNAPSHOT_KEEP"))
+        if not complete or len(complete) > keep:
+            raise RuntimeError(
+                f"[trainer:{kind}] retention broken: "
+                f"{len(complete)} complete snapshots on disk, "
+                f"keep={keep}")
+        return {
+            "actor": "trainer", "state": kind,
+            "recoveries": len(events),
+            "resumed_from": result["resumed_from"],
+            "acked": int(expected.sum()),
+            "applied": round(float(got.sum()), 1),
+            "ambiguous_elems": 0,  # rollback+replay: exact by design
+            "snapshots_kept": len(complete),
+            "postmortem_spans": pm["spans"],
+        }
+
+
+def _chaos_job_torn_cell(bs, smoke=False):
+    """Torn-manifest refusal + fallback + rollback exactness, driven
+    through the public snapshot API against a live (unsupervised)
+    fleet: corrupt the newest snapshot's payload, assert verification
+    refuses it, latest_snapshot falls back to the previous complete
+    one, and restoring that fallback rolls the PS stores back to its
+    exact cut (post-snapshot updates wiped)."""
+    import tempfile
+
+    from persia_tpu import snapshot as _snapmod
+    from persia_tpu.config import EmbeddingSchema, uniform_slots
+    from persia_tpu.data.batch import IDTypeFeature
+    from persia_tpu.service.helper import ServiceCtx
+    from persia_tpu.service.trainer_service import batch_draws, sign_pool
+
+    dim, n_feats, seed = 8, 2, 11
+    bs_t = min(bs, 64)
+    schema = EmbeddingSchema(slots_config=uniform_slots(
+        [f"slot_{i}" for i in range(n_feats)], dim=dim))
+    tmp = tempfile.mkdtemp(prefix="persia_chaos_job_torn_")
+    snap_dir = os.path.join(tmp, "snapshots")
+    pool = sign_pool(2048)
+    with ServiceCtx(schema, n_workers=1, n_ps=2) as svc:
+        w = svc.remote_worker()
+        w.configure_parameter_servers(*_JOB_ARM[0])
+        w.register_optimizer(_JOB_ARM[1])
+
+        def train(k0, k1):
+            for k in range(k0, k1):
+                draws = batch_draws(pool, seed, k, bs_t, n_feats)
+                feats = [IDTypeFeature(f"slot_{i}", [d])
+                         for i, d in enumerate(draws)]
+                ref, out = w.lookup_direct_training(feats)
+                w.update_gradients(ref, {
+                    k2: np.ones_like(v.embeddings)
+                    for k2, v in out.items()})
+
+        train(0, 4)
+        snap1 = _snapmod.snapshot_job(
+            snap_dir, w, cursor={"seed": seed, "consumed": 4}, step=4)
+        exp_at_snap1 = _job_expected_counts(pool, seed, 4, bs_t, n_feats)
+        train(4, 8)
+        snap2 = _snapmod.snapshot_job(
+            snap_dir, w, cursor={"seed": seed, "consumed": 8}, step=8)
+        # tear the newest snapshot: truncate a manifest-listed payload
+        victim = sorted((_snapmod.load_manifest(snap2))["files"])[0]
+        with open(os.path.join(snap2, victim), "wb") as f:
+            f.write(b"torn")
+        try:
+            _snapmod.load_manifest(snap2)
+            raise RuntimeError("[trainer:torn_manifest] checksum "
+                               "verification ACCEPTED a torn snapshot")
+        except _snapmod.SnapshotError:
+            pass
+        # a manifest-less dir newer than everything must also be skipped
+        os.makedirs(os.path.join(snap_dir, "snap_000099"))
+        found = _snapmod.latest_snapshot(snap_dir)
+        if found is None or os.path.basename(found[0]) != \
+                os.path.basename(snap1):
+            raise RuntimeError(
+                f"[trainer:torn_manifest] fallback selection failed: "
+                f"latest_snapshot -> {found and found[0]}")
+        _snapmod.restore_job(found[0], w)
+        got = _job_applied_counts(w, pool, dim)
+        _job_identity_or_raise("trainer:torn_manifest", pool,
+                               exp_at_snap1, got)
+        return {
+            "actor": "trainer", "state": "torn_manifest",
+            "fallback_to": os.path.basename(found[0]),
+            "acked": int(exp_at_snap1.sum()),
+            "applied": round(float(got.sum()), 1),
+            "ambiguous_elems": 0,
+        }
+
+
+def _chaos_job_worker_cell(bs, smoke=False):
+    """Worker-tier SIGKILL under a live driving loop. Workers are
+    stateless past their in-flight update queue, so the job does NOT
+    roll back — the supervisor respawns the replica under the same
+    coordinator index and the loop re-resolves. The ledger splits
+    acked updates into CONFIRMED (a later worker.staleness == 0 poll
+    proved them applied on the PS) and pending; gates:
+
+    - confirmed-at-kill updates are NEVER lost (elementwise);
+    - total loss is bounded by the DECLARED ambiguity (acked-but-
+      unconfirmed at kill + failed cycles) — never silent;
+    - over-application is bounded by the failed cycles (client retries
+      against a fresh dedup cache are at-least-once);
+    - the killed worker leaves a valid postmortem bundle (worker
+      health doc: ``forward_buffer_depth``)."""
+    import tempfile
+    import threading
+
+    from persia_tpu import tracing as _tracing
+    from persia_tpu.config import EmbeddingSchema, uniform_slots
+    from persia_tpu.data.batch import IDTypeFeature
+    from persia_tpu.service.helper import ServiceCtx
+    from persia_tpu.service.trainer_service import sign_pool
+    from persia_tpu.service.worker_service import RemoteEmbeddingWorker
+
+    dim, n_feats = 8, 2
+    bs_t = min(bs, 64)
+    schema = EmbeddingSchema(slots_config=uniform_slots(
+        [f"slot_{i}" for i in range(n_feats)], dim=dim))
+    tmp = tempfile.mkdtemp(prefix="persia_chaos_job_worker_")
+    pm_dir = os.path.join(tmp, "postmortems")
+    pool = sign_pool(4096)
+    _tracing.enable_tracing(True)
+    try:
+        with ServiceCtx(schema, n_workers=1, n_ps=2,
+                        supervise_workers=True, postmortem_dir=pm_dir,
+                        flight_interval=0.3,
+                        env={"PERSIA_TRACING": "1"}) as svc:
+
+            def mk_worker():
+                w = RemoteEmbeddingWorker(list(svc.worker_addrs))
+                w.configure_parameter_servers(*_JOB_ARM[0])
+                w.register_optimizer(_JOB_ARM[1])
+                return w
+
+            worker_box = [mk_worker()]
+            a_lock = threading.Lock()
+            stop = threading.Event()
+            expected = np.zeros(len(pool), np.int64)   # every acked cycle
+            confirmed = np.zeros(len(pool), np.int64)  # settled on the PS
+            acked = [0]
+            settled = [0]
+            pending = []   # (elems, idx) acked, settlement unconfirmed
+            failures = []  # elems per failed cycle
+
+            def train():
+                rng = np.random.default_rng(5)
+                while not stop.is_set():
+                    draws = [rng.choice(pool, size=bs_t)
+                             for _ in range(n_feats)]
+                    feats = [IDTypeFeature(f"slot_{i}", [d])
+                             for i, d in enumerate(draws)]
+                    idx = np.searchsorted(pool, np.concatenate(draws))
+                    # the WHOLE cycle (RPC + ledger) runs under the
+                    # lock; the killer takes the same lock, so a kill
+                    # never lands between an ack and its bookkeeping
+                    with a_lock:
+                        if stop.is_set():
+                            return
+                        w = worker_box[0]
+                        try:
+                            r, o = w.lookup_direct_training(feats)
+                            w.update_gradients(r, {
+                                k: np.ones_like(v.embeddings)
+                                for k, v in o.items()})
+                        except Exception:  # noqa: BLE001
+                            failures.append(n_feats * bs_t)
+                            worker_box[0] = None
+                        else:
+                            acked[0] += n_feats * bs_t
+                            np.add.at(expected, idx, 1)
+                            pending.append((n_feats * bs_t, idx))
+                            try:
+                                if w.staleness == 0:
+                                    for e, pidx in pending:
+                                        settled[0] += e
+                                        np.add.at(confirmed, pidx, 1)
+                                    pending.clear()
+                            except Exception:  # noqa: BLE001
+                                pass  # unconfirmed cycles stay pending
+                    if worker_box[0] is None:
+                        time.sleep(0.25)
+                        try:
+                            worker_box[0] = mk_worker()
+                        except Exception:  # noqa: BLE001
+                            worker_box[0] = None
+                    time.sleep(0.01)
+
+            t = threading.Thread(target=train)
+            t.start()
+            # let flight polls land (0.3s cadence) before the kill
+            time.sleep(1.2)
+            with a_lock:
+                acked_k = acked[0]
+                settled_k = settled[0]
+                confirmed_k = confirmed.copy()
+                p = svc.worker_proc(0)
+                log(f"chaos-job [worker:mid_step]: SIGKILL worker-0 "
+                    f"(pid {p.pid})")
+                p.kill()
+            events = svc.wait_worker_recoveries(1, timeout=90)
+            ev = events[0]
+            if "failed" in ev:
+                raise RuntimeError(f"worker recovery failed: {ev}")
+            time.sleep(1.0 if smoke else 2.0)  # train past the recovery
+            stop.set()
+            t.join(timeout=120)
+            # final settle: everything acked to the REPLACEMENT worker
+            # must drain to the PS before the ledger is read
+            w = worker_box[0] or mk_worker()
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                try:
+                    if w.staleness == 0:
+                        break
+                except Exception:  # noqa: BLE001
+                    pass
+                time.sleep(0.1)
+            got = _job_applied_counts(w, pool, dim)
+            fail_elems = int(sum(failures))
+            declared = (acked_k - settled_k) + fail_elems
+            # 1) confirmed-durable updates survive the kill, per sign
+            short = np.nonzero(confirmed_k - got > 1e-3)[0]
+            if len(short):
+                raise RuntimeError(
+                    f"[worker:mid_step] {len(short)} signs lost updates "
+                    f"that were CONFIRMED settled before the kill")
+            lost = float(expected.sum()) - float(got.sum())
+            # 2) loss bounded by the declared in-flight ambiguity
+            if lost > declared + 1e-3:
+                raise RuntimeError(
+                    f"[worker:mid_step] lost {lost:.1f} updates > "
+                    f"declared ambiguity {declared} (acked@kill="
+                    f"{acked_k}, settled@kill={settled_k}, "
+                    f"failed={fail_elems})")
+            # 3) over-application bounded by retried/failed cycles
+            if -lost > fail_elems + 1e-3:
+                raise RuntimeError(
+                    f"[worker:mid_step] over-applied {-lost:.1f} beyond "
+                    f"the {fail_elems} failed-cycle elements")
+            if len(failures) > 60:
+                raise RuntimeError(
+                    f"[worker:mid_step] {len(failures)} cycles failed — "
+                    f"recovery is not transparent")
+            bundle = ev.get("postmortem")
+            if not bundle or not os.path.isdir(bundle):
+                raise RuntimeError(
+                    f"[worker:mid_step] no postmortem bundle: {ev}")
+            pm = _validate_postmortem(bundle,
+                                      health_key="forward_buffer_depth")
+            return {
+                "actor": "worker", "state": "mid_step",
+                "detection_sec": None,
+                "recovery_sec": ev.get("recovery_sec"),
+                "acked": int(expected.sum()),
+                "applied": round(float(got.sum()), 1),
+                "lost": round(lost, 1),
+                "ambiguous_elems": int(declared),
+                "failed_cycles": len(failures),
+                "postmortem_spans": pm["spans"],
+            }
+    finally:
+        _tracing.enable_tracing(False)
+
+
+def _chaos_job_reshard_snapshot_cell(bs, smoke=False):
+    """Snapshot taken WHILE a live reshard migrates rows: the barrier +
+    dump-time routing stamp must make the restore consistent even onto
+    the post-reshard topology. An in-process counting loop trains
+    through a 2->3 reshard; the controller's phase hook takes a job
+    snapshot during the copy phase (driving loop quiesced, so the
+    expected cut is exact); after the migration completes and more
+    training lands, restoring that snapshot must roll the 3-replica
+    fleet back to the exact mid-reshard cut."""
+    import tempfile
+    import threading
+
+    from persia_tpu import snapshot as _snapmod
+    from persia_tpu.config import EmbeddingSchema, uniform_slots
+    from persia_tpu.data.batch import IDTypeFeature
+    from persia_tpu.reshard import ReshardController
+    from persia_tpu.routing import RoutingTable
+    from persia_tpu.service.helper import ServiceCtx
+    from persia_tpu.service.ps_service import PsClient
+    from persia_tpu.service.trainer_service import sign_pool
+    from persia_tpu.worker.worker import EmbeddingWorker
+
+    dim, n_feats = 8, 2
+    bs_t = min(bs, 64)
+    schema = EmbeddingSchema(slots_config=uniform_slots(
+        [f"slot_{i}" for i in range(n_feats)], dim=dim))
+    tmp = tempfile.mkdtemp(prefix="persia_chaos_job_resnap_")
+    snap_dir = os.path.join(tmp, "snapshots")
+    journal = os.path.join(tmp, "journal")
+    pool = sign_pool(4096)
+    with ServiceCtx(schema, n_workers=0, n_ps=3) as svc:
+        clients = [PsClient(a) for a in svc.ps_addrs]
+        for c in clients:
+            c.configure(*_JOB_ARM[0])
+            c.register_optimizer(_JOB_ARM[1])
+        table = RoutingTable.uniform(2)
+        worker = EmbeddingWorker(schema, clients[:2], routing=table)
+        a_lock = threading.Lock()
+        stop = threading.Event()
+        expected = np.zeros(len(pool), np.int64)
+        snap_cut = {}
+
+        def train():
+            rng = np.random.default_rng(9)
+            while not stop.is_set():
+                draws = [rng.choice(pool, size=bs_t)
+                         for _ in range(n_feats)]
+                feats = [IDTypeFeature(f"slot_{i}", [d])
+                         for i, d in enumerate(draws)]
+                idx = np.searchsorted(pool, np.concatenate(draws))
+                with a_lock:  # full cycle under the lock: the snapshot
+                    if stop.is_set():  # hook sees no half-acked cycles
+                        return
+                    r, o = worker.lookup_direct_training(feats)
+                    worker.update_gradients(r, {
+                        k: np.ones_like(v.embeddings)
+                        for k, v in o.items()})
+                    np.add.at(expected, idx, 1)
+                time.sleep(0.005)
+
+        def phase_hook(st, **kw):
+            if st != "copy" or snap_cut:
+                return
+            with a_lock:
+                snap_cut["path"] = _snapmod.snapshot_job(
+                    snap_dir, worker,
+                    cursor={"seed": 9, "consumed": -1},
+                    step=0)
+                snap_cut["expected"] = expected.copy()
+                snap_cut["epoch"] = worker.routing_epoch
+
+        t = threading.Thread(target=train)
+        t.start()
+        try:
+            ctrl = ReshardController(
+                clients, table, workers=[worker], journal_dir=journal,
+                drain_sec=0.25, replay_settle_rows=64,
+                phase_hook=phase_hook)
+            new_table = ctrl.reshard_to(3)
+            ctrl.finalize(drain_sec=0.3)
+            time.sleep(0.3 if smoke else 0.8)  # post-reshard training
+        finally:
+            stop.set()
+            t.join(timeout=120)
+        if "path" not in snap_cut:
+            raise RuntimeError("[snapshot:during_reshard] the copy-phase "
+                               "hook never fired — no snapshot taken")
+        manifest = _snapmod.load_manifest(snap_cut["path"])
+        if manifest.get("routing_epoch") != snap_cut["epoch"]:
+            raise RuntimeError(
+                f"[snapshot:during_reshard] manifest stamped epoch "
+                f"{manifest.get('routing_epoch')}, live epoch at the "
+                f"cut was {snap_cut['epoch']}")
+        if worker.routing_epoch != new_table.epoch:
+            raise RuntimeError(
+                f"[snapshot:during_reshard] reshard did not complete: "
+                f"worker on epoch {worker.routing_epoch}")
+        # restore the MID-RESHARD snapshot onto the POST-reshard fleet
+        _snapmod.restore_job(snap_cut["path"], worker)
+        got = _job_applied_counts(worker, pool, dim)
+        _job_identity_or_raise("snapshot:during_reshard", pool,
+                               snap_cut["expected"], got)
+        worker.close()
+        return {
+            "actor": "snapshot", "state": "during_reshard",
+            "acked": int(snap_cut["expected"].sum()),
+            "applied": round(float(got.sum()), 1),
+            "ambiguous_elems": 0,
+            "snapshot_epoch": snap_cut["epoch"],
+            "final_epoch": new_table.epoch,
+            "manifest_shards": manifest.get("num_shards"),
+        }
+
+
+def _chaos_job_convergence_cell(smoke=False):
+    """Resumed-run convergence parity on the zoo DLRM scenario through
+    the full TrainCtx path: a baseline run trains N steps straight; a
+    crashed run trains N/2 steps, takes a job snapshot (dense model +
+    optimizer state, sparse stores, cursor) and is discarded; a THIRD
+    stack — fresh, empty — resumes via TrainCtx(resume_from=) and
+    trains the remaining batches from the snapshotted cursor. Both the
+    per-step losses of the replayed suffix and the final dense
+    parameters must match the baseline (deterministic CPU training:
+    the rollback is exact, so divergence means the snapshot lost or
+    corrupted state). Held-out AUC must match the baseline's too."""
+    import itertools
+    import tempfile
+
+    import jax
+
+    from persia_tpu.workloads import evaluate_auc, get_scenario
+
+    sc = get_scenario("dlrm", smoke=True)
+    bs = sc.bench_batch_size
+    n_steps = 60 if smoke else 120
+    half = n_steps // 2
+    tmp = tempfile.mkdtemp(prefix="persia_chaos_job_conv_")
+    snap_dir = os.path.join(tmp, "snapshots")
+
+    def run(start=0, stop_at=None, resume_from=None):
+        ctx, worker, holders = _e2e_stack(sc, resume_from=resume_from)
+        losses = []
+        with ctx:
+            batches = itertools.islice(
+                sc.batches(n_steps * bs, bs), start, stop_at)
+            loss = None
+            for b in batches:
+                loss, _ = ctx.train_step(b)
+                losses.append(float(loss))
+            jax.block_until_ready(loss)
+            if stop_at is not None:  # the to-be-"crashed" run
+                ctx.snapshot(snap_dir,
+                             cursor={"seed": sc.seed, "consumed": stop_at})
+                worker.close()
+                return losses, None, None
+            aucs = evaluate_auc(ctx, sc, num_samples=2048,
+                                batch_size=min(bs, 512))
+            params = jax.device_get(ctx.state.params)
+        worker.close()
+        return losses, aucs, params
+
+    base_losses, base_aucs, base_params = run()
+    run(stop_at=half)  # crashes here; only its snapshot survives
+    from persia_tpu import snapshot as _snapmod
+    found = _snapmod.latest_snapshot(snap_dir)
+    if found is None:
+        raise RuntimeError("[trainer:convergence] mid-run snapshot "
+                           "missing")
+    start = int((found[1].get("cursor") or {}).get("consumed", 0))
+    if start != half:
+        raise RuntimeError(f"[trainer:convergence] snapshot cursor "
+                           f"{start}, wanted {half}")
+    res_losses, res_aucs, res_params = run(start=start,
+                                           resume_from=snap_dir)
+    suffix = base_losses[half:]
+    dl = float(np.max(np.abs(np.array(suffix) - np.array(res_losses))))
+    if dl > 1e-5:
+        raise RuntimeError(
+            f"[trainer:convergence] replayed-suffix losses diverged "
+            f"from the baseline (max |delta| {dl:.2e}) — the resumed "
+            f"job is not the same job")
+    leaves_a = jax.tree_util.tree_leaves(base_params)
+    leaves_b = jax.tree_util.tree_leaves(res_params)
+    dp = max(float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
+             for a, b in zip(leaves_a, leaves_b))
+    if dp > 1e-5:
+        raise RuntimeError(
+            f"[trainer:convergence] final dense parameters diverged "
+            f"(max |delta| {dp:.2e})")
+    da = max(abs(base_aucs[k] - res_aucs[k]) for k in base_aucs)
+    if da > 1e-6:
+        raise RuntimeError(
+            f"[trainer:convergence] held-out AUC diverged: baseline "
+            f"{base_aucs}, resumed {res_aucs}")
+    return {
+        "actor": "trainer", "state": "convergence",
+        "scenario": "dlrm", "steps": n_steps, "resumed_at": half,
+        "loss_suffix_max_delta": dl,
+        "dense_param_max_delta": dp,
+        "auc_baseline": {k: round(v, 4) for k, v in base_aucs.items()},
+        "auc_resumed": {k: round(v, 4) for k, v in res_aucs.items()},
+    }
+
+
+def bench_chaos_job(batch_size, steps, smoke=False, cells=None):
+    """The whole-job crash-safety matrix (`--mode chaos`): SIGKILL the
+    trainer and worker tiers at snapshot-protocol-relevant points and
+    hard-gate, per cell, that the coordinated-snapshot + resume path
+    (persia_tpu/snapshot.py) restores a consistent job: lost updates
+    are zero for rollback-covered kills and bounded by the DECLARED
+    in-flight ambiguity otherwise, torn snapshots are refused with
+    fallback, snapshots taken during a live reshard restore onto the
+    new topology, and a resumed DLRM run converges identically to an
+    unbroken baseline."""
+    bs = min(batch_size, 128) if smoke else min(batch_size, 256)
+    plan = cells if cells else (CHAOS_JOB_SMOKE if smoke
+                                else CHAOS_JOB_FULL)
+    results = []
+    t_start = time.perf_counter()
+    for actor, state in plan:
+        log(f"chaos-job: cell {actor}:{state} "
+            f"({len(results) + 1}/{len(plan)})")
+        t0 = time.perf_counter()
+        if actor == "trainer" and state == "torn_manifest":
+            cell = _chaos_job_torn_cell(bs, smoke=smoke)
+        elif actor == "trainer" and state == "convergence":
+            cell = _chaos_job_convergence_cell(smoke=smoke)
+        elif actor == "trainer":
+            cell = _chaos_job_trainer_cell(state, bs, smoke=smoke)
+        elif actor == "worker":
+            cell = _chaos_job_worker_cell(bs, smoke=smoke)
+        elif actor == "snapshot":
+            cell = _chaos_job_reshard_snapshot_cell(bs, smoke=smoke)
+        else:
+            raise ValueError(f"unknown chaos-job actor {actor!r}")
+        cell["cell_sec"] = round(time.perf_counter() - t0, 1)
+        results.append(cell)
+        log(f"chaos-job: cell {actor}:{state} GREEN in "
+            f"{cell['cell_sec']}s")
+    detail = {
+        "cells": results,
+        "cells_green": len(results),
+        "cells_total": len(plan),
+        "total_sec": round(time.perf_counter() - t_start, 1),
+    }
+    log(f"chaos-job: {len(results)}/{len(plan)} cells green in "
+        f"{detail['total_sec']}s")
     return len(results), detail
 
 
@@ -3672,11 +4344,12 @@ def bench_tier(batch_size, steps, n_ps=2, smoke=False):
 E2E_PLANNER_TOL = 0.20  # |predicted - measured| device hit rate, points
 
 
-def _e2e_stack(scenario, n_ps=2, hotness=False):
+def _e2e_stack(scenario, n_ps=2, hotness=False, resume_from=None):
     """One in-process hybrid stack (holders + worker + ctx) for a zoo
     scenario. Optimizers are the zoo's calibrated pair (adam dense,
     Adagrad(0.1) sparse) — every scenario's convergence gate was tuned
-    against them."""
+    against them. ``resume_from`` hands the ctx a job snapshot to roll
+    the (fresh, empty) stack back onto."""
     import optax
 
     from persia_tpu.ctx import TrainCtx
@@ -3697,6 +4370,7 @@ def _e2e_stack(scenario, n_ps=2, hotness=False):
         embedding_config=EmbeddingConfig(emb_initialization=(-0.05, 0.05)),
         loss_fn=scenario.loss_fn,
         seed=scenario.seed,
+        resume_from=resume_from,
     )
     return ctx, worker, holders
 
@@ -5808,6 +6482,21 @@ def main():
                    help="chaos mode: skip the PR-4 kill/recovery bench "
                         "and run only the reshard kill matrix (the CI "
                         "smoke lane)")
+    p.add_argument("--chaos-job-out",
+                   default=os.path.join(
+                       os.path.dirname(os.path.abspath(__file__)),
+                       "BENCH_chaos_job.json"),
+                   help="chaos mode: per-cell whole-job crash-safety "
+                        "matrix summary path")
+    p.add_argument("--chaos-job-cells", default=None,
+                   help="chaos mode: restrict the whole-job kill matrix "
+                        "to these actor:state cells (comma-joined, e.g. "
+                        "'trainer:mid_step,worker:mid_step'); default "
+                        "is the full matrix (smoke: trainer:mid_step)")
+    p.add_argument("--chaos-job-only", action="store_true",
+                   help="chaos mode: run only the whole-job kill matrix "
+                        "(skip the PR-4 bench and the reshard matrix) — "
+                        "the CI trainer-kill smoke lane")
     p.add_argument("--clients", type=int, default=8,
                    help="infer mode: concurrent closed-loop clients")
     p.add_argument("--entries", type=int, default=10_000_000,
@@ -5963,7 +6652,7 @@ def main():
                 "simd": detail.get("simd", {}),
             })
     elif args.mode == "chaos":
-        if args.chaos_reshard_only:
+        if args.chaos_reshard_only or args.chaos_job_only:
             value, detail = 0.0, {}
         else:
             value, detail = bench_chaos(
@@ -5978,26 +6667,54 @@ def main():
         # reshard actor×state kill matrix (PR 12): each cell hard-gates
         # inside; the machine-readable per-cell results land next to
         # the other BENCH_*.json captures
-        cells = None
-        if args.chaos_cells:
-            cells = [tuple(c.split(":", 1))
-                     for c in args.chaos_cells.split(",") if c]
-        _green, reshard_detail = bench_chaos_reshard(
-            min(args.batch_size, 256) if args.smoke else args.batch_size,
-            max(args.steps, 5), smoke=args.smoke, cells=cells)
-        extra["chaos_reshard"] = reshard_detail
-        _write_summary(
-            args.chaos_reshard_out, "chaos_reshard",
-            "chaos_reshard_cells_green",
-            reshard_detail["cells_green"], "cells",
-            gates={
-                "cells_green": _gate_entry(
-                    reshard_detail["cells_green"], ">=",
-                    reshard_detail["cells_total"]),
-            },
-            detail=reshard_detail)
-        if args.chaos_reshard_only:
-            value = float(reshard_detail["cells_green"])
+        if not args.chaos_job_only:
+            cells = None
+            if args.chaos_cells:
+                cells = [tuple(c.split(":", 1))
+                         for c in args.chaos_cells.split(",") if c]
+            _green, reshard_detail = bench_chaos_reshard(
+                min(args.batch_size, 256) if args.smoke
+                else args.batch_size,
+                max(args.steps, 5), smoke=args.smoke, cells=cells)
+            extra["chaos_reshard"] = reshard_detail
+            _write_summary(
+                args.chaos_reshard_out, "chaos_reshard",
+                "chaos_reshard_cells_green",
+                reshard_detail["cells_green"], "cells",
+                gates={
+                    "cells_green": _gate_entry(
+                        reshard_detail["cells_green"], ">=",
+                        reshard_detail["cells_total"]),
+                },
+                detail=reshard_detail)
+            if args.chaos_reshard_only:
+                value = float(reshard_detail["cells_green"])
+        # whole-job crash-safety matrix (PR 19): trainer/worker kill
+        # cells around the coordinated-snapshot + resume protocol;
+        # every cell hard-gates inside
+        if not args.chaos_reshard_only:
+            job_cells = None
+            if args.chaos_job_cells:
+                job_cells = [tuple(c.split(":", 1))
+                             for c in args.chaos_job_cells.split(",")
+                             if c]
+            _jgreen, job_detail = bench_chaos_job(
+                min(args.batch_size, 256) if args.smoke
+                else args.batch_size,
+                max(args.steps, 5), smoke=args.smoke, cells=job_cells)
+            extra["chaos_job"] = job_detail
+            _write_summary(
+                args.chaos_job_out, "chaos_job",
+                "chaos_job_cells_green",
+                job_detail["cells_green"], "cells",
+                gates={
+                    "cells_green": _gate_entry(
+                        job_detail["cells_green"], ">=",
+                        job_detail["cells_total"]),
+                },
+                detail=job_detail)
+            if args.chaos_job_only:
+                value = float(job_detail["cells_green"])
     elif args.mode == "telemetry":
         value, inflation_pct, detail = bench_telemetry(
             min(args.batch_size, 512) if args.smoke else args.batch_size,

@@ -229,6 +229,24 @@ def _install(ps_clients, signs, entries, routing=None):
 # --- ctx-level checkpoint (dense + sparse) -------------------------------
 
 
+def dense_state_bytes(state) -> bytes:
+    """Flax TrainState (model params + dense optimizer state) as msgpack
+    bytes — the single dense serializer both the plain checkpoint and
+    the job-snapshot protocol (persia_tpu/snapshot.py) write through."""
+    from flax import serialization
+
+    return serialization.to_bytes(state)
+
+
+def apply_dense_bytes(state, data: bytes):
+    """Inverse of :func:`dense_state_bytes`: returns ``state`` with the
+    serialized leaves installed (the template's pytree structure must
+    match the dump's — same model + optimizer construction)."""
+    from flax import serialization
+
+    return serialization.from_bytes(state, data)
+
+
 def dump_checkpoint(ctx, dst_dir: str, with_dense: bool = True):
     """Full job checkpoint (reference: persia/ctx.py:471-495, 1007-1034).
 
@@ -237,10 +255,8 @@ def dump_checkpoint(ctx, dst_dir: str, with_dense: bool = True):
     os.makedirs(dst_dir, exist_ok=True)
     ctx.worker.dump(dst_dir)
     if with_dense and getattr(ctx, "state", None) is not None:
-        from flax import serialization
-
         with open(os.path.join(dst_dir, DENSE_FILE), "wb") as f:
-            f.write(serialization.to_bytes(ctx.state))
+            f.write(dense_state_bytes(ctx.state))
 
 
 def load_checkpoint(ctx, src_dir: str, with_dense: bool = True):
@@ -252,7 +268,5 @@ def load_checkpoint(ctx, src_dir: str, with_dense: bool = True):
                 "dense state not initialized; run one train_step (or build "
                 "the state) before loading a dense checkpoint into it"
             )
-        from flax import serialization
-
         with open(dense_path, "rb") as f:
-            ctx.state = serialization.from_bytes(ctx.state, f.read())
+            ctx.state = apply_dense_bytes(ctx.state, f.read())

@@ -204,6 +204,7 @@ class TrainCtx(EmbeddingCtx):
         device_cache_capacity: int = 0,
         device_cache_admission: Optional[str] = None,
         profiler=None,
+        resume_from: Optional[str] = None,
     ):
         super().__init__(model=model, schema=schema, worker=worker,
                          embedding_config=embedding_config,
@@ -246,6 +247,22 @@ class TrainCtx(EmbeddingCtx):
         self.profiler = (profiler if profiler is not None
                          else _tracing.profiler_from_env())
         self._step_count = 0
+        # --- whole-job resume (persia_tpu/snapshot.py) -----------------
+        # `resume_from` names one snapshot directory or a snapshot_dir
+        # parent (newest complete wins). Resolution + verification
+        # happen HERE so a torn/absent snapshot fails at construction,
+        # not mid-__enter__; the sparse rollback runs on __enter__ and
+        # the dense bytes install lazily once the TrainState exists.
+        self.resume_manifest: Optional[dict] = None
+        self.resume_cursor: Optional[dict] = None
+        self._resume_snap: Optional[str] = None
+        self._pending_dense: Optional[bytes] = None
+        if resume_from:
+            from persia_tpu import snapshot as _snapshot
+
+            self._resume_snap, self.resume_manifest = (
+                _snapshot.resolve_snapshot(resume_from))
+            self.resume_cursor = _snapshot.load_cursor(self._resume_snap)
 
     def __enter__(self):
         super().__enter__()
@@ -253,7 +270,38 @@ class TrainCtx(EmbeddingCtx):
             self.embedding_optimizer.apply()
         if self._cache_engine is not None:
             self._cache_engine.ensure_open()  # re-entry after __exit__
+        if self._resume_snap is not None:
+            self._restore_from_snapshot()
         return self
+
+    def _restore_from_snapshot(self):
+        """Roll the job back to the resolved snapshot: PS stores wiped
+        to the snapshot's consistent cut (post-snapshot updates are
+        re-derived by replaying the deterministic batch stream from
+        ``resume_cursor``), dense bytes staged for lazy install, step
+        counter restored. Runs once; re-entering the ctx later must
+        not re-wipe live training progress."""
+        from persia_tpu import snapshot as _snapshot
+
+        snap, self._resume_snap = self._resume_snap, None
+        if self._cache_engine is not None:
+            self._cache_engine.invalidate()  # cached rows predate restore
+        self.worker.load(snap)
+        self._pending_dense = _snapshot.dense_bytes(snap)
+        self._step_count = int(self.resume_manifest.get("step", 0))
+
+    def snapshot(self, snapshot_dir: str, cursor: Optional[dict] = None,
+                 inc_dir: Optional[str] = None,
+                 keep: Optional[int] = None) -> str:
+        """Take one coordinated job snapshot (persia_tpu/snapshot.py):
+        device cache flushed, backward pipeline drained, then sparse +
+        dense + cursor captured as one manifest-stamped unit."""
+        from persia_tpu import snapshot as _snapshot
+
+        self.flush_device_cache()
+        return _snapshot.snapshot_job(
+            snapshot_dir, self.worker, state=self.state, cursor=cursor,
+            inc_dir=inc_dir, step=self._step_count, keep=keep)
 
     def _wire_dtype(self):
         return (
@@ -291,6 +339,14 @@ class TrainCtx(EmbeddingCtx):
                 non_id, emb_inputs,
             )
             self._eval_step = make_eval_step(self.model)
+        if self._pending_dense is not None:
+            # snapshot resume: install the dumped model + optimizer
+            # leaves into the freshly built (template) TrainState
+            from persia_tpu import checkpoint as _ckpt
+
+            self.state = _ckpt.apply_dense_bytes(self.state,
+                                                 self._pending_dense)
+            self._pending_dense = None
         if self._train_step is None or emb_shapes != self._emb_shapes:
             # (re)build the packed step for this batch geometry; jit caches
             # by shape so alternating geometries stay cheap
@@ -605,6 +661,12 @@ class TrainCtx(EmbeddingCtx):
             from persia_tpu.parallel.train import make_eval_step
 
             self._eval_step = make_eval_step(self.model)
+        if self._pending_dense is not None:
+            from persia_tpu import checkpoint as _ckpt
+
+            self.state = _ckpt.apply_dense_bytes(self.state,
+                                                 self._pending_dense)
+            self._pending_dense = None
 
     def _cached_train_step(self, batch: PersiaBatch):
         self._ensure_cache(batch)

@@ -67,6 +67,57 @@ class IterableDataset(IterableDatasetBase):
             yield item
 
 
+class ResumableDataset(IterableDatasetBase):
+    """Deterministic, cursor-tracked dataset — the data leg of the
+    whole-job snapshot protocol (persia_tpu/snapshot.py).
+
+    ``factory(seed)`` must return a FRESH batch iterator that is a pure
+    function of the seed (the workload-zoo generators are: same seed →
+    byte-identical stream). The dataset skips the first ``start``
+    batches — batches a previous incarnation of the job already
+    trained — and counts every batch it hands out, so
+    :meth:`cursor` names an exact position in the stream that a
+    restarted process reproduces from nothing but ``{seed, consumed}``.
+
+    The cursor is keyed to TRAINED batches, not produced ones: the
+    prefetch pipeline runs ahead of the optimizer, so at snapshot time
+    the trainer passes the number of batches it has fully stepped
+    (``cursor(trained=...)``); resume re-yields everything past that
+    point, including batches that were sitting in the pipeline when
+    the process died.
+    """
+
+    def __init__(self, factory, seed: int = 0, start: int = 0,
+                 buffer_size: int = 128):
+        super().__init__(buffer_size)
+        self.factory = factory
+        self.seed = int(seed)
+        self.start = int(start)
+        self.produced = 0  # batches handed out by THIS incarnation
+
+    def cursor(self, trained: Optional[int] = None) -> Dict[str, int]:
+        """Snapshot cursor. ``trained`` = batches fully stepped this
+        incarnation; defaults to every batch handed out (exact only
+        when nothing runs ahead of the consumer)."""
+        n = self.produced if trained is None else int(trained)
+        return {"seed": self.seed, "consumed": self.start + n}
+
+    @classmethod
+    def from_cursor(cls, factory, cursor: Dict[str, int],
+                    buffer_size: int = 128) -> "ResumableDataset":
+        return cls(factory, seed=cursor["seed"], start=cursor["consumed"],
+                   buffer_size=buffer_size)
+
+    def __iter__(self) -> Iterator[PersiaBatch]:
+        import itertools
+
+        it = itertools.islice(iter(self.factory(self.seed)),
+                              self.start, None)
+        for batch in it:
+            self.produced += 1
+            yield batch
+
+
 class StreamingDataset(IterableDatasetBase):
     """Binds the dataflow receiver: batches pushed by remote data-loader
     processes over the message queue (reference: data.py:97-138).

@@ -133,6 +133,14 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
        "overrides JAX_PLATFORMS via sitecustomize)."),
     _k("PERSIA_FORCE_PYTHON_MW", "bool", False,
        "Skip the native middleware kernels and use the numpy twins."),
+    _k("PERSIA_FSYNC", "bool", True,
+       "Durability of storage.PersiaPath.write_bytes_atomic on local "
+       "paths: fsync the tmp file before the rename and the parent "
+       "directory after it, so a machine crash cannot lose a record "
+       "the caller was told is durable (migration journals, snapshot "
+       "manifests, inc-packet markers). `0` trades that guarantee for "
+       "write latency — process crashes are still safe, host/power "
+       "crashes are not."),
     _k("PERSIA_HOTNESS", "bool", False,
        "Workload telemetry: arm per-table hotness sketches "
        "(Space-Saving top-K + count-min + HLL, per internal shard) on "
@@ -307,6 +315,19 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
        "Skip PersiaBatch input validation (shape/dtype checks) on the "
        "data-loader hot path. Read at call time — setting it after "
        "import works (the old import-time freeze was a bug)."),
+    _k("PERSIA_SNAPSHOT_INTERVAL_STEPS", "int", 50,
+       "Default cadence (train steps) between coordinated job "
+       "snapshots taken by the supervised trainer driver "
+       "(persia_tpu.service.trainer_service). The interval is the "
+       "recovery budget: a trainer SIGKILL loses at most this many "
+       "steps of dense+sparse progress, all of which the resume path "
+       "replays deterministically from the snapshotted data cursor."),
+    _k("PERSIA_SNAPSHOT_KEEP", "int", 3,
+       "Retention of the job-snapshot GC (persia_tpu/snapshot.py): "
+       "the newest K COMPLETE snapshots survive; older completes and "
+       "any torn/manifest-less debris older than the newest complete "
+       "are removed after each successful snapshot. Keep >= 2 so a "
+       "torn newest snapshot always has a fallback."),
     _k("PERSIA_TEST_TPU", "bool", False,
        "Run the TPU-gated hardware-validation tests (pytest conftest "
        "arms a per-test watchdog instead of skipping them)."),

@@ -62,6 +62,7 @@ connections — trainers and workers, not end users).
 """
 
 import os
+import random
 import select
 import socket
 import struct
@@ -380,7 +381,13 @@ class CircuitBreaker:
     def _probe_loop(self):
         """Background recovery watch: while the breaker is open, poll
         the probe; the first success arms the half-open trial slot
-        immediately (no need to wait out the cooldown)."""
+        immediately (no need to wait out the cooldown). The inter-probe
+        sleep is decorrelated-jittered: after a supervised PS restart,
+        every client in the fleet opens its breaker at the same instant,
+        and a fixed cadence would land all N recovery probes (and the
+        trial calls they arm) on the reborn replica in synchronized
+        waves."""
+        delay = self.probe_interval
         while True:
             with self._lock:
                 if self._state != "open":
@@ -395,7 +402,70 @@ class CircuitBreaker:
                         self._state = "half_open"
                         self._trial_inflight = False
                 return
-            _time.sleep(self.probe_interval)
+            delay = decorrelated_jitter(self.probe_interval,
+                                        8 * self.probe_interval, delay)
+            self._sleep(delay)
+
+    # injectable for fake-clock tests
+    _sleep = staticmethod(_time.sleep)
+
+
+def decorrelated_jitter(base: float, cap: float, prev: float,
+                        rand: Optional[Callable[[], float]] = None
+                        ) -> float:
+    """Next backoff delay, AWS-style "decorrelated jitter":
+    ``min(cap, uniform(base, max(base, prev * 3)))``. Unlike plain
+    exponential backoff (deterministic, so N clients that failed
+    together retry together, forever), each client's delay is drawn
+    from a widening window — reconnect storms de-synchronize within a
+    round or two. ``rand`` is injectable for deterministic tests."""
+    r = (rand or random.random)()
+    hi = max(float(base), float(prev) * 3.0)
+    return min(float(cap), float(base) + r * (hi - float(base)))
+
+
+class RetryBudget:
+    """Per-client token bucket bounding transport retries: ``capacity``
+    tokens burst, refilled at ``refill_per_sec``. Each retry SLEEP
+    spends one token; an empty bucket stops the ladder immediately
+    (the call surfaces its transport error instead of sleeping). The
+    point is storm control — during a long PS outage, N workers x M
+    threads x unbounded ladders otherwise wake in lockstep and hammer
+    the reborn replica; with a budget, each client's retry pressure is
+    capped at ``refill_per_sec`` regardless of caller count. The
+    defaults are generous (single-call ladders never notice them).
+    ``clock`` is injectable for fake-clock tests. Thread-safe."""
+
+    def __init__(self, capacity: float = 64.0,
+                 refill_per_sec: float = 8.0,
+                 clock: Optional[Callable[[], float]] = None):
+        self.capacity = float(capacity)
+        self.refill_per_sec = float(refill_per_sec)
+        self._clock = clock or _time.monotonic
+        self._tokens = self.capacity
+        self._stamp = self._clock()
+        self._lock = threading.Lock()
+
+    def _refill_locked(self):
+        now = self._clock()
+        self._tokens = min(self.capacity, self._tokens
+                           + (now - self._stamp) * self.refill_per_sec)
+        self._stamp = now
+
+    def acquire(self, n: float = 1.0) -> bool:
+        """Spend ``n`` tokens; False == budget exhausted, stop retrying."""
+        with self._lock:
+            self._refill_locked()
+            if self._tokens < n:
+                return False
+            self._tokens -= n
+            return True
+
+    @property
+    def tokens(self) -> float:
+        with self._lock:
+            self._refill_locked()
+            return self._tokens
 
 
 def tcp_probe(addr: str, timeout: float = 1.0) -> Callable[[], bool]:
@@ -1218,13 +1288,23 @@ class RpcClient:
                  deadline: Optional[float] = None,
                  enable_deadline: Optional[bool] = None,
                  enable_codec: bool = False,
-                 enable_routing: bool = False):
+                 enable_routing: bool = False,
+                 retry_budget: Optional[RetryBudget] = None):
         self.addr = addr
         host, port = addr.rsplit(":", 1)
         self._target = (host, int(port))
         self.timeout = timeout
         self.max_retries = max_retries
         self.retry_backoff = retry_backoff
+        # storm control on the transport retry ladder: delays are
+        # decorrelated-jittered (see decorrelated_jitter) and retry
+        # sleeps spend from a per-client token bucket, so N clients
+        # that lost the same replica neither wake in lockstep nor
+        # retry unboundedly against the reborn process
+        self.retry_budget = (retry_budget if retry_budget is not None
+                             else RetryBudget())
+        self._retry_rand: Callable[[], float] = random.random
+        self._retry_sleep: Callable[[float], None] = _time.sleep
         self.enable_tags = enable_tags
         # opt-in payload codec (PsClient turns it on for its
         # mixed-precision wire): probes __codec__ at dial; legacy
@@ -1533,12 +1613,13 @@ class RpcClient:
                 try:
                     cs = self._dial()
                 except (ConnectionError, OSError) as e:
-                    if attempts_left <= 0:
+                    if attempts_left <= 0 or not self.retry_budget.acquire():
                         raise _typed_transport_error(e, self.addr,
                                                      method) from e
                     attempts_left -= 1
-                    time.sleep(delay)
-                    delay = min(delay * 2, 5.0)
+                    delay = decorrelated_jitter(self.retry_backoff, 5.0,
+                                                delay, self._retry_rand)
+                    self._retry_sleep(delay)
                     continue
             others_inflight = bool(cs.outstanding)
             try:
@@ -1569,12 +1650,13 @@ class RpcClient:
                                                  method) from e
                 if not fresh:
                     continue  # stale pooled socket: redial once, no sleep
-                if attempts_left <= 0:
+                if attempts_left <= 0 or not self.retry_budget.acquire():
                     raise _typed_transport_error(e, self.addr,
                                                  method) from e
                 attempts_left -= 1
-                time.sleep(delay)
-                delay = min(delay * 2, 5.0)
+                delay = decorrelated_jitter(self.retry_backoff, 5.0,
+                                            delay, self._retry_rand)
+                self._retry_sleep(delay)
         if env[0] != "ok":
             raise _typed_call_error(self.addr, method, env[1])
         return result

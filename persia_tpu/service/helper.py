@@ -104,6 +104,12 @@ class ServiceCtx:
         postmortem_dir: Optional[str] = None,
         flight_interval: float = 1.0,
         http_all: bool = False,
+        supervise_workers: bool = False,
+        worker_max_restarts: int = 5,
+        supervise_trainer: bool = False,
+        trainer_args: Optional[List[str]] = None,
+        trainer_max_restarts: int = 5,
+        snapshot_dir: Optional[str] = None,
     ):
         self.schema = schema
         self.n_workers = n_workers
@@ -161,6 +167,40 @@ class ServiceCtx:
         # the sidecar address to the coordinator, so fleet_targets()
         # sees the whole topology
         self.http_all = http_all
+        # --- whole-job crash safety (persia_tpu/snapshot.py) -----------
+        # supervise_workers: a worker replica that dies is respawned
+        # with the same replica index (workers are stateless past their
+        # forward buffer; the respawn re-registers with the coordinator
+        # under the same index, replacing the dead address). The
+        # trainer drives the data/dense side: with supervise_trainer,
+        # ``trainer_args`` launches persia_tpu.service.trainer_service
+        # (--coordinator/--snapshot-dir appended here); a nonzero exit
+        # respawns it and the reborn driver resumes from the newest
+        # complete snapshot under ``snapshot_dir``; exit 0 == run done.
+        if supervise_workers and native_worker:
+            raise ValueError("supervise_workers drives the Python worker "
+                             "binary; native workers restart at the k8s "
+                             "level")
+        self.supervise_workers = supervise_workers
+        self.worker_max_restarts = worker_max_restarts
+        self.supervise_trainer = supervise_trainer
+        self.trainer_args = list(trainer_args or [])
+        self.trainer_max_restarts = trainer_max_restarts
+        self.snapshot_dir = snapshot_dir
+        self.worker_recoveries: List[dict] = []
+        self.trainer_recoveries: List[dict] = []
+        self.trainer_done = False
+        self.trainer_rc: Optional[int] = None
+        self._worker_restarts: dict = {}
+        self._worker_incarnation: dict = {}
+        self._worker_args: dict = {}
+        self._trainer_restarts = 0
+        self._trainer_incarnation = 0
+        # generic sidecar flight polling beyond the PS tier:
+        # name -> addr file; cached addrs + last-poll stamps
+        self._flight_files: dict = {}
+        self._flight_addr: dict = {}
+        self._flight_last: dict = {}
 
     def _spawn(self, args: List[str], name: str, replica_index: int,
                replica_size: int) -> subprocess.Popen:
@@ -246,17 +286,7 @@ class ServiceCtx:
                             str(gc.embedding_worker.buffered_data_expired_sec)]
                 self._spawn_raw(cmd, f"worker-{i}", i, self.n_workers)
                 continue
-            args = ["-m", "persia_tpu.service.worker_service",
-                    "--replica-index", str(i),
-                    "--replica-size", str(self.n_workers),
-                    "--coordinator", self.coordinator_addr,
-                    "--embedding-config", schema_path,
-                    "--num-ps", str(self.n_ps)]
-            if self.global_config_path:
-                args += ["--global-config", self.global_config_path]
-            if self.http_all:
-                args += ["--http-port", "0"]
-            self._spawn(args, f"worker-{i}", i, self.n_workers)
+            self._spawn_worker(i, schema_path)
 
         try:
             self.ps_addrs = coord.wait_members(ROLE_PS, self.n_ps,
@@ -266,12 +296,65 @@ class ServiceCtx:
         except TimeoutError:
             self.__exit__(None, None, None)
             raise
+        if self.supervise_trainer:
+            self._spawn_trainer()
         self._monitor = threading.Thread(target=self._watch, daemon=True,
                                          name="service-ctx-monitor")
         self._monitor.start()
         _logger.info("cluster up: coordinator=%s ps=%s workers=%s",
                      self.coordinator_addr, self.ps_addrs, self.worker_addrs)
         return self
+
+    def _spawn_worker(self, i: int, schema_path: str) -> subprocess.Popen:
+        """Spawn (or, under supervise_workers, respawn) Python worker
+        replica ``i``. Supervised workers carry a sidecar addr-file so
+        the flight-poll loop can cache their last observable state for
+        postmortems."""
+        args = ["-m", "persia_tpu.service.worker_service",
+                "--replica-index", str(i),
+                "--replica-size", str(self.n_workers),
+                "--coordinator", self.coordinator_addr,
+                "--embedding-config", schema_path,
+                "--num-ps", str(self.n_ps)]
+        if self.global_config_path:
+            args += ["--global-config", self.global_config_path]
+        self._worker_args[i] = list(args)
+        if self.supervise_workers:
+            inc = self._worker_incarnation[i] = (
+                self._worker_incarnation.get(i, 0) + 1)
+            http_file = os.path.join(self._tmpdir.name,
+                                     f"worker_{i}_{inc}.http")
+            self._arm_flight(f"worker{i}", http_file)
+            args = args + ["--http-port", "0",
+                           "--http-addr-file", http_file]
+        elif self.http_all:
+            args = args + ["--http-port", "0"]
+        proc = self._spawn(args, f"worker-{i}", i, self.n_workers)
+        proc._persia_worker = i  # type: ignore[attr-defined]
+        return proc
+
+    def _spawn_trainer(self) -> subprocess.Popen:
+        """Spawn (or respawn) the supervised trainer driver. The driver
+        itself owns resume: on start it rolls the job back to the
+        newest complete snapshot under --snapshot-dir and replays the
+        deterministic batch stream from the snapshotted cursor."""
+        self._trainer_incarnation += 1
+        args = ["-m", "persia_tpu.service.trainer_service",
+                "--coordinator", self.coordinator_addr,
+                *self.trainer_args]
+        if self.snapshot_dir:
+            args += ["--snapshot-dir", self.snapshot_dir]
+        http_file = os.path.join(self._tmpdir.name,
+                                 f"trainer_{self._trainer_incarnation}.http")
+        self._arm_flight("trainer", http_file)
+        args += ["--http-port", "0", "--http-addr-file", http_file]
+        proc = self._spawn(args, "trainer", 0, 1)
+        proc._persia_trainer = True  # type: ignore[attr-defined]
+        return proc
+
+    def _arm_flight(self, name: str, http_file: str):
+        self._flight_files[name] = http_file
+        self._flight_addr.pop(name, None)
 
     def _spawn_ps(self, i: int, restore: bool = False) -> subprocess.Popen:
         """Spawn (or respawn) Python PS replica ``i``. Supervised
@@ -321,19 +404,41 @@ class ServiceCtx:
                 if getattr(p, "_persia_handled", False):
                     continue
                 rc = p.poll()
-                if rc is not None and rc != 0 and not self._closing:
-                    name = getattr(p, "_persia_name", "?")
-                    if (getattr(p, "_persia_supervised", False)
-                            and self._restarts_left(p._persia_replica)):
-                        self._recover_ps(p, f"exited rc={rc}")
+                if rc is None or self._closing:
+                    continue
+                name = getattr(p, "_persia_name", "?")
+                if getattr(p, "_persia_trainer", False):
+                    if rc == 0:
+                        # the driver finished its run: not a crash
+                        p._persia_handled = True  # type: ignore
+                        self.trainer_done = True
+                        self.trainer_rc = 0
                         continue
-                    self.crashed.append(f"{name} rc={rc}")
-                    _logger.error("service %s crashed (rc=%d); tearing down",
-                                  name, rc)
-                    self._terminate_all()
-                    return
+                    if self._trainer_restarts < self.trainer_max_restarts:
+                        self._recover_trainer(p, rc)
+                        continue
+                    self.trainer_rc = rc
+                elif rc == 0:
+                    continue
+                elif (getattr(p, "_persia_supervised", False)
+                        and self._restarts_left(p._persia_replica)):
+                    self._recover_ps(p, f"exited rc={rc}")
+                    continue
+                elif (self.supervise_workers
+                        and getattr(p, "_persia_worker", None) is not None
+                        and self._worker_restarts.get(
+                            p._persia_worker, 0) < self.worker_max_restarts):
+                    self._recover_worker(p, rc)
+                    continue
+                self.crashed.append(f"{name} rc={rc}")
+                _logger.error("service %s crashed (rc=%d); tearing down",
+                              name, rc)
+                self._terminate_all()
+                return
             if self.supervise_ps and not self._closing:
                 self._probe_ps_sidecars()
+            if self._flight_files and not self._closing:
+                self._poll_flights()
             time.sleep(0.2)
 
     def _restarts_left(self, i: int) -> bool:
@@ -414,6 +519,193 @@ class ServiceCtx:
             self.flight_recorder.observe(f"ps{i}", doc)
         except Exception as e:
             _logger.debug("flight fetch for ps%d failed: %s", i, e)
+
+    def _poll_flights(self):
+        """Flight polling for the non-PS supervised tiers (trainer,
+        workers): cache each sidecar's /flight snapshot so a SIGKILLed
+        process still leaves its final observable state behind for the
+        postmortem bundle."""
+        if self.flight_recorder is None:
+            return
+        import urllib.request
+
+        now = time.monotonic()
+        for name, path in list(self._flight_files.items()):
+            last = self._flight_last.get(name)
+            if last is not None and now - last < self.flight_interval:
+                continue
+            addr = self._flight_addr.get(name)
+            if addr is None:
+                if not os.path.exists(path):
+                    continue
+                with open(path) as f:
+                    addr = f.read().strip()
+                if not addr:
+                    continue
+                self._flight_addr[name] = addr
+            try:
+                with urllib.request.urlopen(
+                        f"http://{addr}/flight", timeout=2.0) as r:
+                    doc = json.loads(r.read().decode())
+                self._flight_last[name] = now
+                self.flight_recorder.observe(name, doc)
+            except Exception as e:
+                _logger.debug("flight fetch for %s failed: %s", name, e)
+
+    def _capture_postmortem(self, name: str, reason: str,
+                            extra: Optional[dict] = None) -> Optional[str]:
+        if self.flight_recorder is None:
+            return None
+        try:
+            return self.flight_recorder.capture(name, reason,
+                                                extra=extra or {})
+        except Exception:
+            _logger.exception("postmortem capture for %s failed", name)
+            return None
+
+    def _recover_trainer(self, proc: subprocess.Popen, rc: int):
+        """Respawn the dead trainer driver. The replacement resumes
+        from the newest complete snapshot on its own; this side only
+        records the event (+ postmortem from the last cached /flight
+        snapshot) and relaunches."""
+        proc._persia_handled = True  # type: ignore[attr-defined]
+        self._trainer_restarts += 1
+        event = {"reason": f"exited rc={rc}",
+                 "t_detected": time.monotonic(),
+                 "restart_no": self._trainer_restarts}
+        _logger.error("supervised trainer died (rc=%s); restarting (%d/%d)",
+                      rc, self._trainer_restarts, self.trainer_max_restarts)
+        bundle = self._capture_postmortem(
+            "trainer", f"crash:rc={rc}",
+            extra={"restart_no": self._trainer_restarts})
+        if bundle:
+            event["postmortem"] = bundle
+        self._spawn_trainer()
+        event["t_respawned"] = time.monotonic()
+        self.trainer_recoveries.append(event)
+
+    def _recover_worker(self, proc: subprocess.Popen, rc: int):
+        """Respawn a dead worker replica with the same index. Workers
+        are stateless past their forward buffer (in-flight batches are
+        the declared ambiguity the chaos gates account for); the
+        respawn re-registers with the coordinator under the same index,
+        replacing the dead address, and trainers re-resolve through
+        the coordinator. Recovered == the coordinator shows a NEW
+        address for the index."""
+        i = proc._persia_worker
+        proc._persia_handled = True  # type: ignore[attr-defined]
+        self._worker_restarts[i] = self._worker_restarts.get(i, 0) + 1
+        old_addr = (self.worker_addrs[i]
+                    if i < len(self.worker_addrs) else None)
+        event = {"replica": i, "reason": f"exited rc={rc}",
+                 "t_detected": time.monotonic(),
+                 "restart_no": self._worker_restarts[i]}
+        _logger.error("supervised worker %d died (rc=%s); restarting "
+                      "(%d/%d)", i, rc, self._worker_restarts[i],
+                      self.worker_max_restarts)
+        bundle = self._capture_postmortem(
+            f"worker{i}", f"crash:rc={rc}",
+            extra={"restart_no": self._worker_restarts[i]})
+        if bundle:
+            event["postmortem"] = bundle
+        schema_args = self._worker_args[i]
+        # rebuild via the stored args (schema_path etc. are in there)
+        proc2 = self._spawn_worker_from_args(i, schema_args)
+        coord = CoordinatorClient(self.coordinator_addr)
+        deadline = time.monotonic() + self.startup_timeout
+        new_addr = None
+        while time.monotonic() < deadline and not self._closing:
+            if proc2.poll() is not None:
+                event["failed"] = f"respawn exited rc={proc2.poll()}"
+                self.worker_recoveries.append(event)
+                return
+            try:
+                addrs = coord.list(ROLE_WORKER)
+            except Exception:
+                addrs = []
+            if i < len(addrs) and addrs[i] != old_addr:
+                new_addr = addrs[i]
+                break
+            time.sleep(0.05)
+        if new_addr is None:
+            event["failed"] = "replacement never re-registered"
+            self.worker_recoveries.append(event)
+            _logger.error("worker %d recovery FAILED: replacement never "
+                          "re-registered within %.0fs", i,
+                          self.startup_timeout)
+            return
+        if i < len(self.worker_addrs):
+            self.worker_addrs[i] = new_addr
+        event["addr"] = new_addr
+        event["t_recovered"] = time.monotonic()
+        event["recovery_sec"] = round(
+            event["t_recovered"] - event["t_detected"], 3)
+        self.worker_recoveries.append(event)
+        _logger.warning("worker %d recovered in %.2fs at %s", i,
+                        event["recovery_sec"], new_addr)
+
+    def _spawn_worker_from_args(self, i: int, base_args: List[str]
+                                ) -> subprocess.Popen:
+        args = list(base_args)
+        if self.supervise_workers:
+            inc = self._worker_incarnation[i] = (
+                self._worker_incarnation.get(i, 0) + 1)
+            http_file = os.path.join(self._tmpdir.name,
+                                     f"worker_{i}_{inc}.http")
+            self._arm_flight(f"worker{i}", http_file)
+            args += ["--http-port", "0", "--http-addr-file", http_file]
+        proc = self._spawn(args, f"worker-{i}", i, self.n_workers)
+        proc._persia_worker = i  # type: ignore[attr-defined]
+        return proc
+
+    def trainer_proc(self) -> Optional[subprocess.Popen]:
+        """The LIVE trainer driver subprocess (chaos cells SIGKILL it;
+        after a recovery this returns the replacement)."""
+        for p in reversed(self.procs):
+            if (getattr(p, "_persia_trainer", False)
+                    and not getattr(p, "_persia_handled", False)
+                    and p.poll() is None):
+                return p
+        return None
+
+    def worker_proc(self, i: int) -> Optional[subprocess.Popen]:
+        """The LIVE subprocess currently serving worker replica ``i``."""
+        for p in reversed(self.procs):
+            if (getattr(p, "_persia_worker", None) == i
+                    and not getattr(p, "_persia_handled", False)
+                    and p.poll() is None):
+                return p
+        return None
+
+    def wait_trainer_done(self, timeout: float = 300.0) -> int:
+        """Block until the supervised trainer driver finishes its run
+        (exit 0) — through any number of kill/respawn cycles — or the
+        supervision gave up (max restarts / teardown). Returns the
+        final exit code."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.trainer_done:
+                return 0
+            if self.trainer_rc not in (None, 0):
+                return self.trainer_rc
+            if self.crashed:
+                raise RuntimeError(f"cluster crashed: {self.crashed}")
+            time.sleep(0.05)
+        raise TimeoutError(f"trainer not done after {timeout}s "
+                           f"(restarts={self._trainer_restarts})")
+
+    def wait_worker_recoveries(self, n: int, timeout: float = 60.0
+                               ) -> List[dict]:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            done = [e for e in self.worker_recoveries
+                    if "t_recovered" in e or "failed" in e]
+            if len(done) >= n:
+                return done
+            time.sleep(0.05)
+        raise TimeoutError(
+            f"waited {timeout}s for {n} worker recoveries, have "
+            f"{self.worker_recoveries}")
 
     def _recover_ps(self, proc: subprocess.Popen, reason: str):
         """Restart a dead supervised PS replica and record the recovery

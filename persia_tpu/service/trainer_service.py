@@ -1,0 +1,205 @@
+"""Supervised trainer driver — the nn-worker leg of whole-job crash
+safety (reference: persia/e2e trainer entrypoints; chaos harness in
+bench.py --mode chaos).
+
+This binary is what ``ServiceCtx(supervise_trainer=True)`` respawns
+after a trainer SIGKILL. It runs the counting workload the chaos cells
+gate on (zero-init embeddings + sgd lr=1 + unit gradients, so the
+per-sign identity ``applied == -count`` holds elementwise), takes
+coordinated job snapshots every ``--snapshot-interval`` steps via
+:func:`persia_tpu.snapshot.snapshot_job`, and on start resumes from the
+newest COMPLETE snapshot: roll the PS stores back to the snapshot
+(``worker.load`` wipes post-snapshot updates), then replay the
+deterministic batch stream from the saved cursor. Every batch is a pure
+function of ``(seed, step)``, so replay re-derives the wiped updates
+exactly once and the counting identity stays EXACT across any number of
+kills.
+
+Chaos injection (``--die-at``) SIGKILLs this process at a named point:
+
+* ``mid_step``          — between lookup and gradient update
+* ``mid_snapshot``      — inside snapshot_job, after payloads, before
+                          the manifest (leaves a torn snapshot the
+                          resume path must refuse and fall back past)
+* ``between_snapshots`` — at a step boundary away from the cadence
+
+A marker file under the snapshot dir makes each kill fire exactly once
+across incarnations. On completing ``--steps`` the driver writes
+``--result-file`` atomically and exits 0 (supervisor treats that as
+done, not a crash).
+"""
+
+import argparse
+import json
+import os
+import signal
+import time
+
+import numpy as np
+
+from persia_tpu import knobs, obs_http, tracing
+from persia_tpu import snapshot as _snapshot
+from persia_tpu.data.batch import IDTypeFeature
+from persia_tpu.data.dataloader import ResumableDataset
+from persia_tpu.logger import get_default_logger
+from persia_tpu.service.coordinator import ROLE_WORKER, CoordinatorClient
+from persia_tpu.service.worker_service import RemoteEmbeddingWorker
+from persia_tpu.storage import PersiaPath
+
+_logger = get_default_logger(__name__)
+
+# Counting arm: zero-init + sgd lr=1 + unit grads -> row == -count.
+ARM_INIT = ("bounded_uniform", {"lower": 0.0, "upper": 0.0}, 1.0, 1e9, False)
+ARM_OPT = {"type": "sgd", "lr": 1.0, "wd": 0.0}
+
+DIE_POINTS = ("none", "mid_step", "mid_snapshot", "between_snapshots")
+
+
+def sign_pool(pool_size: int) -> np.ndarray:
+    """The fixed sign universe every incarnation draws from — identical
+    to the chaos harness's ledger pool so the bench can regenerate the
+    exact expected per-sign counts."""
+    return np.unique(np.random.default_rng(7).integers(
+        0, 1 << 40, pool_size, dtype=np.uint64))
+
+
+def batch_draws(pool: np.ndarray, seed: int, step: int,
+                batch_size: int, n_feats: int):
+    """Batch ``step`` of the stream — a pure function of (seed, step)."""
+    rng = np.random.default_rng([seed, step])
+    return [rng.choice(pool, size=batch_size) for _ in range(n_feats)]
+
+
+def _die_now():
+    # SIGKILL, not sys.exit: the point is an unclean death the
+    # supervisor must detect and recover from
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="persia_tpu chaos trainer driver")
+    p.add_argument("--coordinator", required=True)
+    p.add_argument("--num-workers", type=int, default=1)
+    p.add_argument("--snapshot-dir", default=None)
+    p.add_argument("--snapshot-interval", type=int,
+                   default=knobs.get("PERSIA_SNAPSHOT_INTERVAL_STEPS"))
+    p.add_argument("--steps", type=int, default=40)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--n-feats", type=int, default=2)
+    p.add_argument("--seed", type=int, default=3)
+    p.add_argument("--pool-size", type=int, default=8192)
+    p.add_argument("--die-at", choices=DIE_POINTS, default="none")
+    p.add_argument("--die-step", type=int, default=-1)
+    p.add_argument("--result-file", default=None)
+    p.add_argument("--step-delay", type=float, default=0.0)
+    obs_http.add_http_args(p)
+    args = p.parse_args(argv)
+
+    tracing.set_service_name("trainer")
+    status = {"model_manager_status": "Initializing", "step": 0,
+              "resumed_from": None}
+
+    def health_fn():
+        return dict(status, service="trainer")
+
+    http = obs_http.maybe_start("127.0.0.1", obs_http.port_from_args(args),
+                                health_fn)
+    obs_http.write_addr_file_from_args(http, args)
+
+    coord = CoordinatorClient(args.coordinator)
+    addrs = coord.wait_members(ROLE_WORKER, args.num_workers, timeout=120)
+    worker = RemoteEmbeddingWorker(addrs)
+    # arm BEFORE the readiness wait: a PS is not "serving" until it is
+    # configured and has an optimizer
+    worker.configure_parameter_servers(*ARM_INIT)
+    worker.register_optimizer(ARM_OPT)
+    worker.wait_for_serving(timeout=120)
+
+    pool = sign_pool(args.pool_size)
+    die_step = args.die_step
+    die_marker = None
+    die_at = args.die_at
+    if args.snapshot_dir and die_at != "none":
+        die_marker = os.path.join(
+            args.snapshot_dir, f".die_{die_at}_{die_step}")
+        if os.path.exists(die_marker):
+            die_at = "none"  # this kill already fired in a past life
+
+    def arm_kill():
+        # marker BEFORE the kill: if we die mid-write the worst case is
+        # one extra kill, never an unkillable loop
+        if die_marker:
+            PersiaPath(die_marker).write_bytes_atomic(b"1")
+
+    # --- resume: roll the whole job back to the newest complete snapshot
+    start = 0
+    if args.snapshot_dir:
+        found = _snapshot.latest_snapshot(args.snapshot_dir)
+        if found is not None:
+            snap, manifest = found
+            status["model_manager_status"] = "Loading"
+            worker.load(snap)  # PS load is clear=True: post-snap updates wiped
+            cur = manifest.get("cursor") or {}
+            start = int(cur.get("consumed", 0))
+            status["resumed_from"] = os.path.basename(snap)
+            _logger.info("resumed from %s at step %d", snap, start)
+
+    def factory(seed):
+        for k in range(args.steps):
+            draws = batch_draws(pool, seed, k, args.batch_size, args.n_feats)
+            yield [IDTypeFeature(f"slot_{i}", [d])
+                   for i, d in enumerate(draws)]
+
+    ds = ResumableDataset(factory, seed=args.seed, start=start)
+    status["model_manager_status"] = "Training"
+
+    step = start
+    for feats in ds:
+        if die_at == "between_snapshots" and step == die_step:
+            arm_kill()
+            _die_now()
+        # nested spans: the supervisor's postmortem validator requires a
+        # parent->child chain in the flight ring, and the client RPC
+        # layer emits none of its own
+        with tracing.span("trainer/step", root=True):
+            with tracing.span("trainer/lookup"):
+                ref, out = worker.lookup_direct_training(feats)
+            if die_at == "mid_step" and step == die_step:
+                arm_kill()
+                _die_now()
+            with tracing.span("trainer/update"):
+                worker.update_gradients(ref, {
+                    k: np.ones_like(v.embeddings) for k, v in out.items()})
+        step += 1
+        status["step"] = step
+        if args.snapshot_dir and step % args.snapshot_interval == 0:
+            pre = None
+            if die_at == "mid_snapshot" and step >= max(die_step, 1):
+                def pre(_snap):  # noqa: E306
+                    arm_kill()
+                    _die_now()
+            status["model_manager_status"] = "Dumping"
+            _snapshot.snapshot_job(
+                args.snapshot_dir, worker, cursor=ds.cursor(trained=step - start),
+                step=step, pre_manifest=pre)
+            status["model_manager_status"] = "Training"
+        if args.step_delay:
+            time.sleep(args.step_delay)
+
+    # final snapshot so the full run is durable, then report completion
+    if args.snapshot_dir:
+        _snapshot.snapshot_job(args.snapshot_dir, worker,
+                               cursor=ds.cursor(trained=step - start),
+                               step=step)
+    status["model_manager_status"] = "Done"
+    if args.result_file:
+        PersiaPath(args.result_file).write_bytes_atomic(json.dumps({
+            "steps": step, "seed": args.seed, "pool_size": args.pool_size,
+            "batch_size": args.batch_size, "n_feats": args.n_feats,
+            "resumed_from": status["resumed_from"],
+        }).encode())
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

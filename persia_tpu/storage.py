@@ -72,17 +72,39 @@ class PersiaPath:
             f.write(data)
 
     def write_bytes_atomic(self, data: bytes):
-        """All-or-nothing write: the destination either keeps its old
-        content (or stays absent) or holds ``data`` in full — never a
-        torn prefix. Local paths write ``<name>.tmp`` then rename (POSIX
-        atomic within a filesystem); HDFS ``-put -f -`` already replaces
-        whole files, so plain write_bytes is the same guarantee."""
+        """All-or-nothing AND durable write: the destination either
+        keeps its old content (or stays absent) or holds ``data`` in
+        full — never a torn prefix. Local paths write ``<name>.tmp``
+        then rename (POSIX atomic within a filesystem), fsyncing the
+        tmp file BEFORE the rename and the parent directory AFTER it
+        (PERSIA_FSYNC, default on) — without both, a host crash after
+        ``os.replace`` returns can still lose the record the caller
+        was told is durable (journal entries, snapshot manifests).
+        HDFS ``-put -f -`` already replaces whole files, so plain
+        write_bytes is the same guarantee."""
         if self.is_hdfs:
             self.write_bytes(data)
             return
+        from persia_tpu import knobs
+        fsync = knobs.get("PERSIA_FSYNC")
         tmp = PersiaPath(self.path + ".tmp")
-        tmp.write_bytes(data)
+        parent = os.path.dirname(self.path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        with open(tmp.path, "wb") as f:
+            f.write(data)
+            if fsync:
+                f.flush()
+                os.fsync(f.fileno())
         os.replace(tmp.path, self.path)
+        if fsync and parent:
+            # The rename itself lives in the directory entry; sync it
+            # too or the file can revert to the old name post-crash.
+            dfd = os.open(parent, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
 
     def exists(self) -> bool:
         if self.is_hdfs:

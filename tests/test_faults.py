@@ -245,6 +245,37 @@ def test_circuit_breaker_background_probe_closes_early():
     assert br.state == "closed"
 
 
+def test_circuit_breaker_probe_cadence_is_jittered():
+    """The inter-probe sleep must be decorrelated-jittered, not a fixed
+    cadence: after a supervised PS restart every client in the fleet
+    opens its breaker at the same instant, and a fixed cadence lands
+    all recovery probes on the reborn replica in synchronized waves.
+    Fake clock: the injectable ``_sleep`` records delays instead of
+    waiting, and the probe flips to success after a few rounds so the
+    loop terminates deterministically."""
+    rounds = []
+
+    def probe():
+        rounds.append(1)
+        return len(rounds) > 4  # fail 4 probes, then recover
+
+    br = CircuitBreaker(threshold=1, cooldown=60.0,
+                        probe=probe, probe_interval=0.25)
+    sleeps = []
+    br._sleep = sleeps.append  # fake clock: record, don't wait
+    br.record_failure()
+    assert br.state == "open"
+    deadline = time.monotonic() + 5.0
+    while br.state != "half_open" and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert br.state == "half_open"
+    assert len(sleeps) == 4  # one sleep per failed probe, none after
+    for d in sleeps:
+        assert br.probe_interval <= d <= 8 * br.probe_interval
+    # jittered, not a fixed cadence: the draws must not all coincide
+    assert len({round(d, 9) for d in sleeps}) > 1
+
+
 def test_ps_client_fails_fast_when_open_and_recovers():
     """PsClient + breaker against a real PS service: kill the server ->
     the breaker opens after consecutive transport failures and later

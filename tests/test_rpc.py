@@ -293,3 +293,77 @@ def test_dedup_concurrent_duplicate_waits_for_inflight():
         assert results == [b"result-1", b"result-1"]
     finally:
         server.stop()
+
+
+# --- retry-ladder storm control (PR 19) ----------------------------------
+
+
+def test_decorrelated_jitter_bounds_and_spread():
+    from persia_tpu.rpc import decorrelated_jitter
+
+    base, cap = 0.2, 5.0
+    # bounds: always within [base, cap] for any rand draw and any prev
+    for r in (0.0, 0.25, 0.9999):
+        for prev in (0.0, base, 1.7, 100.0):
+            d = decorrelated_jitter(base, cap, prev, rand=lambda r=r: r)
+            assert base <= d <= cap, (r, prev, d)
+    # decorrelation: the window widens with prev (prev*3), so the same
+    # rand draw maps to DIFFERENT delays for different histories
+    d_small = decorrelated_jitter(base, cap, 0.3, rand=lambda: 0.5)
+    d_large = decorrelated_jitter(base, cap, 1.2, rand=lambda: 0.5)
+    assert d_small != d_large
+    assert d_small == pytest.approx(base + 0.5 * (0.9 - base))
+    # cap clamps a runaway ladder
+    assert decorrelated_jitter(base, cap, 1e9, rand=lambda: 1.0) == cap
+    # degenerate window (prev*3 < base) never dips below base
+    assert decorrelated_jitter(base, cap, 0.0, rand=lambda: 0.0) == base
+
+
+def test_retry_budget_fake_clock():
+    from persia_tpu.rpc import RetryBudget
+
+    now = [100.0]
+    b = RetryBudget(capacity=3.0, refill_per_sec=2.0, clock=lambda: now[0])
+    assert b.acquire() and b.acquire() and b.acquire()
+    assert not b.acquire()  # burst spent, no time has passed
+    now[0] += 1.0  # fake clock: +1s -> +2 tokens
+    assert b.acquire()
+    assert b.acquire()
+    assert not b.acquire()
+    now[0] += 10.0  # refill caps at capacity, not 20 tokens
+    assert b.tokens == pytest.approx(3.0)
+
+
+def test_retry_ladder_spends_budget_and_jitters():
+    """Dial a dead address: the ladder must (a) draw every sleep from
+    decorrelated_jitter via the injectable rand, (b) stop early when
+    the per-client RetryBudget empties — surfacing the transport error
+    instead of sleeping through max_retries."""
+    import socket
+
+    from persia_tpu.rpc import RetryBudget, RpcClient
+
+    with socket.socket() as s:  # reserve a port nobody listens on
+        s.bind(("127.0.0.1", 0))
+        dead_addr = "127.0.0.1:%d" % s.getsockname()[1]
+
+    now = [0.0]
+    budget = RetryBudget(capacity=2.0, refill_per_sec=0.0,
+                         clock=lambda: now[0])
+    c = RpcClient(dead_addr, max_retries=10, retry_backoff=0.2,
+                  retry_budget=budget)
+    sleeps = []
+    c._retry_sleep = sleeps.append  # fake clock: record, don't wait
+    c._retry_rand = lambda: 0.5
+    with pytest.raises((RpcError, ConnectionError, OSError)):
+        c.call("ping", b"")
+    # budget (2 tokens, no refill) cut the 10-retry ladder to 2 sleeps
+    assert len(sleeps) == 2
+    assert budget.tokens == 0.0
+    # and each sleep is the decorrelated-jitter draw, not fixed backoff
+    from persia_tpu.rpc import decorrelated_jitter
+
+    d0 = decorrelated_jitter(0.2, 5.0, 0.2, rand=lambda: 0.5)
+    d1 = decorrelated_jitter(0.2, 5.0, d0, rand=lambda: 0.5)
+    assert sleeps == [pytest.approx(d0), pytest.approx(d1)]
+    assert sleeps[0] != sleeps[1]  # widening window, not constant

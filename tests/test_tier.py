@@ -50,6 +50,43 @@ def test_persia_path_write_bytes_atomic(tmp_path):
     assert not os.path.exists(str(tmp_path / "pkt.tmp"))
 
 
+def test_write_bytes_atomic_fsyncs_file_and_parent_dir(tmp_path, monkeypatch):
+    """Durability contract, not just atomicity: the tmp file must be
+    fsync'd BEFORE the rename and the parent directory AFTER it —
+    without both, a host crash after os.replace returns can still lose
+    the record the caller was told is durable."""
+    import persia_tpu.storage as storage
+
+    synced = []
+    real_fsync = os.fsync
+
+    def spy_fsync(fd):
+        synced.append(os.path.realpath(f"/proc/self/fd/{fd}")
+                      if os.path.exists(f"/proc/self/fd/{fd}") else fd)
+        return real_fsync(fd)
+
+    monkeypatch.setattr(storage.os, "fsync", spy_fsync)
+    target = tmp_path / "manifest.json"
+    PersiaPath(str(target)).write_bytes_atomic(b"payload")
+    assert target.read_bytes() == b"payload"
+    assert len(synced) == 2
+    # first sync is the tmp file (pre-rename), second the parent dir
+    assert str(synced[0]).endswith("manifest.json.tmp")
+    assert str(synced[1]) == os.path.realpath(str(tmp_path))
+
+
+def test_write_bytes_atomic_fsync_knob_off(tmp_path, monkeypatch):
+    import persia_tpu.storage as storage
+
+    calls = []
+    monkeypatch.setattr(storage.os, "fsync", lambda fd: calls.append(fd))
+    monkeypatch.setenv("PERSIA_FSYNC", "0")
+    p = PersiaPath(str(tmp_path / "pkt"))
+    p.write_bytes_atomic(b"x")
+    assert p.read_bytes() == b"x"
+    assert calls == []  # knob off: atomic rename only, no fsync
+
+
 # --- SpillStore ----------------------------------------------------------
 
 
