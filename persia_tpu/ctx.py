@@ -475,7 +475,9 @@ class TrainCtx(EmbeddingCtx):
         from persia_tpu.parallel.train import unpack_embedding_grads
         from persia_tpu.pipeline import LookedUpBatch
 
-        if self.device_cache_capacity:
+        if self.device_cache_capacity and not (
+                self._cache_engine is None and jax.process_count() > 1
+                and self._negotiate_multihost_cache()):
             if isinstance(batch, LookedUpBatch):
                 # DataLoader yields raw batches when the active ctx is
                 # cached (dataloader.py), so a pre-looked-up batch here
@@ -555,6 +557,35 @@ class TrainCtx(EmbeddingCtx):
 
     # --- device-resident cache path --------------------------------------
 
+    def _negotiate_multihost_cache(self) -> bool:
+        """Multi-process mesh + device cache requested: decide between
+        the historic hard error and a loud negotiate-down.
+
+        ``PERSIA_MULTIHOST_CACHE=off`` (default) disables the cache and
+        lets the run continue on the PS-only hybrid path — a pod job
+        must not die on a cache knob. ``refuse`` preserves the hard
+        error (we return False and :meth:`_ensure_cache` raises).
+        Returns True when the cache was negotiated off."""
+        from persia_tpu import knobs
+
+        mode = str(knobs.get("PERSIA_MULTIHOST_CACHE")).lower()
+        if mode == "refuse":
+            return False
+        if mode != "off":
+            raise ValueError(
+                f"PERSIA_MULTIHOST_CACHE={mode!r}: expected 'off' or "
+                "'refuse'")
+        _logger.warning(
+            "device cache requested (capacity=%d) on a multi-process "
+            "mesh (jax.process_count()=%d) — the cache's sign->slot "
+            "mapper and miss/evict host transfers are single-controller "
+            "state; NEGOTIATING DOWN: device cache DISABLED, continuing "
+            "on the PS-only hybrid path. Set PERSIA_MULTIHOST_CACHE="
+            "refuse to make this a hard error instead.",
+            self.device_cache_capacity, jax.process_count())
+        self.device_cache_capacity = 0
+        return True
+
     def _ensure_cache(self, batch: PersiaBatch):
         """First-batch validation + lazy build of the cache engine and
         the fused cached step. The v2 envelope: uniform dim, SUMMED
@@ -580,7 +611,9 @@ class TrainCtx(EmbeddingCtx):
                 f"jax.process_count()={jax.process_count()} — the "
                 "sign->slot mapper and miss/evict host transfers live "
                 "on one process; use the uncached hybrid path (or "
-                "device mode) on multi-process meshes")
+                "device mode) on multi-process meshes — or leave "
+                "PERSIA_MULTIHOST_CACHE=off to negotiate the cache "
+                "down instead of erroring")
         from persia_tpu.embedding.optim import Adagrad as ClientAdagrad
 
         opt = self.embedding_optimizer

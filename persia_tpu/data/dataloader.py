@@ -85,14 +85,33 @@ class ResumableDataset(IterableDatasetBase):
     (``cursor(trained=...)``); resume re-yields everything past that
     point, including batches that were sitting in the pipeline when
     the process died.
+
+    **Multi-process sharding**: ``process_index``/``process_count``
+    round-robin-partition the ONE global deterministic stream across a
+    trainer group — process ``p`` of ``N`` yields exactly the global
+    batches whose stream position ``i`` satisfies ``i % N == p``, so
+    the union of the per-process shard streams IS the 1-process stream
+    (no batch trained twice, none skipped, order within a shard
+    preserved). ``start`` and the cursor stay in PER-PROCESS trained
+    batches; the cursor additionally records the shard coordinates so
+    a resumed process refuses a cursor cut for a different shard.
+    Defaults (0, 1) are the historic single-process stream, positions
+    and cursor dict byte-identical.
     """
 
     def __init__(self, factory, seed: int = 0, start: int = 0,
-                 buffer_size: int = 128):
+                 buffer_size: int = 128, process_index: int = 0,
+                 process_count: int = 1):
         super().__init__(buffer_size)
         self.factory = factory
         self.seed = int(seed)
         self.start = int(start)
+        self.process_index = int(process_index)
+        self.process_count = int(process_count)
+        if not 0 <= self.process_index < self.process_count:
+            raise ValueError(
+                f"process_index {self.process_index} outside group of "
+                f"{self.process_count}")
         self.produced = 0  # batches handed out by THIS incarnation
 
     def cursor(self, trained: Optional[int] = None) -> Dict[str, int]:
@@ -100,19 +119,46 @@ class ResumableDataset(IterableDatasetBase):
         incarnation; defaults to every batch handed out (exact only
         when nothing runs ahead of the consumer)."""
         n = self.produced if trained is None else int(trained)
-        return {"seed": self.seed, "consumed": self.start + n}
+        cur = {"seed": self.seed, "consumed": self.start + n}
+        if self.process_count != 1:
+            # shard coordinates ride the cursor ONLY for sharded
+            # streams: the 1-process cursor dict (and with it the
+            # snapshot manifest) stays byte-identical to the historic
+            # format
+            cur["process_index"] = self.process_index
+            cur["process_count"] = self.process_count
+        return cur
 
     @classmethod
     def from_cursor(cls, factory, cursor: Dict[str, int],
-                    buffer_size: int = 128) -> "ResumableDataset":
+                    buffer_size: int = 128, process_index: int = 0,
+                    process_count: int = 1) -> "ResumableDataset":
+        cur_count = int(cursor.get("process_count", 1))
+        cur_index = int(cursor.get("process_index", 0))
+        if process_count == 1 and cur_count != 1:
+            # a sharded cursor restored without explicit coordinates
+            # resumes ITS shard (the cursor names the stream cut)
+            process_index, process_count = cur_index, cur_count
+        elif (cur_count, cur_index) != (1, 0) and (
+                (process_index, process_count) != (cur_index, cur_count)):
+            raise ValueError(
+                f"cursor names shard {cur_index}/{cur_count} but resume "
+                f"asked for {process_index}/{process_count} — a "
+                f"per-process cursor only positions its own shard")
         return cls(factory, seed=cursor["seed"], start=cursor["consumed"],
-                   buffer_size=buffer_size)
+                   buffer_size=buffer_size, process_index=process_index,
+                   process_count=process_count)
 
     def __iter__(self) -> Iterator[PersiaBatch]:
         import itertools
 
+        # global stream position of this shard's next batch: shard
+        # batches sit at global positions p, p+N, p+2N, ...; ``start``
+        # per-process trained batches == start*N global batches behind
         it = itertools.islice(iter(self.factory(self.seed)),
-                              self.start, None)
+                              self.process_index
+                              + self.start * self.process_count,
+                              None, self.process_count)
         for batch in it:
             self.produced += 1
             yield batch

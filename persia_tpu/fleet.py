@@ -127,6 +127,14 @@ class ScrapeTarget:
             # replica silently running scalar kernels is flagged
             "simd": h.get("simd"),
             "dispatch": h.get("dispatch"),
+            # multi-process trainer observables (trainer rows only;
+            # None elsewhere): which group member this row is, the
+            # group size, and the jax mesh shape it rendezvoused —
+            # fleet_status cross-checks mesh/version agreement across
+            # the group (trainer_*_skew)
+            "process_index": h.get("process_index"),
+            "process_count": h.get("process_count"),
+            "mesh_shape": h.get("mesh_shape"),
             "last_scrape_age_sec": (
                 round(now - self.last_scrape_t, 3)
                 if self.last_scrape_t is not None else None),
@@ -718,6 +726,18 @@ class FleetMonitor:
         # bit-identical results but at silently different cost, which
         # capacity planning must see
         simd_paths = {t["simd"] for t in targets if t.get("simd")}
+        # trainer-group skew, same shape as simd_skew: the rows of a
+        # multi-process trainer group must agree on package version
+        # (mixed rollout mid-job = divergent step functions) and mesh
+        # shape (a member that rendezvoused a different mesh cannot be
+        # in the same collective) — either is a co-scheduling bug the
+        # fleet view must flag before the collectives deadlock
+        trainers = [t for t in targets
+                    if t.get("process_index") is not None]
+        trainer_versions = {t["version"] for t in trainers
+                            if t.get("version")}
+        trainer_meshes = {t["mesh_shape"] for t in trainers
+                          if t.get("mesh_shape")}
         return {
             "fleet_monitor": {
                 "version": __version__,
@@ -731,6 +751,10 @@ class FleetMonitor:
             "version_skew": len(versions) > 1,
             "simd_skew": len(simd_paths) > 1,
             "simd_paths": sorted(simd_paths),
+            "n_trainer_processes": len(trainers),
+            "trainer_version_skew": len(trainer_versions) > 1,
+            "trainer_mesh_skew": len(trainer_meshes) > 1,
+            "trainer_mesh_shapes": sorted(trainer_meshes),
             "targets": targets,
         }
 

@@ -178,6 +178,14 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
     _k("PERSIA_METRICS_GATEWAY_ADDR", "str", None,
        "Prometheus push-gateway address for metrics.push_loop. Unset "
        "= pull-only via the /metrics sidecar."),
+    _k("PERSIA_MULTIHOST_CACHE", "str", "off",
+       "What a multi-process trainer (`jax.process_count() > 1`) does "
+       "when the device-resident embedding cache is requested: `off` "
+       "(default) negotiates down LOUDLY — the cache is disabled and "
+       "the run continues on the PS-only hybrid path, because a pod "
+       "job must not die on a cache knob; `refuse` keeps the historic "
+       "hard error (the cache's sign->slot mapper and miss/evict host "
+       "transfers are single-controller state)."),
     _k("PERSIA_NN_WORKER_ENTRY", "str", None,
        "Script the `persia_tpu.launcher nn-worker` role runs when no "
        "script argument is given."),
@@ -283,6 +291,17 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
        "expired extract/install instead of hanging the migration "
        "unboundedly. Idle fleets never negotiate it — the "
        "no-migration wire stays byte-identical. 0 disables."),
+    _k("PERSIA_PROCESS_COUNT", "int", 1,
+       "Trainer-group size this process belongs to. Set by "
+       "`persia_tpu.launcher nn-worker` on every spawned trainer copy "
+       "(alongside PERSIA_PROCESS_INDEX); the trainer driver shards "
+       "the deterministic batch stream by (index, count). 1 = the "
+       "historic single-process stream."),
+    _k("PERSIA_PROCESS_INDEX", "int", 0,
+       "This trainer process's rank within the trainer group "
+       "(0-based, < PERSIA_PROCESS_COUNT). Owns every global batch "
+       "whose stream position i satisfies "
+       "i % PERSIA_PROCESS_COUNT == index."),
     _k("PERSIA_RESHARD_DRAIN_SEC", "float", 5.0,
        "Double-read window after a reshard cutover: donors keep the "
        "moved rows readable (for in-flight lookups routed by the "
@@ -364,6 +383,21 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
        "disabled path must cost nothing, so the gate is a module "
        "constant; tests toggle via subprocess env.",
        import_time_safe=True),
+    _k("PERSIA_TRAINER_PROCESSES", "int", 1,
+       "Trainer (nn-worker) processes per job: `persia_tpu.launcher "
+       "nn-worker` spawns this many copies of the entry script with "
+       "PERSIA_PROCESS_INDEX/PERSIA_PROCESS_COUNT set, and "
+       "ServiceCtx's trainer supervisor sizes its group from the same "
+       "number. 1 = the historic single-process trainer."),
+    _k("PERSIA_TRAINER_RENDEZVOUS_KEY", "str", "trainer/jax_coordinator",
+       "Coordinator KV key the trainer group rendezvouses through: "
+       "process 0 binds the jax.distributed coordination port and "
+       "kv_put's `host:port` under this key; every other process "
+       "wait_kv's it before jax.distributed.initialize."),
+    _k("PERSIA_TRAINER_RENDEZVOUS_TIMEOUT_SEC", "float", 120.0,
+       "How long a non-zero trainer process waits for process 0 to "
+       "publish the jax.distributed coordinator address before giving "
+       "up (coordinator KV wait_kv timeout)."),
     _k("PERSIA_VARIANT_ROUTE_FEATURE", "str", None,
        "Field-based A/B routing for the serving tier: when set, a "
        "plain predict derives its variant route key from this id "

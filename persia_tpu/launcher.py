@@ -9,14 +9,19 @@ manifests stay declarative:
     python -m persia_tpu.launcher embedding-worker --embedding-config ...
     python -m persia_tpu.launcher embedding-parameter-server ...
 
-Unlike the reference there is no torch.distributed.launch wrapping for
-nn-workers: multi-chip scale-out is an in-process jax Mesh (single
-controller per host), so one nn-worker process per TPU host suffices.
+Multi-chip scale-out within a host is an in-process jax Mesh (single
+controller per host), so one nn-worker process per TPU host suffices;
+POD scale-out sets ``PERSIA_TRAINER_PROCESSES`` and the nn-worker role
+spawns that many trainer copies (the reference's
+``torch.distributed.launch`` analogue), each carrying
+``PERSIA_PROCESS_INDEX``/``PERSIA_PROCESS_COUNT`` for stream sharding
+and jax.distributed mesh rendezvous.
 """
 
 import argparse
 import os
 import sys
+import time
 
 from persia_tpu import knobs
 from persia_tpu.logger import get_default_logger
@@ -35,6 +40,51 @@ def _run_script(entry_env: str, argv):
     _logger.info("launching %s", " ".join(cmd))
     proc = run_command(cmd)
     raise SystemExit(proc.wait())
+
+
+def _run_trainer_group(argv):
+    """nn-worker role: PERSIA_TRAINER_PROCESSES copies of the entry
+    script, each with PERSIA_PROCESS_INDEX/PERSIA_PROCESS_COUNT set so
+    the trainer drivers shard the deterministic batch stream and
+    rendezvous their jax.distributed mesh (the reference's
+    ``torch.distributed.launch`` role, done pod-style: one process per
+    trainer host, co-scheduled with the PS/worker tiers). Exits with
+    the first nonzero child rc — one dead group member means the
+    collective is wedged, so the whole group should be restarted by
+    whatever supervises the launcher."""
+    n = knobs.get("PERSIA_TRAINER_PROCESSES")
+    if n <= 1:
+        _run_script("PERSIA_NN_WORKER_ENTRY", argv)
+        return
+    script = argv[0] if argv else knobs.get("PERSIA_NN_WORKER_ENTRY")
+    if not script:
+        raise SystemExit("no script given and PERSIA_NN_WORKER_ENTRY not set")
+    cmd = [sys.executable, script, *argv[1:]]
+    procs = []
+    for i in range(n):
+        _logger.info("launching trainer %d/%d: %s", i, n, " ".join(cmd))
+        procs.append(run_command(cmd, env={
+            "PERSIA_PROCESS_INDEX": i, "PERSIA_PROCESS_COUNT": n}))
+    # poll, don't wait sequentially: a crashed member wedges the rest
+    # on the next collective, and a wait() on a wedged process would
+    # mask the crash forever
+    rc = None
+    while rc is None:
+        rcs = [proc.poll() for proc in procs]
+        bad = [(i, r) for i, r in enumerate(rcs) if r not in (None, 0)]
+        if bad:
+            i, rc = bad[0]
+            _logger.error("trainer %d exited rc=%d; terminating group",
+                          i, rc)
+        elif all(r == 0 for r in rcs):
+            rc = 0
+        else:
+            time.sleep(0.2)
+    if rc != 0:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.terminate()
+    raise SystemExit(rc)
 
 
 def main(argv=None):
@@ -64,7 +114,7 @@ def main(argv=None):
     elif args.role == "data-loader":
         _run_script("PERSIA_DATALOADER_ENTRY", rest)
     elif args.role == "nn-worker":
-        _run_script("PERSIA_NN_WORKER_ENTRY", rest)
+        _run_trainer_group(rest)
 
 
 if __name__ == "__main__":

@@ -110,6 +110,8 @@ class ServiceCtx:
         trainer_args: Optional[List[str]] = None,
         trainer_max_restarts: int = 5,
         snapshot_dir: Optional[str] = None,
+        n_trainers: int = 1,
+        trainer_env: Optional[dict] = None,
     ):
         self.schema = schema
         self.n_workers = n_workers
@@ -187,6 +189,16 @@ class ServiceCtx:
         self.trainer_args = list(trainer_args or [])
         self.trainer_max_restarts = trainer_max_restarts
         self.snapshot_dir = snapshot_dir
+        # multi-process trainer group (the pod-scale hybrid): N copies
+        # of the trainer driver, each spawned with
+        # --process-index/--process-count so the drivers shard the ONE
+        # deterministic batch stream. trainer_env overlays env on the
+        # trainer tier only (e.g. JAX_PLATFORMS=cpu for CPU-mesh cells
+        # without forcing the CPU backend on the PS/worker tier).
+        if n_trainers < 1:
+            raise ValueError(f"n_trainers must be >= 1, got {n_trainers}")
+        self.n_trainers = n_trainers
+        self.trainer_env = dict(trainer_env or {})
         self.worker_recoveries: List[dict] = []
         self.trainer_recoveries: List[dict] = []
         self.trainer_done = False
@@ -194,8 +206,9 @@ class ServiceCtx:
         self._worker_restarts: dict = {}
         self._worker_incarnation: dict = {}
         self._worker_args: dict = {}
-        self._trainer_restarts = 0
-        self._trainer_incarnation = 0
+        self._trainer_restarts: dict = {}   # process index -> restarts
+        self._trainer_incarnation: dict = {}
+        self._trainer_exit: dict = {}       # process index -> rc 0
         # generic sidecar flight polling beyond the PS tier:
         # name -> addr file; cached addrs + last-poll stamps
         self._flight_files: dict = {}
@@ -203,12 +216,14 @@ class ServiceCtx:
         self._flight_last: dict = {}
 
     def _spawn(self, args: List[str], name: str, replica_index: int,
-               replica_size: int) -> subprocess.Popen:
+               replica_size: int,
+               env_extra: Optional[dict] = None) -> subprocess.Popen:
         return self._spawn_raw([sys.executable, *args], name, replica_index,
-                               replica_size)
+                               replica_size, env_extra=env_extra)
 
     def _spawn_raw(self, cmd: List[str], name: str, replica_index: int,
-                   replica_size: int) -> subprocess.Popen:
+                   replica_size: int,
+                   env_extra: Optional[dict] = None) -> subprocess.Popen:
         env = dict(os.environ)
         env["PYTHONPATH"] = _REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
         env["REPLICA_INDEX"] = str(replica_index)
@@ -216,6 +231,8 @@ class ServiceCtx:
         if self.coordinator_addr:
             env["PERSIA_COORDINATOR_ADDR"] = self.coordinator_addr
         env.update({k: str(v) for k, v in self.extra_env.items()})
+        if env_extra:
+            env.update({k: str(v) for k, v in env_extra.items()})
         proc = subprocess.Popen(cmd, env=env)
         proc._persia_name = name  # type: ignore[attr-defined]
         self.procs.append(proc)
@@ -297,7 +314,8 @@ class ServiceCtx:
             self.__exit__(None, None, None)
             raise
         if self.supervise_trainer:
-            self._spawn_trainer()
+            for i in range(self.n_trainers):
+                self._spawn_trainer(i)
         self._monitor = threading.Thread(target=self._watch, daemon=True,
                                          name="service-ctx-monitor")
         self._monitor.start()
@@ -333,23 +351,35 @@ class ServiceCtx:
         proc._persia_worker = i  # type: ignore[attr-defined]
         return proc
 
-    def _spawn_trainer(self) -> subprocess.Popen:
-        """Spawn (or respawn) the supervised trainer driver. The driver
-        itself owns resume: on start it rolls the job back to the
-        newest complete snapshot under --snapshot-dir and replays the
-        deterministic batch stream from the snapshotted cursor."""
-        self._trainer_incarnation += 1
+    def _spawn_trainer(self, i: int = 0) -> subprocess.Popen:
+        """Spawn (or respawn) supervised trainer driver ``i`` of the
+        group. The driver itself owns resume: on start it rolls the job
+        back to the newest complete snapshot under --snapshot-dir (or,
+        in a multi-process group, to its own shard cursor) and replays
+        the deterministic batch stream. With ``n_trainers > 1`` every
+        copy gets explicit --process-index/--process-count and its own
+        flight channel (``trainer<i>``); the single-trainer spawn stays
+        argument-identical to the historic supervisor."""
+        inc = self._trainer_incarnation[i] = (
+            self._trainer_incarnation.get(i, 0) + 1)
         args = ["-m", "persia_tpu.service.trainer_service",
                 "--coordinator", self.coordinator_addr,
                 *self.trainer_args]
+        if self.n_trainers > 1:
+            args += ["--process-index", str(i),
+                     "--process-count", str(self.n_trainers)]
         if self.snapshot_dir:
             args += ["--snapshot-dir", self.snapshot_dir]
-        http_file = os.path.join(self._tmpdir.name,
-                                 f"trainer_{self._trainer_incarnation}.http")
-        self._arm_flight("trainer", http_file)
+        flight = "trainer" if self.n_trainers == 1 else f"trainer{i}"
+        stem = (f"trainer_{inc}" if self.n_trainers == 1
+                else f"trainer_{i}_{inc}")
+        http_file = os.path.join(self._tmpdir.name, f"{stem}.http")
+        self._arm_flight(flight, http_file)
         args += ["--http-port", "0", "--http-addr-file", http_file]
-        proc = self._spawn(args, "trainer", 0, 1)
+        proc = self._spawn(args, flight, i, self.n_trainers,
+                           env_extra=self.trainer_env or None)
         proc._persia_trainer = True  # type: ignore[attr-defined]
+        proc._persia_trainer_idx = i  # type: ignore[attr-defined]
         return proc
 
     def _arm_flight(self, name: str, http_file: str):
@@ -408,13 +438,18 @@ class ServiceCtx:
                     continue
                 name = getattr(p, "_persia_name", "?")
                 if getattr(p, "_persia_trainer", False):
+                    ti = getattr(p, "_persia_trainer_idx", 0)
                     if rc == 0:
-                        # the driver finished its run: not a crash
+                        # this driver finished its run: not a crash.
+                        # The JOB is done when the whole group is.
                         p._persia_handled = True  # type: ignore
-                        self.trainer_done = True
-                        self.trainer_rc = 0
+                        self._trainer_exit[ti] = 0
+                        if len(self._trainer_exit) == self.n_trainers:
+                            self.trainer_done = True
+                            self.trainer_rc = 0
                         continue
-                    if self._trainer_restarts < self.trainer_max_restarts:
+                    if (self._trainer_restarts.get(ti, 0)
+                            < self.trainer_max_restarts):
                         self._recover_trainer(p, rc)
                         continue
                     self.trainer_rc = rc
@@ -564,23 +599,27 @@ class ServiceCtx:
             return None
 
     def _recover_trainer(self, proc: subprocess.Popen, rc: int):
-        """Respawn the dead trainer driver. The replacement resumes
-        from the newest complete snapshot on its own; this side only
-        records the event (+ postmortem from the last cached /flight
-        snapshot) and relaunches."""
+        """Respawn a dead trainer driver (process ``i`` of the group).
+        The replacement resumes from the newest complete snapshot (or
+        its shard cursor) on its own; this side only records the event
+        (+ postmortem from the last cached /flight snapshot) and
+        relaunches."""
+        i = getattr(proc, "_persia_trainer_idx", 0)
         proc._persia_handled = True  # type: ignore[attr-defined]
-        self._trainer_restarts += 1
-        event = {"reason": f"exited rc={rc}",
+        self._trainer_restarts[i] = self._trainer_restarts.get(i, 0) + 1
+        flight = "trainer" if self.n_trainers == 1 else f"trainer{i}"
+        event = {"reason": f"exited rc={rc}", "process": i,
                  "t_detected": time.monotonic(),
-                 "restart_no": self._trainer_restarts}
-        _logger.error("supervised trainer died (rc=%s); restarting (%d/%d)",
-                      rc, self._trainer_restarts, self.trainer_max_restarts)
+                 "restart_no": self._trainer_restarts[i]}
+        _logger.error(
+            "supervised trainer %d died (rc=%s); restarting (%d/%d)",
+            i, rc, self._trainer_restarts[i], self.trainer_max_restarts)
         bundle = self._capture_postmortem(
-            "trainer", f"crash:rc={rc}",
-            extra={"restart_no": self._trainer_restarts})
+            flight, f"crash:rc={rc}",
+            extra={"restart_no": self._trainer_restarts[i]})
         if bundle:
             event["postmortem"] = bundle
-        self._spawn_trainer()
+        self._spawn_trainer(i)
         event["t_respawned"] = time.monotonic()
         self.trainer_recoveries.append(event)
 
@@ -658,11 +697,12 @@ class ServiceCtx:
         proc._persia_worker = i  # type: ignore[attr-defined]
         return proc
 
-    def trainer_proc(self) -> Optional[subprocess.Popen]:
-        """The LIVE trainer driver subprocess (chaos cells SIGKILL it;
-        after a recovery this returns the replacement)."""
+    def trainer_proc(self, i: int = 0) -> Optional[subprocess.Popen]:
+        """The LIVE subprocess of trainer driver ``i`` (chaos cells
+        SIGKILL it; after a recovery this returns the replacement)."""
         for p in reversed(self.procs):
             if (getattr(p, "_persia_trainer", False)
+                    and getattr(p, "_persia_trainer_idx", 0) == i
                     and not getattr(p, "_persia_handled", False)
                     and p.poll() is None):
                 return p
@@ -691,8 +731,10 @@ class ServiceCtx:
             if self.crashed:
                 raise RuntimeError(f"cluster crashed: {self.crashed}")
             time.sleep(0.05)
-        raise TimeoutError(f"trainer not done after {timeout}s "
-                           f"(restarts={self._trainer_restarts})")
+        raise TimeoutError(
+            f"trainer group not done after {timeout}s (done="
+            f"{sorted(self._trainer_exit)}/{self.n_trainers}, "
+            f"restarts={dict(self._trainer_restarts)})")
 
     def wait_worker_recoveries(self, n: int, timeout: float = 60.0
                                ) -> List[dict]:

@@ -63,6 +63,10 @@ class WorkerService:
         # in the construction window must not 500 on missing state.
         self._ready_lock = threading.Lock()
         self._ready_cache = (0.0, True)
+        # gradient shipments per trainer process label ("" = unlabeled
+        # single-process trainer) — the fleet's per-process data-plane view
+        self._ship_lock = threading.Lock()
+        self._ship_counts: Dict[str, int] = {}
         self.http = obs_http.maybe_start(host, http_port, self._health)
         s = self.server
         s.register("forward_batched", self._forward_batched)
@@ -110,6 +114,9 @@ class WorkerService:
         # (every PS replica armed and Idle)? /healthz?ready=1 turns a
         # False into a 503 so probes stop routing here mid-PS-recovery
         doc["ready"] = self._ready_cached()
+        with self._ship_lock:
+            if self._ship_counts:
+                doc["ship_counts"] = dict(self._ship_counts)
         return doc
 
     READY_CACHE_SEC = 2.0
@@ -170,6 +177,13 @@ class WorkerService:
         meta, grads = ser.unpack_gradients(payload)
         self.worker.update_gradients(meta["ref_id"], grads,
                                      loss_scale=meta.get("loss_scale", 1.0))
+        # multi-process trainers label their shipments (meta["process"])
+        # so the fleet can see every group member's backward traffic
+        # landing; single-process trainers send no label (byte-identical
+        # wire) and are counted under ""
+        label = str(meta.get("process", ""))
+        with self._ship_lock:
+            self._ship_counts[label] = self._ship_counts.get(label, 0) + 1
         return b""
 
     def _configure(self, payload: bytes) -> bytes:
@@ -259,6 +273,10 @@ class RemoteEmbeddingWorker:
         self._clients = {a: RpcClient(a) for a in self.addrs}
         self._rr = itertools.cycle(self.addrs)
         self._rr_lock = threading.Lock()
+        # multi-process trainers set this (e.g. "p1") so their backward
+        # shipments are attributable per group member; None (default)
+        # sends the historic meta dict — byte-identical wire
+        self.process_label: Optional[str] = None
         self.schema = None  # populated lazily for prepare_features parity
         # the serving tier's miss-fetch hop honors the same wire-codec
         # policy as the PS hop: fp16 rows when PERSIA_PS_WIRE_CODEC
@@ -331,10 +349,12 @@ class RemoteEmbeddingWorker:
     def update_gradients(self, ref, grads: Dict[str, np.ndarray],
                          loss_scale: float = 1.0):
         client = self._client_for(ref)
+        meta = {"ref_id": ref[1], "loss_scale": loss_scale}
+        if self.process_label is not None:
+            meta["process"] = self.process_label
         # non-idempotent: dedup id makes the retry at-most-once server-side
-        client.call("update_gradients", ser.pack_gradients(
-            grads, {"ref_id": ref[1], "loss_scale": loss_scale}),
-            dedup=True)
+        client.call("update_gradients", ser.pack_gradients(grads, meta),
+                    dedup=True)
 
     # --- control plane ---------------------------------------------------
 

@@ -311,6 +311,59 @@ def test_fleet_status_topology_and_version_skew():
         b.stop()
 
 
+def test_fleet_status_trainer_group_rows_and_skew():
+    """Multi-process trainer rows: /fleet/status carries each group
+    member's process_index/count + rendezvoused mesh shape, flags
+    version or mesh disagreement across the group (either means the
+    collectives will deadlock), and the process-labeled step gauges
+    land in /fleet/history as per-member series."""
+    h0 = {"process_index": 0, "process_count": 2, "mesh_shape": "2x1"}
+    h1 = {"process_index": 1, "process_count": 2, "mesh_shape": "2x1"}
+    reg0, t0 = _mk_sidecar("trainer0", extra_health=h0)
+    reg1, t1 = _mk_sidecar("trainer1", extra_health=h1)
+    _, ps = _mk_sidecar("ps0")
+    reg0.gauge("trainer_step", labels={"process": "p0"}).set(4.0)
+    reg1.gauge("trainer_step", labels={"process": "p1"}).set(3.0)
+    mon = FleetMonitor(targets=[
+        {"service": "trainer0", "http_addr": t0.addr, "role": "trainer"},
+        {"service": "trainer1", "http_addr": t1.addr, "role": "trainer"},
+        {"service": "ps0", "http_addr": ps.addr, "role": "ps"},
+    ])
+    try:
+        mon.scrape_once()
+        st = mon.fleet_status()
+        assert st["n_trainer_processes"] == 2
+        assert not st["trainer_version_skew"]  # same package everywhere
+        assert not st["trainer_mesh_skew"]
+        assert st["trainer_mesh_shapes"] == ["2x1"]
+        by_name = {t["service"]: t for t in st["targets"]}
+        assert by_name["trainer0"]["process_index"] == 0
+        assert by_name["trainer1"]["process_index"] == 1
+        assert by_name["trainer1"]["process_count"] == 2
+        assert by_name["trainer1"]["mesh_shape"] == "2x1"
+        # non-trainer rows are untouched (and excluded from the group)
+        assert by_name["ps0"]["process_index"] is None
+
+        # process-labeled gauges become distinct /fleet/history series
+        ex = mon.history.excerpt("trainer_step", window_sec=100.0, points=4)
+        assert {e["service"] for e in ex} == {"trainer0", "trainer1"}
+
+        # one member rendezvoused a different mesh on a different
+        # package build: both skew flags must fire
+        h1["mesh_shape"] = "4x1"
+        h1["version"] = "0.0.0-canary"
+        mon.scrape_once()
+        st = mon.fleet_status()
+        assert st["trainer_mesh_skew"]
+        assert st["trainer_version_skew"]
+        assert st["trainer_mesh_shapes"] == ["2x1", "4x1"]
+    finally:
+        mon.stop()
+        t0.stop()
+        t1.stop()
+        ps.stop()
+
+
 def test_fleet_http_endpoints():
     reg, a = _mk_sidecar("ps0")
     reg.counter("reqs_total").inc()
